@@ -1,0 +1,9 @@
+"""AMP — analog of python/paddle/amp/ (auto_cast.py:687, grad_scaler.py:576).
+
+TPU-first: the default low-precision dtype is bfloat16 (no loss scaling needed),
+but fp16 + dynamic GradScaler is kept for API/behavior parity.
+"""
+from .auto_cast import auto_cast, amp_guard, amp_state, white_list, black_list  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+auto_cast = auto_cast

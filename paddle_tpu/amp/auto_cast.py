@@ -1,0 +1,100 @@
+"""auto_cast: op-level automatic mixed precision.
+
+Analog of the reference's eager AMP autocast (paddle/fluid/eager/amp_utils.h,
+python/paddle/amp/auto_cast.py): per-op allow/deny lists consulted in the op
+dispatch path. O1 casts allow-listed compute ops to bf16/fp16; O2 additionally
+keeps parameters in low precision (use Layer.bfloat16() / decorate()).
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from ..core import dtype as dtypes
+
+# ops that benefit from MXU low precision (matmul/conv family)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "einsum", "bmm", "mm", "mv", "addmm",
+    "sdpa", "lstm", "gru", "rnn_tanh", "rnn_relu",
+}
+# ops that must stay fp32 for numerics
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "bce_with_logits",
+    "binary_cross_entropy", "mse_loss", "l1_loss", "kl_div", "ctc_loss",
+    "softmax", "log_softmax", "logsumexp", "norm", "mean", "sum", "cumsum",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = dtypes.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def amp_dtype_for(op_name: str):
+    """Called by ops.dispatch: returns the target dtype if this op should be
+    autocast, else None."""
+    if not _state.enabled:
+        return None
+    name = op_name.lower()
+    if name in _state.custom_black or name in BLACK_LIST:
+        return dtypes.float32
+    if name in _state.custom_white or name in WHITE_LIST:
+        return _state.dtype
+    return None
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = dtypes.convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the AMP dtype (master weights are
+    maintained by the optimizer via multi_precision)."""
+    dt = dtypes.convert_dtype(dtype)
+    out_models = models
+    if models is not None:
+        ms = models if isinstance(models, (list, tuple)) else [models]
+        for m in ms:
+            m.astype(dt)
+    if optimizers is None:
+        return out_models
+    return out_models, optimizers

@@ -1,0 +1,151 @@
+"""Reverse-mode traversal of the recorded tape.
+
+Analog of egr::Backward / RunBackward (paddle/fluid/eager/backward.cc:421,:104):
+queue-driven reverse-topological walk over GradNodes with per-edge pending counts
+and gradient accumulation (GradTensorHolder analog is the `node_cots` map).
+"""
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+
+
+def _accumulate(slot, grad):
+    return grad if slot is None else slot + grad
+
+
+def backward(tensors: List[Tensor], grad_tensors: Optional[List[Optional[Tensor]]] = None,
+             retain_graph: bool = False):
+    roots = [t for t in tensors if isinstance(t, Tensor)]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+
+    # seed cotangents
+    node_cots = {}   # node -> [cot per output]
+
+    def seed(t, g):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar tensor in backward()")
+            g = jnp.ones(t._value.shape, t._value.dtype)
+        else:
+            g = g._value if isinstance(g, Tensor) else jnp.asarray(g, t._value.dtype)
+        node = t._grad_node
+        if node is None:
+            # root is itself a leaf
+            if not t.stop_gradient:
+                prev = t.grad._value if t.grad is not None else None
+                t.grad = Tensor(_accumulate(prev, g))
+            return
+        cots = node_cots.setdefault(node, [None] * len(node.out_avals))
+        cots[t._out_index] = _accumulate(cots[t._out_index], g)
+
+    for t, g in zip(roots, grad_tensors):
+        seed(t, g)
+
+    # discover reachable graph + per-node pending consumer-edge counts
+    pending = defaultdict(int)   # id(node) -> number of unprocessed consumer edges
+    nodes_by_id = {}
+    stack = [t._grad_node for t in roots if t._grad_node is not None]
+    while stack:
+        node = stack.pop()
+        if id(node) in nodes_by_id:
+            continue
+        nodes_by_id[id(node)] = node
+        for inp in node.inputs:
+            parent = inp._grad_node
+            if parent is not None and not inp.stop_gradient:
+                pending[id(parent)] += 1
+                stack.append(parent)
+
+    ready = deque(n for nid, n in nodes_by_id.items() if pending[nid] == 0)
+    processed = set()
+
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+
+        cots = node_cots.pop(node, None)
+        if cots is None:
+            cots = [None] * len(node.out_avals)
+        # fill missing cotangents with zeros
+        full = []
+        for c, aval in zip(cots, node.out_avals):
+            if c is None:
+                shape, dt = aval
+                c = jnp.zeros(shape, dt)
+            full.append(c)
+        cot_arg = tuple(full) if node.multi_output else full[0]
+        in_grads = node.vjp_fn(cot_arg)
+
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None or inp.stop_gradient:
+                continue
+            # fire user hooks on the flowing gradient
+            if inp._backward_hooks:
+                gt = Tensor(g)
+                for hook in inp._backward_hooks:
+                    r = hook(gt)
+                    if r is not None:
+                        gt = r if isinstance(r, Tensor) else Tensor(r)
+                g = gt._value
+            parent = inp._grad_node
+            if parent is None or inp._retain_grads:
+                if not inp.stop_gradient:
+                    prev = inp.grad._value if inp.grad is not None else None
+                    inp.grad = Tensor(_accumulate(prev, g))
+            if parent is not None:
+                cots = node_cots.setdefault(parent, [None] * len(parent.out_avals))
+                cots[inp._out_index] = _accumulate(cots[inp._out_index], g)
+                pending[id(parent)] -= 1
+                if pending[id(parent)] == 0:
+                    ready.append(parent)
+
+        if not retain_graph:
+            node.vjp_fn = None
+            node.inputs = []
+
+    if not retain_graph:
+        for t in roots:
+            t._grad_node = None
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
+         allow_unused=False):
+    """Functional gradient — analog of paddle.grad (python/paddle/autograd).
+
+    Note: create_graph (higher-order) is not supported by the eager tape yet; use
+    the traced path (paddle_tpu.jit) + jax.grad composition for higher-order AD.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.jit traced autograd for higher-order")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    # temporarily retain grads on inputs, snapshot existing .grad
+    snapshots = [(t, t.grad, t._retain_grads) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._retain_grads = True
+    try:
+        backward(list(outputs), grad_outputs, retain_graph=retain_graph)
+        results = []
+        for t in inputs:
+            if t.grad is None and not allow_unused:
+                raise RuntimeError("an input tensor received no gradient; "
+                                   "pass allow_unused=True to permit this")
+            results.append(t.grad)
+    finally:
+        for t, g, r in snapshots:
+            t.grad = g
+            t._retain_grads = r
+    return results

@@ -1,0 +1,37 @@
+"""Grad-recording mode switches (analog of paddle.no_grad / enable_grad)."""
+from __future__ import annotations
+
+import threading
+from contextlib import ContextDecorator
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "enabled", True)
+
+
+def set_grad_enabled(flag: bool):
+    _state.enabled = bool(flag)
+
+
+class no_grad(ContextDecorator):
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class enable_grad(ContextDecorator):
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False

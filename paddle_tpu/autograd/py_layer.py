@@ -1,0 +1,90 @@
+"""PyLayer: user-defined autograd ops.
+
+Analog of paddle.autograd.PyLayer (paddle/fluid/eager/pylayer/). The user's
+static `forward`/`backward` run eagerly on Tensors; a custom GradNode bridges
+the user backward into the tape.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import GradNode
+from .grad_mode import is_grad_enabled, no_grad
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class _PyLayerNode(GradNode):
+    """GradNode whose vjp calls the user's backward."""
+    __slots__ = ("ctx", "backward_fn", "n_inputs")
+
+    def __init__(self, ctx, backward_fn, inputs, out_avals, multi_output, op_name):
+        def vjp(cot):
+            cots = cot if isinstance(cot, tuple) else (cot,)
+            cot_tensors = tuple(Tensor(c) for c in cots)
+            with no_grad():
+                grads = backward_fn(ctx, *cot_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            out = []
+            for g in grads:
+                out.append(None if g is None else
+                           (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+            return tuple(out)
+        super().__init__(vjp, inputs, out_avals, multi_output, op_name)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        requires = is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        if requires:
+            diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+            # the user's backward returns grads for ALL tensor inputs in order
+            node = _PyLayerNode(
+                ctx, cls.backward, tensor_inputs,
+                [(o._value.shape, o._value.dtype) for o in outs],
+                multi, cls.__name__)
+            for i, o in enumerate(outs):
+                if isinstance(o, Tensor):
+                    o = outs[i] = Tensor(o._value, stop_gradient=False)
+                    o._grad_node = node
+                    o._out_index = i
+            out = type(out)(outs) if multi else outs[0]
+        return out

@@ -1,0 +1,2 @@
+from . import device, dtype, generator  # noqa: F401
+from .tensor import Parameter, Tensor, is_tensor  # noqa: F401

@@ -1,0 +1,71 @@
+"""Device management.
+
+TPU-native analog of the reference's DeviceManager / paddle.device API
+(paddle/phi/backends/device_manager.h:133, python/paddle/device/__init__.py:244).
+Devices are jax devices; "tpu" maps to the default accelerator platform.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+_PLATFORM_ALIASES = {
+    "tpu": ("tpu", "axon"),  # axon = tunneled TPU platform name in this environment
+    "cpu": ("cpu",),
+    "gpu": ("gpu", "cuda", "rocm"),
+}
+
+
+def _platform_devices(platform: str):
+    for alias in _PLATFORM_ALIASES.get(platform, (platform,)):
+        try:
+            devs = jax.devices(alias)
+            if devs:
+                return devs
+        except RuntimeError:
+            continue
+    return []
+
+
+def device_count(platform: str | None = None) -> int:
+    if platform is None:
+        return len(jax.devices())
+    return len(_platform_devices(platform))
+
+
+def is_compiled_with_tpu() -> bool:
+    return bool(_platform_devices("tpu"))
+
+
+def set_device(device: str):
+    """set_device('tpu') / 'cpu' / 'tpu:0'."""
+    if ":" in device:
+        platform, idx = device.split(":")
+        idx = int(idx)
+    else:
+        platform, idx = device, 0
+    devs = _platform_devices(platform)
+    if not devs:
+        raise RuntimeError(f"no devices found for platform {platform!r}; "
+                           f"available: {[d.platform for d in jax.devices()]}")
+    _state.device = devs[idx]
+    _state.device_str = f"{platform}:{idx}"
+    return _state.device
+
+
+def get_device() -> str:
+    if not hasattr(_state, "device_str"):
+        # default: first device of the default backend
+        d = jax.devices()[0]
+        plat = "tpu" if d.platform in ("tpu", "axon") else d.platform
+        _state.device = d
+        _state.device_str = f"{plat}:{d.id}"
+    return _state.device_str
+
+
+def current_jax_device():
+    get_device()
+    return _state.device

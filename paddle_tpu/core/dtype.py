@@ -1,0 +1,84 @@
+"""Dtype registry.
+
+TPU-native analog of the reference's dtype enum (paddle/phi/common/data_type.h).
+We alias directly onto numpy/jax dtypes; strings accepted everywhere.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    # convenience aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(d):
+    """Normalize str/np.dtype/jnp dtype to a canonical numpy dtype type."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        if d not in _STR2DTYPE:
+            raise TypeError(f"unsupported dtype string: {d!r}")
+        return _STR2DTYPE[d]
+    return np.dtype(d).type
+
+
+def dtype_name(d) -> str:
+    return np.dtype(d).name
+
+
+def is_floating(d) -> bool:
+    return np.issubdtype(np.dtype(d), np.floating)
+
+
+def is_complex(d) -> bool:
+    return np.issubdtype(np.dtype(d), np.complexfloating)
+
+
+def is_integer(d) -> bool:
+    return np.issubdtype(np.dtype(d), np.integer)
+
+
+def is_differentiable(d) -> bool:
+    return is_floating(d) or is_complex(d)

@@ -1,0 +1,92 @@
+"""RNG state.
+
+Analog of phi::Generator (paddle/phi/core/generator.h:32) — a named, seedable,
+splittable random state built on JAX PRNG keys (threefry). `paddle_tpu.seed(n)`
+reseeds the default generator; every random op folds a fresh subkey off it.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+_lock = threading.Lock()
+
+
+class Generator:
+    def __init__(self, seed: int = 0, name: str = "default"):
+        self.name = name
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._offset = 0
+
+    def manual_seed(self, seed: int):
+        with _lock:
+            self._seed = int(seed)
+            self._key = jax.random.key(self._seed)
+            self._offset = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Return a fresh PRNG key; deterministic given (seed, call index)."""
+        with _lock:
+            self._offset += 1
+            return jax.random.fold_in(self._key, self._offset)
+
+    def get_state(self):
+        return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state):
+        with _lock:
+            self._seed = int(state["seed"])
+            self._key = jax.random.key(self._seed)
+            self._offset = int(state["offset"])
+
+
+_trace = threading.local()
+
+
+class key_override:
+    """Route next_key() off an explicit (possibly traced) base key.
+
+    Used by the to_static trace path so random ops (dropout etc.) inside a
+    compiled program draw from a per-call key argument instead of host state.
+    """
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        self._prev = (getattr(_trace, "key", None), getattr(_trace, "ctr", 0))
+        _trace.key = self._key
+        _trace.ctr = 0
+        return self
+
+    def __exit__(self, *exc):
+        _trace.key, _trace.ctr = self._prev
+        return False
+
+
+_default_generator = Generator(seed=np.random.randint(0, 2**31 - 1))
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """Global reseed — analog of paddle.seed."""
+    return _default_generator.manual_seed(s)
+
+
+def next_key():
+    base = getattr(_trace, "key", None)
+    if base is not None:
+        import jax as _jax
+        _trace.ctr = getattr(_trace, "ctr", 0) + 1
+        return _jax.random.fold_in(base, _trace.ctr)
+    return _default_generator.next_key()

@@ -1,0 +1,198 @@
+"""Tensor: the user-facing array type.
+
+TPU-native analog of the reference's eager Tensor (paddle/phi/api/include/tensor.h:82
++ paddle/fluid/pybind/eager_method.cc). A Tensor wraps a jax.Array (or a JAX tracer
+while inside a traced/compiled region) plus autograd metadata: `stop_gradient`,
+`.grad`, and a pointer into the define-by-run grad graph
+(analog of AutogradMeta/GradNodeBase, paddle/fluid/eager/grad_node_info.h:168).
+
+All math is executed by JAX/XLA; on TPU every op is an XLA computation. Methods are
+thin delegators into the functional op library (paddle_tpu.ops) and are installed by
+ops/_method_patch.py at import time (analog of eager_math_op_patch.cc).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+
+
+class Tensor:
+    __slots__ = (
+        "_value", "stop_gradient", "grad", "name", "persistable",
+        "_grad_node", "_out_index", "_retain_grads", "_backward_hooks",
+        "__weakref__",
+    )
+
+    # let Tensor win in  np_array * Tensor  reflected ops
+    __array_priority__ = 100
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.name = name
+        self.persistable = False
+        self._grad_node = None       # GradNode producing this tensor
+        self._out_index = 0          # which output of that node
+        self._retain_grads = False
+        self._backward_hooks = None
+
+    # ---- basic properties ----
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return self._value.dtype.type
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        if devs is None:
+            return "traced"
+        try:
+            return str(next(iter(self._value.devices())))
+        except Exception:
+            return "unknown"
+
+    def numel(self):
+        return self.size
+
+    # ---- conversion ----
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # ---- autograd ----
+    def retain_grads(self):
+        self._retain_grads = True
+        return self
+
+    def register_hook(self, hook):
+        """Register a grad hook: hook(grad_tensor) -> grad_tensor | None."""
+        if self._backward_hooks is None:
+            self._backward_hooks = []
+        self._backward_hooks.append(hook)
+        return hook
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from ..autograd.backward import backward as _backward
+        _backward([self], [grad_tensor] if grad_tensor is not None else None,
+                  retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True, name=self.name)
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops import dispatch
+        return dispatch.apply(jnp.copy, self, op_name="clone")
+
+    # in-place value swap (used by optimizers / load_state_dict)
+    def _set_value(self, new_value):
+        if isinstance(new_value, Tensor):
+            new_value = new_value._value
+        self._value = jnp.asarray(new_value, dtype=self._value.dtype) \
+            if not isinstance(new_value, (jax.Array, jax.core.Tracer)) else new_value
+        return self
+
+    def set_value(self, new_value):
+        return self._set_value(new_value)
+
+    def copy_(self, other):
+        return self._set_value(other)
+
+    def block_until_ready(self):
+        if hasattr(self._value, "block_until_ready"):
+            self._value.block_until_ready()
+        return self
+
+    # pretty-print
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        if isinstance(self._value, jax.core.Tracer):
+            return f"Tensor(traced, shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}{grad_info})"
+        return (f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}"
+                f"{grad_info},\n       {np.array2string(self.numpy(), prefix='       ')})")
+
+
+class Parameter(Tensor):
+    """Trainable tensor — analog of paddle's Parameter/EagerParamBase."""
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed", "_sharding")
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self._sharding = None  # optional jax.sharding annotation (set by parallel layers)
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

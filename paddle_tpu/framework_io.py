@@ -1,0 +1,58 @@
+"""paddle.save / paddle.load analog (python/paddle/framework/io.py:646,889).
+
+Tensors are pickled as numpy arrays; nested dicts/lists (state_dicts, optimizer
+states) round-trip. Safe against device placement: everything is host numpy in
+the file.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Parameter, Tensor
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient,
+                "param": isinstance(obj, Parameter)}
+    if hasattr(obj, "shape") and hasattr(obj, "dtype") and not isinstance(obj, np.ndarray):
+        return {"__tensor__": True, "data": np.asarray(obj), "stop_gradient": True,
+                "param": False}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__") is True:
+            if return_numpy:
+                return obj["data"]
+            t = Parameter(jnp.asarray(obj["data"])) if obj.get("param") \
+                else Tensor(jnp.asarray(obj["data"]), stop_gradient=obj.get("stop_gradient", True))
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

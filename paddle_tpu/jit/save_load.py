@@ -1,0 +1,131 @@
+"""jit.save / jit.load — AOT export of compiled programs.
+
+Analog of the reference's jit.save → program+params → jit::Layer/AnalysisPredictor
+(python/paddle/jit/api.py, paddle/fluid/jit/layer.h:44). The TPU-native form: the
+traced function is serialized as StableHLO via jax.export (the ProgramDesc
+analog), parameters as an .npz; jit.load returns a TranslatedLayer that executes
+the deserialized XLA program — loadable without the original Python model code,
+which is the inference-deployment contract AnalysisPredictor provides.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..autograd.grad_mode import no_grad
+from .api import InputSpec, to_static
+
+
+def _avals_from_spec(spec):
+    """Build export avals; None/-1 dims become symbolic so the loaded program
+    accepts any size there (the dynamic-batch contract of save_inference_model)."""
+    avals = []
+    sym_idx = 0
+    for s in spec:
+        if isinstance(s, InputSpec):
+            from ..core.dtype import convert_dtype
+            dims = []
+            for d in s.shape:
+                if d is None or (isinstance(d, int) and d < 0):
+                    dims.append(f"b{sym_idx}")
+                    sym_idx += 1
+                else:
+                    dims.append(int(d))
+            shape = jax.export.symbolic_shape(
+                "(" + ", ".join(str(d) for d in dims) + ")") if any(
+                isinstance(d, str) for d in dims) else tuple(dims)
+            avals.append(jax.ShapeDtypeStruct(shape, convert_dtype(s.dtype)))
+        elif isinstance(s, Tensor):
+            avals.append(jax.ShapeDtypeStruct(s._value.shape, s._value.dtype))
+        else:
+            a = jnp.asarray(s)
+            avals.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+    return avals
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize layer (or traced function) + params to {path}.pdmodel/.pdiparams."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer")
+    layer.eval()
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes to trace with)")
+    in_avals = _avals_from_spec(input_spec)
+
+    names, tensors = [], []
+    for n, p in layer.named_parameters():
+        names.append(n)
+        tensors.append(p)
+    for n, b in layer.named_buffers():
+        names.append("buffer:" + n)
+        tensors.append(b)
+
+    def pure(params, *inputs):
+        saved = [t._value for t in tensors]
+        try:
+            for t, v in zip(tensors, params):
+                t._value = v
+            with no_grad():
+                out = layer(*[Tensor(i) for i in inputs])
+        finally:
+            for t, v in zip(tensors, saved):
+                t._value = v
+        leaves = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))[0]
+        return tuple(l._value if isinstance(l, Tensor) else jnp.asarray(l)
+                     for l in leaves)
+
+    param_vals = [t._value for t in tensors]
+    param_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in param_vals]
+    exported = jax.export.export(jax.jit(pure))(param_avals, *in_avals)
+    blob = exported.serialize()
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    np.savez(path + ".pdiparams",
+             **{str(i): np.asarray(v) for i, v in enumerate(param_vals)})
+    meta = {
+        "param_names": names,
+        "input_shapes": [[d if isinstance(d, int) else None
+                          for d in (list(a.shape))] for a in in_avals],
+        "input_dtypes": [np.dtype(a.dtype).name for a in in_avals],
+    }
+    with open(path + ".pdmeta", "w") as f:
+        json.dump(meta, f)
+
+
+class TranslatedLayer(Layer):
+    """Runs a deserialized XLA program (analog of jit::Layer / TranslatedLayer)."""
+
+    def __init__(self, exported, param_vals, meta):
+        super().__init__()
+        self._exported = exported
+        self._param_vals = param_vals
+        self._meta = meta
+
+    def forward(self, *inputs):
+        in_vals = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                   for i in inputs]
+        out = self._exported.call(self._param_vals, *in_vals)
+        outs = [Tensor(o) for o in out]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    npz = np.load(path + ".pdiparams.npz" if os.path.exists(path + ".pdiparams.npz")
+                  else path + ".pdiparams")
+    param_vals = [jnp.asarray(npz[str(i)]) for i in range(len(npz.files))]
+    meta = {}
+    if os.path.exists(path + ".pdmeta"):
+        with open(path + ".pdmeta") as f:
+            meta = json.load(f)
+    return TranslatedLayer(exported, param_vals, meta)

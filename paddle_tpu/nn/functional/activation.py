@@ -1,0 +1,151 @@
+"""Activations (analog of python/paddle/nn/functional/activation.py).
+
+All map to jax.nn primitives; XLA fuses them into neighboring matmuls on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply
+
+__all__ = [
+    "relu", "relu6", "relu_", "leaky_relu", "prelu", "elu", "selu", "celu", "gelu",
+    "silu", "swish", "mish", "softplus", "softshrink", "hardshrink", "tanhshrink",
+    "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "log_sigmoid", "maxout",
+    "softmax", "log_softmax", "softsign", "thresholded_relu", "tanh", "glu",
+    "rrelu",
+]
+
+
+def _un(name, fn):
+    def op(x, name_=None):
+        return apply(fn, x, op_name=name)
+    op.__name__ = name
+    return op
+
+
+relu = _un("relu", jax.nn.relu)
+relu_ = relu
+relu6 = _un("relu6", jax.nn.relu6)
+sigmoid = _un("sigmoid", jax.nn.sigmoid)
+log_sigmoid = _un("log_sigmoid", jax.nn.log_sigmoid)
+silu = _un("silu", jax.nn.silu)
+softsign = _un("softsign", jax.nn.soft_sign)
+tanh = _un("tanh", jnp.tanh)
+mish = _un("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), x, op_name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW"):
+    def f(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+    return apply(f, x, weight, op_name="prelu")
+
+
+def elu(x, alpha=1.0):
+    return apply(lambda v: jax.nn.elu(v, alpha), x, op_name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                 x, op_name="selu")
+
+
+def celu(x, alpha=1.0):
+    return apply(lambda v: jax.nn.celu(v, alpha), x, op_name="celu")
+
+
+def gelu(x, approximate=False):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), x, op_name="gelu")
+
+
+def swish(x):
+    return silu(x)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return apply(lambda v: jnp.where(v * beta > threshold, v,
+                                     jax.nn.softplus(v * beta) / beta),
+                 x, op_name="softplus")
+
+
+def softshrink(x, threshold=0.5):
+    return apply(lambda v: jnp.where(v > threshold, v - threshold,
+                                     jnp.where(v < -threshold, v + threshold,
+                                               jnp.zeros_like(v))),
+                 x, op_name="softshrink")
+
+
+def hardshrink(x, threshold=0.5):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, jnp.zeros_like(v)),
+                 x, op_name="hardshrink")
+
+
+def tanhshrink(x):
+    return apply(lambda v: v - jnp.tanh(v), x, op_name="tanhshrink")
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5):
+    return apply(lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), x, op_name="hardsigmoid")
+
+
+def hardswish(x):
+    return apply(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x, op_name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return apply(lambda v: jnp.clip(v, min, max), x, op_name="hardtanh")
+
+
+def thresholded_relu(x, threshold=1.0):
+    return apply(lambda v: jnp.where(v > threshold, v, jnp.zeros_like(v)),
+                 x, op_name="thresholded_relu")
+
+
+def maxout(x, groups, axis=1):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        newshape = v.shape[:ax] + (groups, c // groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(newshape), axis=ax)
+    return apply(f, x, op_name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None):
+    def f(v):
+        vv = v.astype(dtype) if dtype is not None else v
+        return jax.nn.softmax(vv, axis=axis)
+    return apply(f, x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None):
+    def f(v):
+        vv = v.astype(dtype) if dtype is not None else v
+        return jax.nn.log_softmax(vv, axis=axis)
+    return apply(f, x, op_name="log_softmax")
+
+
+def glu(x, axis=-1):
+    return apply(lambda v: jax.nn.glu(v, axis=axis), x, op_name="glu")
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=False, key=None):
+    if training:
+        from ...core import generator as gen
+        k = key if key is not None else gen.next_key()
+
+        def f(v):
+            a = jax.random.uniform(k, v.shape, v.dtype, lower, upper)
+            return jnp.where(v >= 0, v, a * v)
+        return apply(f, x, op_name="rrelu")
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)

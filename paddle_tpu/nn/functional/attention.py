@@ -1,0 +1,83 @@
+"""Attention functionals.
+
+Analog of the reference's flash-attn path (paddle/phi/kernels/gpu/flash_attn_kernel.h,
+python/paddle/nn/functional/flash_attention.py). On TPU the memory-efficient path is
+a Pallas flash-attention kernel (paddle_tpu/ops/pallas/flash_attention.py) selected
+automatically for real TPU devices; the reference implementation below is the
+XLA-fused fallback used on CPU and for parity tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply
+
+__all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_attention_ref"]
+
+
+def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
+    # q,k,v: [B, S, H, D] (paddle flash-attn layout)
+    qT = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
+    kT = jnp.swapaxes(k, 1, 2)
+    vT = jnp.swapaxes(v, 1, 2)
+    s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    # grouped-query support: repeat kv heads if fewer than q heads
+    if kT.shape[1] != qT.shape[1]:
+        rep = qT.shape[1] // kT.shape[1]
+        kT = jnp.repeat(kT, rep, axis=1)
+        vT = jnp.repeat(vT, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * s
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        logits = jnp.where(cmask, logits, jnp.asarray(-1e30, logits.dtype))
+    if mask is not None:
+        logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vT)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+def sdp_attention_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
+    return _sdpa_ref(q, k, v, mask, dropout_p, causal, scale)
+
+
+def _use_pallas(q_val) -> bool:
+    try:
+        dev = list(q_val.devices())[0] if hasattr(q_val, "devices") else None
+    except Exception:
+        dev = None
+    if dev is None:
+        return False
+    return dev.platform in ("tpu",)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None,
+                                 scale=None):
+    """Inputs [batch, seq, heads, head_dim] as in the reference flash-attn API."""
+    def f(q, k, v, *m):
+        mask = m[0] if m else None
+        if _use_pallas(q):
+            try:
+                from ...ops.pallas.flash_attention import flash_attention_fwd
+                if mask is None:
+                    return flash_attention_fwd(q, k, v, causal=is_causal, scale=scale)
+            except Exception:
+                pass
+        return _sdpa_ref(q, k, v, mask, dropout_p, is_causal, scale)
+    if attn_mask is not None:
+        return apply(f, query, key, value, attn_mask, op_name="sdpa")
+    return apply(f, query, key, value, op_name="sdpa")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal)
+    if return_softmax:
+        return out, None
+    return out, None

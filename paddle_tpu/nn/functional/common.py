@@ -1,0 +1,288 @@
+"""Common NN functionals: linear, embedding, dropout, interpolate, etc.
+
+Analog of python/paddle/nn/functional/common.py (linear at :1790) + input.py.
+`linear` is THE hot op: a plain jnp.dot so XLA maps it straight onto the MXU and
+fuses the bias add; under AMP it runs in bfloat16.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import generator as gen
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply
+
+__all__ = [
+    "linear", "embedding", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "unfold", "fold", "interpolate", "upsample", "pixel_shuffle", "pixel_unshuffle",
+    "channel_shuffle", "pad", "cosine_similarity", "label_smooth", "bilinear",
+    "class_center_sample", "zeropad2d",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight layout [in, out] as in the reference
+    (python/paddle/nn/functional/common.py:1790)."""
+    if bias is None:
+        return apply(lambda v, w: jnp.matmul(v, w), x, weight, op_name="linear")
+    return apply(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias, op_name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        return out
+    return apply(f, x, weight, op_name="embedding")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None,
+            key=None):
+    if not training or p == 0.0:
+        return x
+    k = key if key is not None else gen.next_key()
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [a % v.ndim for a in axes] else 1
+                     for i, s in enumerate(v.shape)]
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros_like(v))
+        return jnp.where(keep, v, jnp.zeros_like(v))
+    return apply(f, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", key=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training, key=key)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", key=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training, key=key)
+
+
+def alpha_dropout(x, p=0.5, training=True, key=None):
+    if not training or p == 0.0:
+        return x
+    k = key if key is not None else gen.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(v):
+        keep = jax.random.bernoulli(k, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2))).astype(np.float32)
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, jnp.full_like(v, alpha_p)) + b
+    return apply(f, x, op_name="alpha_dropout")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    from ...ops.manip import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def f(v):
+        n, c, h, w = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=tuple(ks), window_strides=tuple(st),
+            padding=((pd[0], pd[2]), (pd[1], pd[3])),
+            rhs_dilation=tuple(dl), dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # output [N, C*kh*kw, L]
+        return patches.reshape(n, c * ks[0] * ks[1], -1)
+    return apply(f, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        oh = (os_[0] + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (os_[1] + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        vv = v.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                hi = i * dl[0]
+                wi = j * dl[1]
+                out = out.at[:, :, hi:hi + oh * st[0]:st[0],
+                             wi:wi + ow * st[1]:st[1]].add(vv[:, :, i, j])
+        return out[:, :, pd[0]:out.shape[2] - pd[0] if pd[0] else out.shape[2],
+                   pd[1]:out.shape[3] - pd[1] if pd[1] else out.shape[3]]
+    return apply(f, x, op_name="fold")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW"):
+    def f(v):
+        chan_last = data_format in ("NHWC", "NWC", "NDHWC")
+        spatial_nd = v.ndim - 2
+        if chan_last:
+            spatial = v.shape[1:-1]
+        else:
+            spatial = v.shape[2:]
+        if size is not None:
+            out_spatial = [int(s.item() if isinstance(s, Tensor) else s)
+                           for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * spatial_nd
+            out_spatial = [int(d * s) for d, s in zip(spatial, sf)]
+        if chan_last:
+            out_shape = (v.shape[0], *out_spatial, v.shape[-1])
+        else:
+            out_shape = (v.shape[0], v.shape[1], *out_spatial)
+        jmode = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear",
+                 "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if mode == "nearest":
+            return jax.image.resize(v, out_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate with explicit gather
+            return _resize_align_corners(v, out_shape, jmode, chan_last)
+        return jax.image.resize(v, out_shape, method=jmode)
+    return apply(f, x, op_name="interpolate")
+
+
+def _resize_align_corners(v, out_shape, method, chan_last):
+    nd = v.ndim
+    spatial_axes = list(range(1, nd - 1)) if chan_last else list(range(2, nd))
+    out = v
+    for ax in spatial_axes:
+        in_d, out_d = v.shape[ax], out_shape[ax]
+        if in_d == out_d:
+            continue
+        if out_d == 1:
+            idx = jnp.zeros((1,))
+        else:
+            idx = jnp.linspace(0.0, in_d - 1, out_d)
+        i0 = jnp.clip(jnp.floor(idx).astype(jnp.int32), 0, in_d - 1)
+        i1 = jnp.clip(i0 + 1, 0, in_d - 1)
+        w = (idx - i0).astype(v.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = out_d
+        w = w.reshape(shape)
+        a = jnp.take(out, i0, axis=ax)
+        b = jnp.take(out, i1, axis=ax)
+        out = a * (1 - w) + b * w
+        v = out
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners, data_format=data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = int(upscale_factor)
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply(f, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = int(downscale_factor)
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return apply(f, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    g = int(groups)
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return v.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, g, c // g).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply(f, x, op_name="channel_shuffle")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(f, x1, x2, op_name="cosine_similarity")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    def f(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._value if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+    return apply(f, label, op_name="label_smooth")
+
+
+def bilinear(x1, x2, weight, bias=None):
+    def f(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+    if bias is not None:
+        return apply(f, x1, x2, weight, bias, op_name="bilinear")
+    return apply(f, x1, x2, weight, op_name="bilinear")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    # rarely used (face recognition); host-side implementation
+    lab = np.asarray(label._value if isinstance(label, Tensor) else label)
+    pos = np.unique(lab)
+    if pos.size >= num_samples:
+        sampled = pos
+    else:
+        neg = np.setdiff1d(np.arange(num_classes), pos)
+        extra = np.random.choice(neg, num_samples - pos.size, replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    return (Tensor(jnp.asarray(remap[lab])), Tensor(jnp.asarray(sampled)))

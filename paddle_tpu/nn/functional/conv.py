@@ -1,0 +1,132 @@
+"""Convolutions via lax.conv_general_dilated (MXU-mapped by XLA).
+
+Analog of python/paddle/nn/functional/conv.py → Phi conv kernels. API keeps the
+reference's NCHW default; XLA re-layouts internally for TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n, stride=None, dilation=None, ksize=None):
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last, name):
+    spatial = "DHW"[-n:] if n < 3 else "DHW"
+    spatial = {1: "W", 2: "HW", 3: "DHW"}[n]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    dn = (lhs_spec, "OI" + spatial, lhs_spec)
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+
+    def f(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+    if bias is not None:
+        return apply(f, x, weight, bias, op_name=name)
+    return apply(f, x, weight, op_name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format in ("NLC",), "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format == "NHWC", "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format == "NDHWC", "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, channel_last, name):
+    spatial = {1: "W", 2: "HW", 3: "DHW"}[n]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    dn = (lhs_spec, "IO" + spatial, lhs_spec)  # paddle transpose-conv weight: [in, out, *k]
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+
+    def f(v, w, *b):
+        k = w.shape[2:]
+        if isinstance(padding, str) and padding.upper() == "SAME":
+            p = [((dil[i] * (k[i] - 1)) // 2,) * 2 for i in range(n)]
+        elif isinstance(padding, str):  # VALID
+            p = [(0, 0)] * n
+        else:
+            p = _padding(padding, n)
+        # transposed conv == gradient conv: lhs-dilate by stride, flip kernel
+        # spatially, contract over the `in` dim of the [in, out, *k] weight
+        pad = [(dil[i] * (k[i] - 1) - p[i][0],
+                dil[i] * (k[i] - 1) - p[i][1] + opad[i]) for i in range(n)]
+        w_flipped = jax.numpy.flip(w, axis=tuple(range(2, 2 + n)))
+        out = jax.lax.conv_general_dilated(
+            v, w_flipped, window_strides=(1,) * n, padding=pad,
+            lhs_dilation=strides, rhs_dilation=dil,
+            dimension_numbers=(lhs_spec, "IO" + spatial, lhs_spec),
+            feature_group_count=groups)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+    if bias is not None:
+        return apply(f, x, weight, bias, op_name=name)
+    return apply(f, x, weight, op_name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 1, data_format == "NLC", "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None,
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 2, data_format == "NHWC", "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCDHW", output_size=None,
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 3, data_format == "NDHWC", "conv3d_transpose")

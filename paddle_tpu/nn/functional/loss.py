@@ -1,0 +1,284 @@
+"""Loss functionals (analog of python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss", "ctc_loss", "square_error_cost",
+    "sigmoid_focal_loss", "log_loss", "huber_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0):
+    def f(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        n_class = logits.shape[axis]
+        if soft_label:
+            tgt = lab
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            valid = jnp.ones(loss.shape, bool)
+        else:
+            ids = lab
+            if ids.ndim == logp.ndim and ids.shape[axis] == 1:
+                ids = jnp.squeeze(ids, axis)
+            ids = ids.astype(jnp.int32)
+            valid = ids != ignore_index
+            safe_ids = jnp.where(valid, ids, 0)
+            if label_smoothing > 0.0:
+                nl = -jnp.take_along_axis(
+                    logp, jnp.expand_dims(safe_ids, axis), axis=axis).squeeze(axis)
+                sm = -jnp.mean(logp, axis=axis)
+                loss = (1 - label_smoothing) * nl + label_smoothing * sm
+            else:
+                loss = -jnp.take_along_axis(
+                    logp, jnp.expand_dims(safe_ids, axis), axis=axis).squeeze(axis)
+            if w:
+                loss = loss * jnp.take(w[0], safe_ids)
+            loss = jnp.where(valid, loss, jnp.zeros_like(loss))
+        if reduction == "mean":
+            if w and not soft_label:
+                ww = jnp.where(valid, jnp.take(w[0], jnp.where(valid, safe_ids, 0)), 0.0)
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(ww), 1e-12)
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(f, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # reference returns loss w/ trailing dim kept
+    from ...ops.manip import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    def f(p, y, *w):
+        loss = -(y * jnp.log(jnp.maximum(p, 1e-12))
+                 + (1 - y) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(f, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None):
+    def f(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]; i += 1
+        # numerically-stable bce-with-logits
+        max_val = jnp.maximum(-z, 0.0)
+        if pw is not None:
+            log_w = (pw - 1.0) * y + 1.0
+            loss = (1 - y) * z + log_w * (jnp.log(jnp.exp(-max_val)
+                                                  + jnp.exp(-z - max_val)) + max_val)
+        else:
+            loss = (1 - y) * z + max_val + jnp.log(jnp.exp(-max_val)
+                                                   + jnp.exp(-z - max_val))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply(f, *args, op_name="bce_with_logits")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    def f(logp, lab, *w):
+        ids = lab.astype(jnp.int32)
+        valid = ids != ignore_index
+        safe = jnp.where(valid, ids, 0)
+        loss = -jnp.take_along_axis(logp, safe[:, None] if logp.ndim == 2
+                                    else jnp.expand_dims(safe, 1), axis=1)
+        loss = jnp.squeeze(loss, 1)
+        if w:
+            loss = loss * jnp.take(w[0], safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = (jnp.sum(jnp.take(w[0], safe) * valid) if w
+                     else jnp.sum(valid.astype(loss.dtype)))
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(f, *args, op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean"):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label,
+                 op_name="mse_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label, op_name="square_error_cost")
+
+
+def l1_loss(input, label, reduction="mean"):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label,
+                 op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
+        # paddle huber-style: 0.5*d^2 if d<delta else delta*(d-0.5*delta)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply(f, input, label, op_name="smooth_l1_loss")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    return smooth_l1_loss(input, label, reduction, delta)
+
+
+def kl_div(input, label, reduction="mean"):
+    def f(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(f, input, label, op_name="kl_div")
+
+
+def log_loss(input, label, epsilon=1e-4):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return apply(f, input, label, op_name="log_loss")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    return apply(lambda a, b, y: _reduce(jnp.maximum(-y * (a - b) + margin, 0.0),
+                                         reduction),
+                 input, other, label, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    return apply(lambda a, y: _reduce(jnp.where(y == 1, a,
+                                                jnp.maximum(margin - a, 0.0)), reduction),
+                 input, label, op_name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+    return apply(f, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2, eps=1e-6,
+                        swap=False, reduction="mean"):
+    def f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, -1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, -1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, -1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply(f, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    def f(z, y, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if nrm:
+            loss = loss / nrm[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply(f, *args, op_name="sigmoid_focal_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over time).
+
+    log_probs: [T, B, C] (paddle layout), labels: [B, S].
+    """
+    def f(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        # extended label seq: blank interleaved -> length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        ext_len = 2 * lab_len.astype(jnp.int32) + 1
+
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf, lp.dtype)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, lp[0, jnp.arange(B), ext[:, 1]], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf, lp.dtype),
+                                        alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf, lp.dtype),
+                                        alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def masked_step(carry, inp):
+            alpha, t = carry
+            new_alpha, _ = step(alpha, inp)
+            keep = (t < in_len)[:, None]
+            return (jnp.where(keep, new_alpha, alpha), t + 1), None
+
+        (alphaT, _), _ = jax.lax.scan(masked_step, (alpha0, jnp.ones((B,), jnp.int32)),
+                                      lp[1:])
+        idx_last = ext_len - 1
+        idx_prev = jnp.maximum(ext_len - 2, 0)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0],
+            jnp.take_along_axis(alphaT, idx_prev[:, None], axis=1)[:, 0])
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+    return apply(f, log_probs, labels, input_lengths, label_lengths, op_name="ctc_loss")

@@ -1,0 +1,194 @@
+"""Normalization functionals (analog of python/paddle/nn/functional/norm.py).
+
+These are memory-bandwidth-bound on TPU; writing them as straight jnp chains
+lets XLA fuse mean/var/normalize/affine into one pass over HBM.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply
+
+__all__ = ["normalize", "batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "rms_norm"]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    def f(v):
+        n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return apply(f, x, op_name="normalize")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None):
+    use_global = (not training) if use_global_stats is None else use_global_stats
+    ch_axis = 1 if data_format.startswith("NC") else -1
+
+    def stats_axes(v):
+        return tuple(i for i in range(v.ndim) if i != (ch_axis % v.ndim))
+
+    def bshape(v, p):
+        s = [1] * v.ndim
+        s[ch_axis % v.ndim] = p.shape[0]
+        return p.reshape(s)
+
+    if use_global:
+        args = [x, running_mean, running_var]
+        def f(v, m, var_, *wb):
+            inv = jax.lax.rsqrt(var_.astype(v.dtype) + epsilon)
+            out = (v - bshape(v, m.astype(v.dtype))) * bshape(v, inv)
+            if wb:
+                out = out * bshape(v, wb[0])
+                if len(wb) > 1:
+                    out = out + bshape(v, wb[1])
+            return out
+    else:
+        args = [x]
+        def f(v, *wb):
+            axes = stats_axes(v)
+            m = jnp.mean(v, axis=axes)
+            var_ = jnp.var(v, axis=axes)
+            inv = jax.lax.rsqrt(var_ + epsilon)
+            out = (v - bshape(v, m)) * bshape(v, inv)
+            if wb:
+                out = out * bshape(v, wb[0])
+                if len(wb) > 1:
+                    out = out + bshape(v, wb[1])
+            return out
+
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    out = apply(f, *args, op_name="batch_norm")
+
+    if training and running_mean is not None:
+        # update running stats out-of-graph (matches reference eager semantics)
+        v = x._value if isinstance(x, Tensor) else x
+        axes = tuple(i for i in range(v.ndim) if i != (ch_axis % v.ndim))
+        m = jnp.mean(v, axis=axes)
+        var_ = jnp.var(v, axis=axes)
+        running_mean._set_value(momentum * running_mean._value + (1 - momentum) * m)
+        running_var._set_value(momentum * running_var._value + (1 - momentum) * var_)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    ndim_norm = len(list(normalized_shape))
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - ndim_norm, v.ndim))
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var_ = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(var_ + epsilon)
+        if wb:
+            out = out * wb[0]
+            if len(wb) > 1:
+                out = out + wb[1]
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1):
+    """RMSNorm (no mean subtraction) — the LLaMA-family norm; maps to one fused
+    XLA reduction. Analog of paddle.incubate.nn.functional.fused_rms_norm."""
+    def f(v, *w):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=axis, keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    if weight is not None:
+        return apply(f, x, weight, op_name="rms_norm")
+    return apply(f, x, op_name="rms_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW"):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+
+    def f(v, *wb):
+        axes = tuple(range(2, v.ndim)) if ch_axis == 1 else tuple(range(1, v.ndim - 1))
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var_ = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(var_ + eps)
+        if wb:
+            s = [1] * v.ndim
+            s[ch_axis % v.ndim] = wb[0].shape[0]
+            out = out * wb[0].reshape(s)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(s)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    g = int(num_groups)
+
+    def f(v, *wb):
+        if data_format == "NCHW" or data_format.startswith("NC"):
+            n, c = v.shape[0], v.shape[1]
+            rest = v.shape[2:]
+            vv = v.reshape(n, g, c // g, *rest)
+            axes = tuple(range(2, vv.ndim))
+            m = jnp.mean(vv, axis=axes, keepdims=True)
+            var_ = jnp.var(vv, axis=axes, keepdims=True)
+            out = ((vv - m) * jax.lax.rsqrt(var_ + epsilon)).reshape(v.shape)
+            if wb:
+                s = [1, c] + [1] * len(rest)
+                out = out * wb[0].reshape(s)
+                if len(wb) > 1:
+                    out = out + wb[1].reshape(s)
+            return out
+        n, c = v.shape[0], v.shape[-1]
+        rest = v.shape[1:-1]
+        vv = v.reshape(n, *rest, g, c // g)
+        axes = tuple(range(1, vv.ndim - 2)) + (vv.ndim - 1,)
+        m = jnp.mean(vv, axis=axes, keepdims=True)
+        var_ = jnp.var(vv, axis=axes, keepdims=True)
+        out = ((vv - m) * jax.lax.rsqrt(var_ + epsilon)).reshape(v.shape)
+        if wb:
+            s = [1] * (v.ndim - 1) + [c]
+            out = out * wb[0].reshape(s)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(s)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW"):
+    def f(v):
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        # moving sum over channel window
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            sl = [slice(None)] * v.ndim
+            sl[ch_axis] = slice(i, i + v.shape[ch_axis])
+            acc = acc + padded[tuple(sl)]
+        return v / jnp.power(k + alpha * acc / size, beta)
+    return apply(f, x, op_name="local_response_norm")

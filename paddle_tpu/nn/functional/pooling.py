@@ -1,0 +1,149 @@
+"""Pooling functionals via lax.reduce_window (analog of python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.dispatch import apply
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+           "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+           "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "adaptive_max_pool3d"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pad_cfg(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, name,
+          ceil_mode=False, count_include_pad=True, exclusive=None):
+    ks = _tuple(kernel, n)
+    st = _tuple(stride if stride is not None else kernel, n)
+    pad = _pad_cfg(padding, n)
+
+    def f(v):
+        if channel_last:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = ([(0, 0)] + list(pad) + [(0, 0)]) if not isinstance(pad, str) else pad
+        else:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = ([(0, 0), (0, 0)] + list(pad)) if not isinstance(pad, str) else pad
+        if reducer == "max":
+            out = jax.lax.reduce_window(v, -jnp.inf if np.issubdtype(v.dtype, np.floating)
+                                        else np.iinfo(v.dtype).min,
+                                        jax.lax.max, window, strides,
+                                        pads if not isinstance(pads, str) else pads)
+            return out
+        # avg pool
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
+                                       pads if not isinstance(pads, str) else pads)
+        if count_include_pad and not (exclusive is True):
+            denom = np.prod(ks)
+            return summed / denom
+        ones = jnp.ones_like(v)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                       pads if not isinstance(pads, str) else pads)
+        return summed / counts
+    return apply(f, x, op_name=name)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               data_format="NCL"):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC", "avg", 0.0,
+                 "avg_pool1d", ceil_mode, not exclusive, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", "avg", 0.0,
+                 "avg_pool2d", ceil_mode, not exclusive, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", "avg", 0.0,
+                 "avg_pool3d", ceil_mode, not exclusive, exclusive)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL"):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC", "max", None,
+                 "max_pool1d", ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", "max", None,
+                 "max_pool2d", ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", "max", None,
+                 "max_pool3d", ceil_mode)
+
+
+def _adaptive(x, output_size, n, channel_last, mode, name):
+    os_ = _tuple(output_size, n)
+
+    def f(v):
+        spatial_off = 1 if channel_last else 2
+        out = v
+        for d in range(n):
+            ax = spatial_off + d
+            in_d, out_d = out.shape[ax], os_[d]
+            if out_d is None or in_d == out_d:
+                continue
+            # split into out_d regions with start/end as in the reference kernel
+            starts = (np.arange(out_d) * in_d) // out_d
+            ends = ((np.arange(out_d) + 1) * in_d + out_d - 1) // out_d
+            pieces = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                red = jnp.max(seg, axis=ax, keepdims=True) if mode == "max" \
+                    else jnp.mean(seg, axis=ax, keepdims=True)
+                pieces.append(red)
+            out = jnp.concatenate(pieces, axis=ax)
+        return out
+    return apply(f, x, op_name=name)
+
+
+def adaptive_avg_pool1d(x, output_size, data_format="NCL"):
+    return _adaptive(x, output_size, 1, data_format == "NLC", "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive(x, output_size, 2, data_format == "NHWC", "avg", "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive(x, output_size, 3, data_format == "NDHWC", "avg", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, data_format="NCL"):
+    return _adaptive(x, output_size, 1, data_format == "NLC", "max", "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    return _adaptive(x, output_size, 2, data_format == "NHWC", "max", "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, data_format="NCDHW"):
+    return _adaptive(x, output_size, 3, data_format == "NDHWC", "max", "adaptive_max_pool3d")

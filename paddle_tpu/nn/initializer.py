@@ -1,0 +1,189 @@
+"""Weight initializers (analog of python/paddle/nn/initializer/)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import generator as gen
+from ..core.tensor import Tensor
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out, in, *k] (paddle layout)
+    rf = int(np.prod(shape[2:]))
+    return shape[1] * rf, shape[0] * rf
+
+
+class Initializer:
+    def __call__(self, param: Tensor):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, param):
+        param._set_value(jnp.full_like(param._value, self.value))
+        return param
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, param):
+        v = self.value._value if isinstance(self.value, Tensor) else jnp.asarray(self.value)
+        param._set_value(v.astype(param._value.dtype).reshape(param._value.shape))
+        return param
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, param):
+        k = gen.next_key()
+        param._set_value(jax.random.uniform(
+            k, param._value.shape, param._value.dtype, self.low, self.high))
+        return param
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param):
+        k = gen.next_key()
+        v = jax.random.normal(k, param._value.shape, param._value.dtype)
+        param._set_value(self.mean + self.std * v)
+        return param
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param):
+        k = gen.next_key()
+        v = jax.random.truncated_normal(k, -2.0, 2.0, param._value.shape,
+                                        param._value.dtype)
+        param._set_value(self.mean + self.std * v)
+        return param
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param):
+        fi, fo = _fan_in_out(param._value.shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = gen.next_key()
+        param._set_value(jax.random.uniform(
+            k, param._value.shape, param._value.dtype, -limit, limit))
+        return param
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param):
+        fi, fo = _fan_in_out(param._value.shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = gen.next_key()
+        param._set_value(std * jax.random.normal(k, param._value.shape,
+                                                 param._value.dtype))
+        return param
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, param):
+        fi, _ = _fan_in_out(param._value.shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        k = gen.next_key()
+        param._set_value(jax.random.uniform(
+            k, param._value.shape, param._value.dtype, -limit, limit))
+        return param
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, param):
+        fi, _ = _fan_in_out(param._value.shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fi)
+        k = gen.next_key()
+        param._set_value(std * jax.random.normal(k, param._value.shape,
+                                                 param._value.dtype))
+        return param
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, param):
+        shape = param._value.shape
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        k = gen.next_key()
+        a = jax.random.normal(k, (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        param._set_value((self.gain * q[:rows, :cols]).reshape(shape)
+                         .astype(param._value.dtype))
+        return param
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, param):
+        shape = param._value.shape
+        out_c, in_c = shape[0], shape[1]
+        v = np.zeros(shape, np.float32)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(out_c // self.groups, in_c)):
+                idx = (g * (out_c // self.groups) + i, i) + tuple(centers)
+                v[idx] = 1.0
+        param._set_value(jnp.asarray(v, param._value.dtype))
+        return param
+
+
+# paddle-style ParamAttr carrier
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

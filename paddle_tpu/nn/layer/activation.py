@@ -1,0 +1,75 @@
+"""Activation layers (analog of python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from ...core.tensor import Parameter
+from .. import functional as F
+from ..initializer import Constant
+from .layers import Layer
+
+
+def _mk(name, fn_name, **defaults):
+    def __init__(self, name=None, **kw):
+        Layer.__init__(self)
+        self._kw = {**defaults, **{k: v for k, v in kw.items() if k in defaults}}
+
+    def forward(self, x):
+        return getattr(F, fn_name)(x, **self._kw)
+
+    cls = type(name, (Layer,), {"__init__": __init__, "forward": forward})
+    return cls
+
+
+ReLU = _mk("ReLU", "relu")
+ReLU6 = _mk("ReLU6", "relu6")
+Sigmoid = _mk("Sigmoid", "sigmoid")
+LogSigmoid = _mk("LogSigmoid", "log_sigmoid")
+Tanh = _mk("Tanh", "tanh")
+Tanhshrink = _mk("Tanhshrink", "tanhshrink")
+Silu = _mk("Silu", "silu")
+Swish = _mk("Swish", "swish")
+Mish = _mk("Mish", "mish")
+GELU = _mk("GELU", "gelu", approximate=False)
+ELU = _mk("ELU", "elu", alpha=1.0)
+SELU = _mk("SELU", "selu")
+CELU = _mk("CELU", "celu", alpha=1.0)
+LeakyReLU = _mk("LeakyReLU", "leaky_relu", negative_slope=0.01)
+Hardsigmoid = _mk("Hardsigmoid", "hardsigmoid")
+Hardswish = _mk("Hardswish", "hardswish")
+Hardtanh = _mk("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Hardshrink = _mk("Hardshrink", "hardshrink", threshold=0.5)
+Softshrink = _mk("Softshrink", "softshrink", threshold=0.5)
+Softplus = _mk("Softplus", "softplus", beta=1.0, threshold=20.0)
+Softsign = _mk("Softsign", "softsign")
+ThresholdedReLU = _mk("ThresholdedReLU", "thresholded_relu", threshold=1.0)
+LogSoftmax = _mk("LogSoftmax", "log_softmax", axis=-1)
+Maxout = _mk("Maxout", "maxout", groups=2, axis=1)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter([num_parameters], attr=weight_attr)
+        Constant(init)(self.weight)
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

@@ -1,0 +1,208 @@
+"""Recurrent layers via lax.scan (analog of python/paddle/nn/layer/rnn.py).
+
+lax.scan keeps the time loop inside one XLA program (static trip count), so the
+per-step matmuls batch onto the MXU without host round-trips — the TPU replacement
+for the reference's cuDNN RNN kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply
+from ..initializer import Uniform
+from .layers import Layer
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        self._weights = []
+        std = 1.0 / math.sqrt(hidden_size)
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer == 0 else hidden_size * self.bidirect
+                sfx = f"l{layer}" + ("_reverse" if d else "")
+                w_ih = self.create_parameter([gate_mult * hidden_size, in_sz])
+                w_hh = self.create_parameter([gate_mult * hidden_size, hidden_size])
+                b_ih = self.create_parameter([gate_mult * hidden_size], is_bias=True)
+                b_hh = self.create_parameter([gate_mult * hidden_size], is_bias=True)
+                for p in (w_ih, w_hh, b_ih, b_hh):
+                    Uniform(-std, std)(p)
+                self.add_parameter(f"weight_ih_{sfx}", w_ih)
+                self.add_parameter(f"weight_hh_{sfx}", w_hh)
+                self.add_parameter(f"bias_ih_{sfx}", b_ih)
+                self.add_parameter(f"bias_hh_{sfx}", b_hh)
+                self._weights.append((f"weight_ih_{sfx}", f"weight_hh_{sfx}",
+                                      f"bias_ih_{sfx}", f"bias_hh_{sfx}"))
+
+    def _cell(self, mode):
+        H = self.hidden_size
+        if mode == "LSTM":
+            def step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+                h, c = carry
+                gates = x_t @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c2 = f * c + i * g
+                h2 = o * jnp.tanh(c2)
+                return (h2, c2), h2
+        elif mode == "GRU":
+            def step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+                h = carry[0]
+                gi = x_t @ w_ih.T + b_ih
+                gh = h @ w_hh.T + b_hh
+                i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+                h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(i_r + h_r)
+                z = jax.nn.sigmoid(i_z + h_z)
+                n = jnp.tanh(i_n + r * h_n)
+                h2 = (1 - z) * n + z * h
+                return (h2,), h2
+        else:
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+            def step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+                h = carry[0]
+                h2 = act(x_t @ w_ih.T + h @ w_hh.T + b_ih + b_hh)
+                return (h2,), h2
+        return step
+
+    def forward(self, inputs, initial_states=None):
+        step = self._cell(self.mode)
+        n_state = 2 if self.mode == "LSTM" else 1
+
+        arg_names = [n for grp in self._weights for n in grp]
+        weights = [getattr(self, n) for n in arg_names]
+
+        def f(x, *ws):
+            xs = x if self.time_major else jnp.swapaxes(x, 0, 1)  # [T,B,I]
+            B = xs.shape[1]
+            out = xs
+            final_h, final_c = [], []
+            wi = 0
+            for layer in range(self.num_layers):
+                dir_outs = []
+                for d in range(self.bidirect):
+                    w_ih, w_hh, b_ih, b_hh = ws[wi:wi + 4]
+                    wi += 4
+                    seq = out if d == 0 else jnp.flip(out, 0)
+                    h0 = jnp.zeros((B, self.hidden_size), xs.dtype)
+                    carry0 = (h0, jnp.zeros_like(h0)) if n_state == 2 else (h0,)
+
+                    def scan_step(carry, x_t, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                        return step(carry, x_t, w_ih, w_hh, b_ih, b_hh)
+                    carry, ys = jax.lax.scan(scan_step, carry0, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    final_h.append(carry[0])
+                    if n_state == 2:
+                        final_c.append(carry[1])
+                out = jnp.concatenate(dir_outs, -1) if self.bidirect == 2 else dir_outs[0]
+            ys_out = out if self.time_major else jnp.swapaxes(out, 0, 1)
+            h_stack = jnp.stack(final_h, 0)
+            if n_state == 2:
+                return ys_out, h_stack, jnp.stack(final_c, 0)
+            return ys_out, h_stack
+
+        out = apply(f, inputs, *weights, op_name=self.mode.lower())
+        if n_state == 2:
+            return out[0], (out[1], out[2])
+        return out[0], out[1]
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size])
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size])
+        self.bias_ih = self.create_parameter([4 * hidden_size], is_bias=True)
+        self.bias_hh = self.create_parameter([4 * hidden_size], is_bias=True)
+        for p in (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh):
+            Uniform(-std, std)(p)
+
+    def forward(self, inputs, states=None):
+        def f(x, h, c, w_ih, w_hh, b_ih, b_hh):
+            gates = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i, fg, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fg), jax.nn.sigmoid(o)
+            c2 = fg * c + i * jnp.tanh(g)
+            h2 = o * jnp.tanh(c2)
+            return h2, c2
+        if states is None:
+            import paddle_tpu as P
+            B = inputs.shape[0]
+            states = (P.zeros([B, self.hidden_size], inputs.dtype),
+                      P.zeros([B, self.hidden_size], inputs.dtype))
+        out = apply(f, inputs, states[0], states[1], self.weight_ih, self.weight_hh,
+                    self.bias_ih, self.bias_hh, op_name="lstm_cell")
+        return out[0], (out[0], out[1])
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size])
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size])
+        self.bias_ih = self.create_parameter([3 * hidden_size], is_bias=True)
+        self.bias_hh = self.create_parameter([3 * hidden_size], is_bias=True)
+        for p in (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh):
+            Uniform(-std, std)(p)
+
+    def forward(self, inputs, states=None):
+        def f(x, h, w_ih, w_hh, b_ih, b_hh):
+            gi = x @ w_ih.T + b_ih
+            gh = h @ w_hh.T + b_hh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1 - z) * n + z * h
+        if states is None:
+            import paddle_tpu as P
+            states = P.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+        out = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                    self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return out, out

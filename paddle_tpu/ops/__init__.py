@@ -1,0 +1,10 @@
+"""Functional op library (the Phi-kernel-library analog, paddle/phi/kernels/)."""
+from . import dispatch  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manip import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401  (namespaced under paddle_tpu.linalg too)
+from .random import *  # noqa: F401,F403
+from . import _method_patch  # noqa: F401  (installs Tensor methods)
+
+from . import creation, linalg, manip, math, random  # noqa: F401

@@ -1,0 +1,152 @@
+"""Install Tensor methods & operator overloads.
+
+Analog of the reference's C++ math-op patch + method table
+(paddle/fluid/pybind/eager_math_op_patch.cc, eager_method.cc): every method is a
+thin delegator into the functional op library so eager and traced paths share
+one implementation.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import creation, linalg, manip, math
+from .dispatch import apply
+
+
+def _coerce(other, ref):
+    if isinstance(other, Tensor):
+        return other
+    return other  # scalars / arrays handled by jnp broadcasting
+
+
+def _install():
+    T = Tensor
+
+    # ---- arithmetic operators ----
+    T.__add__ = lambda s, o: math.add(s, _coerce(o, s))
+    T.__radd__ = lambda s, o: math.add(s, o)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: apply(lambda v: o - v, s, op_name="rsub")
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(s, o)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: apply(lambda v: o / v, s, op_name="rdiv")
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: apply(lambda v: o // v, s, op_name="rfloordiv")
+    T.__mod__ = lambda s, o: math.mod(s, o)
+    T.__rmod__ = lambda s, o: apply(lambda v: o % v, s, op_name="rmod")
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: apply(lambda v: o ** v, s, op_name="rpow")
+    T.__neg__ = lambda s: math.neg(s)
+    T.__pos__ = lambda s: s
+    T.__abs__ = lambda s: math.abs(s)
+    T.__matmul__ = lambda s, o: math.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: apply(lambda v: jnp.matmul(
+        o._value if isinstance(o, Tensor) else o, v), s, op_name="rmatmul")
+    T.__invert__ = lambda s: math.bitwise_not(s)
+    T.__and__ = lambda s, o: math.bitwise_and(s, o)
+    T.__or__ = lambda s, o: math.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: math.bitwise_xor(s, o)
+
+    # comparisons
+    T.__eq__ = lambda s, o: math.equal(s, o)
+    T.__ne__ = lambda s, o: math.not_equal(s, o)
+    T.__lt__ = lambda s, o: math.less_than(s, o)
+    T.__le__ = lambda s, o: math.less_equal(s, o)
+    T.__gt__ = lambda s, o: math.greater_than(s, o)
+    T.__ge__ = lambda s, o: math.greater_equal(s, o)
+
+    # ---- indexing ----
+    def _getitem(s, idx):
+        idx2 = _prep_index(idx)
+        return apply(lambda v: v[idx2], s, op_name="getitem")
+
+    def _setitem(s, idx, value):
+        idx2 = _prep_index(idx)
+        val = value._value if isinstance(value, Tensor) else value
+        new = s._value.at[idx2].set(val)
+        s._set_value(new)
+        return s
+
+    def _prep_index(idx):
+        def conv(i):
+            if isinstance(i, Tensor):
+                v = i._value
+                return v.astype(bool) if v.dtype == jnp.bool_ else v
+            return i
+        if isinstance(idx, tuple):
+            return tuple(conv(i) for i in idx)
+        return conv(idx)
+
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    # ---- named methods: bulk-install from op modules ----
+    method_sources = [math, manip, creation, linalg]
+    skip = {"to_tensor", "as_tensor", "arange", "linspace", "logspace", "eye",
+            "meshgrid", "zeros", "ones", "full", "empty", "tril_indices",
+            "triu_indices", "scatter_nd", "complex"}
+    for mod in method_sources:
+        for name in getattr(mod, "__all__", []):
+            if name in skip or hasattr(T, name):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn):
+                setattr(T, name, fn)
+
+    # in-place variants used pervasively by optimizers/training code
+    def _make_inplace(op):
+        def ip(s, *a, **k):
+            out = op(s, *a, **k)
+            s._set_value(out._value)
+            return s
+        return ip
+
+    for base in ["add", "subtract", "multiply", "divide", "clip", "scale", "floor",
+                 "ceil", "exp", "sqrt", "rsqrt", "reciprocal", "round", "tanh",
+                 "cast"]:
+        setattr(T, base + "_", _make_inplace(getattr(math, base)))
+    T.zero_ = lambda s: s._set_value(jnp.zeros_like(s._value)) or s
+    T.fill_ = lambda s, v: s._set_value(jnp.full_like(s._value, v)) or s
+
+    def _zero(s):
+        s._set_value(jnp.zeros_like(s._value))
+        return s
+
+    def _fill(s, v):
+        s._set_value(jnp.full_like(s._value, v))
+        return s
+    T.zero_ = _zero
+    T.fill_ = _fill
+
+    # misc names paddle exposes on Tensor
+    T.dim = lambda s: s.ndim
+    T.rank = lambda s: s.ndim
+    T.astype = lambda s, d: math.cast(s, d)
+    T.cast = lambda s, d: math.cast(s, d)
+    T.scale = lambda s, *a, **k: math.scale(s, *a, **k)
+    T.mean = lambda s, *a, **k: math.mean(s, *a, **k)
+    T.cuda = lambda s, *a, **k: s
+    T.cpu = lambda s: s
+    T.pin_memory = lambda s: s
+    T.contiguous = lambda s: s
+    T.is_contiguous = lambda s: True
+    T.to_dense = lambda s: s
+    T.element_size = lambda s: np.dtype(s.dtype).itemsize
+
+    def _to(s, *args, **kwargs):
+        out = s
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and (":" in a or a in ("cpu", "tpu", "gpu")):
+                continue  # single logical device space under jax
+            try:
+                out = math.cast(out, a)
+            except TypeError:
+                pass
+        return out
+    T.to = _to
+
+
+_install()

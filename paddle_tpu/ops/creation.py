@@ -1,0 +1,185 @@
+"""Tensor creation ops (analog of python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .dispatch import apply
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace", "logspace",
+    "eye", "zeros_like", "ones_like", "full_like", "empty_like", "tril", "triu",
+    "diag", "diagflat", "meshgrid", "assign", "clone", "tril_indices", "triu_indices",
+    "complex", "as_tensor",
+]
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _resolve_dtype(dtype, data=None):
+    if dtype is not None:
+        return dtypes.convert_dtype(dtype)
+    return None
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    dtype = _resolve_dtype(dtype)
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None and v.dtype.type != dtype:
+            v = v.astype(dtype)
+        return Tensor(v, stop_gradient=stop_gradient)
+    if isinstance(data, (jax.Array, jax.core.Tracer)):
+        v = data if dtype is None else data.astype(dtype)
+        return Tensor(v, stop_gradient=stop_gradient)
+    arr = np.asarray(data)
+    if dtype is None:
+        # paddle defaults: python floats -> default float dtype, ints -> int64
+        if arr.dtype == np.float64 and not isinstance(data, np.ndarray):
+            arr = arr.astype(dtypes.get_default_dtype())
+        elif arr.dtype in (np.int32,) and not isinstance(data, np.ndarray):
+            arr = arr.astype(np.int64)
+    else:
+        arr = arr.astype(dtype)
+    return Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+
+
+as_tensor = to_tensor
+
+
+def zeros(shape, dtype=None):
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+    return Tensor(jnp.zeros(_norm_shape(shape), dt))
+
+
+def ones(shape, dtype=None):
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+    return Tensor(jnp.ones(_norm_shape(shape), dt))
+
+
+def full(shape, fill_value, dtype=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    dt = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    return Tensor(jnp.full(_norm_shape(shape), fill_value, dt))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (np.int64 if all(isinstance(x, (int, np.integer)) for x in (start, end, step))
+                 else dtypes.get_default_dtype())
+    else:
+        dtype = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    dt = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=dt))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    dt = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base), dtype=dt))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    dt = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=dt))
+
+
+def zeros_like(x, dtype=None):
+    dt = dtypes.convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.zeros_like(x._value if isinstance(x, Tensor) else x, dtype=dt))
+
+
+def ones_like(x, dtype=None):
+    dt = dtypes.convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.ones_like(x._value if isinstance(x, Tensor) else x, dtype=dt))
+
+
+def full_like(x, fill_value, dtype=None):
+    dt = dtypes.convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.full_like(x._value if isinstance(x, Tensor) else x, fill_value, dtype=dt))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def tril(x, diagonal=0):
+    return apply(jnp.tril, x, k=int(diagonal), op_name="tril")
+
+
+def triu(x, diagonal=0):
+    return apply(jnp.triu, x, k=int(diagonal), op_name="triu")
+
+
+def diag(x, offset=0, padding_value=0):
+    def _diag(v):
+        d = jnp.diag(v, k=int(offset))
+        if v.ndim == 1 and padding_value != 0:
+            mask = jnp.diag(jnp.ones(v.shape[0], bool), k=int(offset))
+            d = jnp.where(mask, d, jnp.asarray(padding_value, v.dtype))
+        return d
+    return apply(_diag, x, op_name="diag")
+
+
+def diagflat(x, offset=0):
+    return apply(lambda v: jnp.diagflat(v, k=int(offset)), x, op_name="diagflat")
+
+
+def meshgrid(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    outs = jnp.meshgrid(*vals, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output._set_value(v)
+        return output
+    return apply(jnp.copy, x if isinstance(x, Tensor) else Tensor(v), op_name="assign")
+
+
+def clone(x):
+    return apply(jnp.copy, x, op_name="clone")
+
+
+def tril_indices(row, col=None, offset=0):
+    col = row if col is None else col
+    r, c = np.tril_indices(int(row), int(offset), int(col))
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def triu_indices(row, col=None, offset=0):
+    col = row if col is None else col
+    r, c = np.triu_indices(int(row), int(offset), int(col))
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def complex(real, imag):
+    return apply(jax.lax.complex, real, imag, op_name="complex")

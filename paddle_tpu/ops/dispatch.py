@@ -1,0 +1,136 @@
+"""Op dispatch + autograd tape recording.
+
+TPU-native replacement for the reference's generated `<op>_ad_func` layer
+(paddle/fluid/eager/auto_code_generator/, dygraph_functions.cc) and kernel dispatch
+(paddle/phi/core/kernel_factory.cc:218). Instead of a (name, backend, dtype)-keyed
+kernel registry dispatching hand-written CUDA kernels, every op IS a jax function:
+on TPU it lowers through XLA (and is fused by the compiler); under `jax.jit` tracing
+the same Python path emits into the traced program, which is how the to_static
+compile path reuses the whole op library unchanged.
+
+Autograd: when grad recording is on and any differentiable input requires grad, we
+run the op under `jax.vjp` and record a GradNode holding the vjp closure — the
+define-by-run tape (analog of GradNodeBase + TensorWrapper,
+paddle/fluid/eager/grad_node_info.h:168).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.grad_mode import is_grad_enabled
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+
+__all__ = ["apply", "GradNode", "defprim"]
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    Holds the vjp closure over the op's differentiable inputs plus weak structure
+    info needed to seed missing cotangents with zeros.
+    """
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "multi_output", "op_name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs: Sequence[Tensor], out_avals, multi_output, op_name):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)     # strong refs: keeps producer subgraph alive
+        self.out_avals = out_avals     # [(shape, dtype), ...]
+        self.multi_output = multi_output
+        self.op_name = op_name
+
+    def __repr__(self):
+        return f"<GradNode {self.op_name}>"
+
+
+def _unwrap(a):
+    return a._value if isinstance(a, Tensor) else a
+
+
+def _is_diff_tensor(a) -> bool:
+    return (isinstance(a, Tensor) and not a.stop_gradient
+            and dtypes.is_differentiable(a.dtype))
+
+
+def _wrap_outputs(raw, op_name):
+    if isinstance(raw, (tuple, list)):
+        return type(raw)(Tensor(r) if isinstance(r, (jax.Array, jax.core.Tracer)) else r
+                         for r in raw), True
+    return Tensor(raw), False
+
+
+_amp_dtype_for = None
+
+
+def _get_amp_hook():
+    global _amp_dtype_for
+    if _amp_dtype_for is None:
+        from ..amp.auto_cast import amp_dtype_for
+        _amp_dtype_for = amp_dtype_for
+    return _amp_dtype_for
+
+
+def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
+    """Execute `jax_fn(*arrays, **static_kwargs)` over Tensor args with tape recording.
+
+    - Tensor args are unwrapped to their jax values.
+    - Non-Tensor args pass through (treated as constants / static config).
+    - Differentiation happens only w.r.t. inputs that are floating/complex Tensors
+      with stop_gradient=False, matching the reference's semantics.
+    - Under amp.auto_cast, inputs of allow-listed ops are cast to the AMP dtype
+      before execution (the eager_amp_auto_cast.h analog).
+    """
+    name = op_name or getattr(jax_fn, "__name__", "op")
+    vals = [_unwrap(a) for a in args]
+
+    amp_dt = _get_amp_hook()(name)
+    if amp_dt is not None:
+        import numpy as _np
+        for i, v in enumerate(vals):
+            if hasattr(v, "dtype") and _np.issubdtype(_np.dtype(v.dtype), _np.floating) \
+                    and v.dtype != amp_dt:
+                vals[i] = v.astype(amp_dt)
+    diff_idx = [i for i, a in enumerate(args) if _is_diff_tensor(a)]
+
+    if not diff_idx or not is_grad_enabled():
+        raw = jax_fn(*vals, **static_kwargs)
+        out, multi = _wrap_outputs(raw, name)
+        return out
+
+    diff_vals = [vals[i] for i in diff_idx]
+
+    def f(*dv):
+        vv = list(vals)
+        for k, i in enumerate(diff_idx):
+            vv[i] = dv[k]
+        return jax_fn(*vv, **static_kwargs)
+
+    raw, vjp_fn = jax.vjp(f, *diff_vals)
+    out, multi = _wrap_outputs(raw, name)
+
+    outs_list = list(out) if multi else [out]
+    out_avals = [
+        (o._value.shape, o._value.dtype) if isinstance(o, Tensor) else None
+        for o in outs_list
+    ]
+    node = GradNode(vjp_fn, [args[i] for i in diff_idx], out_avals, multi, name)
+    for i, o in enumerate(outs_list):
+        if isinstance(o, Tensor):
+            o._grad_node = node
+            o._out_index = i
+            o.stop_gradient = False
+    return out
+
+
+def defprim(jax_fn: Callable, op_name: str | None = None):
+    """Lift a jax-level function into a Tensor-level op."""
+    name = op_name or getattr(jax_fn, "__name__", "op")
+
+    def op(*args, **kwargs):
+        return apply(jax_fn, *args, op_name=name, **kwargs)
+
+    op.__name__ = name
+    return op

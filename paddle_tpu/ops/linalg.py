@@ -1,0 +1,183 @@
+"""Linear algebra ops (analog of python/paddle/tensor/linalg.py → paddle.linalg)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import apply
+
+__all__ = [
+    "norm", "cond", "matrix_power", "det", "slogdet", "inv", "pinv", "solve",
+    "triangular_solve", "cholesky", "cholesky_solve", "qr", "svd", "eig", "eigh",
+    "eigvals", "eigvalsh", "lu", "matrix_rank", "multi_dot", "lstsq", "corrcoef",
+    "cov", "householder_product", "pca_lowrank",
+]
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    def f(v):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(v)))
+        if axis is None:
+            return jnp.linalg.norm(v.reshape(-1), ord=None if p == "fro" else p)
+        if isinstance(axis, (list, tuple)):
+            return jnp.linalg.norm(v, ord=p if p != "fro" else "fro",
+                                   axis=tuple(axis), keepdims=keepdim)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=axis, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=axis, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+    return apply(f, x, op_name="norm")
+
+
+def cond(x, p=None):
+    return apply(lambda v: jnp.linalg.cond(v, p=p), x, op_name="cond")
+
+
+def matrix_power(x, n):
+    return apply(lambda v: jnp.linalg.matrix_power(v, int(n)), x, op_name="matrix_power")
+
+
+def det(x):
+    return apply(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x):
+    def f(v):
+        s, l = jnp.linalg.slogdet(v)
+        return jnp.stack([s, l]) if v.ndim == 2 else jnp.stack([s, l])
+    return apply(f, x, op_name="slogdet")
+
+
+def inv(x):
+    return apply(jnp.linalg.inv, x, op_name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian),
+                 x, op_name="pinv")
+
+
+def solve(x, y):
+    return apply(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    def f(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose else a
+        return jax.scipy.linalg.solve_triangular(
+            aa, b, lower=not upper if not transpose else upper,
+            unit_diagonal=unitriangular)
+    return apply(f, x, y, op_name="triangular_solve")
+
+
+def cholesky(x, upper=False):
+    def f(v):
+        c = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(c, -1, -2).conj() if upper else c
+    return apply(f, x, op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False):
+    def f(b, c):
+        return jax.scipy.linalg.cho_solve((c, not upper), b)
+    return apply(f, x, y, op_name="cholesky_solve")
+
+
+def qr(x, mode="reduced"):
+    out = apply(lambda v: jnp.linalg.qr(v, mode=mode), x, op_name="qr")
+    return (out[0], out[1]) if isinstance(out, (tuple, list)) else out
+
+
+def svd(x, full_matrices=False):
+    out = apply(lambda v: jnp.linalg.svd(v, full_matrices=full_matrices), x, op_name="svd")
+    return out[0], out[1], out[2]
+
+
+def eig(x):
+    # CPU-only in jax; evaluate on host
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigh(x, UPLO="L"):
+    out = apply(lambda v: jnp.linalg.eigh(v, UPLO=UPLO), x, op_name="eigh")
+    return out[0], out[1]
+
+
+def eigvals(x):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def eigvalsh(x, UPLO="L"):
+    return apply(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x, op_name="eigvalsh")
+
+
+def lu(x, pivot=True):
+    def f(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle uses 1-based pivots
+    out = apply(f, x, op_name="lu")
+    return out[0], out[1]
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return apply(lambda v: jnp.linalg.matrix_rank(v, rtol=tol), x, op_name="matrix_rank")
+
+
+def multi_dot(tensors):
+    return apply(lambda *vs: jnp.linalg.multi_dot(list(vs)), *tensors, op_name="multi_dot")
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    out = apply(f, x, y, op_name="lstsq")
+    return out[0], out[1], out[2], out[3]
+
+
+def corrcoef(x, rowvar=True):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), x, op_name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return apply(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0),
+                 x, op_name="cov")
+
+
+def householder_product(x, tau):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) > i, a[..., i], 0.0)
+            v = v.at[..., i].set(1.0) if v.ndim == 1 else v
+            v = jnp.where(jnp.arange(m) == i, 1.0, v)
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
+            return q @ h
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :, :n]
+    return apply(f, x, tau, op_name="householder_product")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    def f(v):
+        qq = q or min(6, *v.shape[-2:])
+        vv = v - v.mean(axis=-2, keepdims=True) if center else v
+        u, s, vt = jnp.linalg.svd(vv, full_matrices=False)
+        return u[..., :qq], s[..., :qq], jnp.swapaxes(vt, -1, -2)[..., :qq]
+    out = apply(f, x, op_name="pca_lowrank")
+    return out[0], out[1], out[2]

@@ -1,0 +1,527 @@
+"""Shape / layout / indexing manipulation ops.
+
+Analog of python/paddle/tensor/manipulation.py + search.py over Phi kernels.
+All static-shape friendly: sizes are Python ints at trace time so XLA gets
+fully static programs (required for clean MXU tiling on TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .dispatch import apply
+
+__all__ = []
+
+
+def _export(fn, name=None):
+    name = name or fn.__name__
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def _u(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _static_ints(x):
+    if isinstance(x, Tensor):
+        x = x.tolist()
+    if isinstance(x, (int, np.integer)):
+        return int(x)
+    return [int(v.item() if isinstance(v, Tensor) else v) for v in x]
+
+
+@_export
+def reshape(x, shape):
+    shape = _static_ints(shape)
+    return apply(lambda v: jnp.reshape(v, shape), x, op_name="reshape")
+
+
+@_export
+def reshape_(x, shape):
+    out = reshape(x, shape)
+    x._set_value(out._value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+@_export
+def flatten(x, start_axis=0, stop_axis=-1):
+    def f(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        newshape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, newshape)
+    return apply(f, x, op_name="flatten")
+
+
+@_export
+def transpose(x, perm):
+    perm = _static_ints(perm)
+    return apply(lambda v: jnp.transpose(v, perm), x, op_name="transpose")
+
+
+@_export
+def moveaxis(x, source, destination):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), x, op_name="moveaxis")
+
+
+@_export
+def swapaxes(x, axis1, axis2):
+    return apply(lambda v: jnp.swapaxes(v, axis1, axis2), x, op_name="swapaxes")
+
+
+@_export
+def squeeze(x, axis=None):
+    def f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axes) if axes else v
+    return apply(f, x, op_name="squeeze")
+
+
+@_export
+def unsqueeze(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = _static_ints(axes)
+    def f(v):
+        out = v
+        for a in sorted(axes):
+            out = jnp.expand_dims(out, a)
+        return out
+    return apply(f, x, op_name="unsqueeze")
+
+
+@_export
+def concat(x, axis=0):
+    tensors = list(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *vs: jnp.concatenate(vs, axis=ax), *tensors, op_name="concat")
+
+
+@_export
+def stack(x, axis=0):
+    tensors = list(x)
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *tensors, op_name="stack")
+
+
+@_export
+def split(x, num_or_sections, axis=0):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = (x.shape[ax] if isinstance(x, Tensor) else x.shape[ax])
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = list(_static_ints(num_or_sections))
+        n_unknown = [i for i, s in enumerate(sizes) if s in (-1,)]
+        if n_unknown:
+            known = sum(s for s in sizes if s != -1)
+            sizes[n_unknown[0]] = dim - known
+    offsets = np.cumsum([0] + sizes[:-1])
+
+    def f(v):
+        return tuple(jax.lax.slice_in_dim(v, int(o), int(o + s), axis=ax)
+                     for o, s in zip(offsets, sizes))
+    out = apply(f, x, op_name="split")
+    return list(out)
+
+
+@_export
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+@_export
+def unbind(x, axis=0):
+    n = x.shape[axis]
+    def f(v):
+        return tuple(jnp.squeeze(s, axis) for s in jnp.split(v, n, axis))
+    return list(apply(f, x, op_name="unbind"))
+
+
+@_export
+def tile(x, repeat_times):
+    reps = _static_ints(repeat_times)
+    return apply(lambda v: jnp.tile(v, reps), x, op_name="tile")
+
+
+@_export
+def expand(x, shape):
+    shape = _static_ints(shape)
+    def f(v):
+        tgt = list(shape)
+        # -1 means keep source dim
+        off = len(tgt) - v.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tgt)
+    return apply(f, x, op_name="expand")
+
+
+@_export
+def broadcast_to(x, shape):
+    return expand(x, shape)
+
+
+@_export
+def expand_as(x, y):
+    return expand(x, y.shape)
+
+
+@_export
+def broadcast_tensors(inputs):
+    vals = [_u(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[v.shape for v in vals])
+    return [apply(lambda v: jnp.broadcast_to(v, shape), t, op_name="broadcast_tensors")
+            for t in inputs]
+
+
+@_export
+def roll(x, shifts, axis=None):
+    return apply(lambda v: jnp.roll(v, shifts, axis=axis), x, op_name="roll")
+
+
+@_export
+def flip(x, axis):
+    return apply(lambda v: jnp.flip(v, axis=axis), x, op_name="flip")
+
+
+@_export
+def rot90(x, k=1, axes=(0, 1)):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x, op_name="rot90")
+
+
+@_export
+def gather(x, index, axis=0):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=ax), x, index,
+                 op_name="gather")
+
+
+@_export
+def gather_nd(x, index):
+    def f(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return v[flat_idx]
+    return apply(f, x, index, op_name="gather_nd")
+
+
+@_export
+def index_select(x, index, axis=0):
+    return gather(x, index, axis)
+
+
+@_export
+def index_sample(x, index):
+    def f(v, idx):
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v[rows, idx.astype(jnp.int32)]
+    return apply(f, x, index, op_name="index_sample")
+
+
+@_export
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return apply(lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+                 arr, indices, op_name="take_along_axis")
+
+
+@_export
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    mode = {"assign": None, "add": "add", "mul": "multiply", "multiply": "multiply"}[reduce]
+
+    def f(v, i, val):
+        i = i.astype(jnp.int32)
+        val = jnp.broadcast_to(val, i.shape).astype(v.dtype)
+        if mode is None:
+            return jnp.put_along_axis(v, i, val, axis=axis, inplace=False)
+        # scatter-with-reduction via explicit index grid
+        idx = jnp.indices(i.shape, sparse=False)
+        full_idx = tuple(i if d == (axis % v.ndim) else idx[d] for d in range(v.ndim))
+        if mode == "add":
+            return v.at[full_idx].add(val)
+        return v.at[full_idx].multiply(val)
+    return apply(f, arr, indices, values, op_name="put_along_axis")
+
+
+@_export
+def scatter(x, index, updates, overwrite=True):
+    def f(v, i, u):
+        i = i.astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u)
+        return v.at[i].add(u)
+    return apply(f, x, index, updates, op_name="scatter")
+
+
+@_export
+def scatter_nd_add(x, index, updates):
+    def f(v, i, u):
+        i = i.astype(jnp.int32)
+        flat_idx = tuple(jnp.moveaxis(i, -1, 0))
+        return v.at[flat_idx].add(u)
+    return apply(f, x, index, updates, op_name="scatter_nd_add")
+
+
+@_export
+def scatter_nd(index, updates, shape):
+    shape = _static_ints(shape)
+    def f(i, u):
+        z = jnp.zeros(shape, u.dtype)
+        flat_idx = tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))
+        return z.at[flat_idx].add(u)
+    return apply(f, index, updates, op_name="scatter_nd")
+
+
+@_export
+def masked_select(x, mask):
+    # dynamic output shape: eager-only op (not jittable), like reference semantics
+    v, m = _u(x), _u(mask)
+    out = np.asarray(v)[np.asarray(m).astype(bool)]
+    return Tensor(jnp.asarray(out))
+
+
+@_export
+def masked_fill(x, mask, value):
+    val = _u(value) if isinstance(value, Tensor) else value
+    return apply(lambda v, m: jnp.where(m.astype(bool), jnp.asarray(val, v.dtype), v),
+                 x, mask, op_name="masked_fill")
+
+
+@_export
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c.astype(bool), a, b), condition, x, y,
+                 op_name="where")
+
+
+@_export
+def nonzero(x, as_tuple=False):
+    v = np.asarray(_u(x))
+    idx = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=-1).astype(np.int64)))
+
+
+@_export
+def sort(x, axis=-1, descending=False, stable=False):
+    def f(v):
+        out = jnp.sort(v, axis=axis, stable=stable)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply(f, x, op_name="sort")
+
+
+@_export
+def argsort(x, axis=-1, descending=False, stable=False):
+    def f(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable)
+        return jnp.flip(idx, axis=axis).astype(jnp.int64) if descending else idx.astype(jnp.int64)
+    return apply(f, x, op_name="argsort")
+
+
+@_export
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def f(v):
+        ax = axis % v.ndim
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, k)
+        else:
+            vals, idx = jax.lax.top_k(-vv, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+    out = apply(f, x, op_name="topk")
+    return out[0], out[1]
+
+
+@_export
+def kthvalue(x, k, axis=-1, keepdim=False):
+    def f(v):
+        vv = jnp.sort(v, axis=axis)
+        iv = jnp.argsort(v, axis=axis)
+        vals = jnp.take(vv, k - 1, axis=axis)
+        idx = jnp.take(iv, k - 1, axis=axis)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, axis), jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int64)
+    out = apply(f, x, op_name="kthvalue")
+    return out[0], out[1]
+
+
+@_export
+def mode(x, axis=-1, keepdim=False):
+    def f(v):
+        ax = axis % v.ndim
+        vv = jnp.sort(v, axis=ax)
+        iv = jnp.argsort(v, axis=ax)
+        n = vv.shape[ax]
+        same = jnp.concatenate([jnp.ones(vv.shape[:ax] + (1,) + vv.shape[ax + 1:], bool),
+                                jnp.take(vv, jnp.arange(1, n), axis=ax)
+                                == jnp.take(vv, jnp.arange(0, n - 1), axis=ax)], axis=ax)
+        # run lengths via cumulative reset counting
+        def scan_runs(carry, s):
+            run = jnp.where(s, carry + 1, 1)
+            return run, run
+        sm = jnp.moveaxis(same, ax, 0)
+        _, runs = jax.lax.scan(lambda c, s: ((jnp.where(s, c + 1, 1)),
+                                             (jnp.where(s, c + 1, 1))),
+                               jnp.zeros(sm.shape[1:], jnp.int32), sm)
+        runs = jnp.moveaxis(runs, 0, ax)
+        best = jnp.argmax(runs, axis=ax, keepdims=True)
+        vals = jnp.take_along_axis(vv, best, axis=ax)
+        idxs = jnp.take_along_axis(iv, best, axis=ax)
+        if not keepdim:
+            vals, idxs = jnp.squeeze(vals, ax), jnp.squeeze(idxs, ax)
+        return vals, idxs.astype(jnp.int64)
+    out = apply(f, x, op_name="mode")
+    return out[0], out[1]
+
+
+@_export
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    v = np.asarray(_u(x))
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+@_export
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    v = np.asarray(_u(x)).ravel() if axis is None else np.asarray(_u(x))
+    if axis is not None:
+        raise NotImplementedError("unique_consecutive with axis")
+    keep = np.ones(v.shape[0], bool)
+    keep[1:] = v[1:] != v[:-1]
+    out = [Tensor(jnp.asarray(v[keep]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, v.shape[0]))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+@_export
+def one_hot(x, num_classes):
+    n = int(num_classes)
+    return apply(lambda v: jax.nn.one_hot(v.astype(jnp.int32), n,
+                                          dtype=dtypes.get_default_dtype()),
+                 x, op_name="one_hot")
+
+
+@_export
+def slice(x, axes, starts, ends):
+    axes = _static_ints(axes)
+    starts = _static_ints(starts)
+    ends = _static_ints(ends)
+
+    def f(v):
+        out = v
+        for ax, st, en in zip(axes, starts, ends):
+            dim = v.shape[ax]
+            st2 = min(st % dim if st < 0 else st, dim)
+            en2 = dim if en >= dim else (en % dim if en < 0 else en)
+            out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
+        return out
+    return apply(f, x, op_name="slice")
+
+
+@_export
+def strided_slice(x, axes, starts, ends, strides):
+    def f(v):
+        sl = [builtins_slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            sl[ax] = builtins_slice(st, en, sd)
+        return v[tuple(sl)]
+    import builtins
+    builtins_slice = builtins.slice
+    return apply(f, x, op_name="strided_slice")
+
+
+@_export
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    pad = _static_ints(pad)
+
+    def f(v):
+        if len(pad) == v.ndim * 2:
+            cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(v.ndim)]
+        else:
+            # paddle convention: pairs apply from the LAST dim backward
+            # ([left, right, top, bottom] pads W then H for NCHW)
+            npair = len(pad) // 2
+            cfg = [(0, 0)] * (v.ndim - npair) + list(reversed(
+                [(pad[2 * i], pad[2 * i + 1]) for i in range(npair)]))
+        if mode == "constant":
+            return jnp.pad(v, cfg, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(v, cfg, mode=jmode)
+    return apply(f, x, op_name="pad")
+
+
+@_export
+def repeat_interleave(x, repeats, axis=None):
+    r = _u(repeats) if isinstance(repeats, Tensor) else repeats
+    return apply(lambda v: jnp.repeat(v, r, axis=axis), x, op_name="repeat_interleave")
+
+
+@_export
+def as_strided(x, shape, stride, offset=0):
+    raise NotImplementedError("as_strided is not supported on TPU (no raw striding)")
+
+
+@_export
+def numel(x):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+@_export
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(v):
+        size = index_num // nshards
+        shard = v // size
+        new = jnp.where(shard == shard_id, v % size, ignore_value)
+        return new
+    return apply(f, input, op_name="shard_index")
+
+
+@_export
+def bincount(x, weights=None, minlength=0):
+    if weights is not None:
+        return apply(lambda v, w: jnp.bincount(v.astype(jnp.int32), w, minlength=minlength,
+                                               length=None), x, weights, op_name="bincount")
+    v = np.asarray(_u(x))
+    return Tensor(jnp.asarray(np.bincount(v, minlength=minlength)))
+
+
+@_export
+def histogram(x, bins=100, min=0, max=0):
+    v = np.asarray(_u(x))
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = np.histogram(v, bins=bins, range=rng)
+    return Tensor(jnp.asarray(hist.astype(np.int64)))
+
+
+@_export
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    return apply(lambda s, v: jnp.searchsorted(s, v, side=side).astype(dt),
+                 sorted_sequence, values, op_name="searchsorted")

@@ -1,0 +1,429 @@
+"""Elementwise math, reduction, and comparison ops.
+
+Analog of python/paddle/tensor/math.py + logic.py over the Phi kernel library
+(paddle/phi/kernels/). Each op is a jax/XLA computation; elementwise chains are
+fused by XLA on TPU, so there is no need for hand-fused kernels here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .dispatch import apply, defprim
+
+__all__ = []
+
+
+def _export(name, fn):
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def _u(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+# ---------- binary elementwise with paddle-style broadcasting ----------
+
+def _binop(name, jax_fn):
+    def op(x, y, name_=None):
+        return apply(jax_fn, x, y, op_name=name)
+    op.__name__ = name
+    return _export(name, op)
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.divide)
+mod = _binop("mod", jnp.mod)
+remainder = _export("remainder", mod)
+floor_mod = _export("floor_mod", mod)
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+pow = _binop("pow", jnp.power)
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+hypot = _binop("hypot", jnp.hypot)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+nextafter = _binop("nextafter", jnp.nextafter)
+copysign = _binop("copysign", jnp.copysign)
+heaviside = _binop("heaviside", jnp.heaviside)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+inner = _binop("inner", jnp.inner)
+outer = _binop("outer", jnp.outer)
+kron = _binop("kron", jnp.kron)
+cross = _export("cross", lambda x, y, axis=None: apply(
+    lambda a, b: jnp.cross(a, b, axis=-1 if axis is None else axis), x, y, op_name="cross"))
+
+
+def divide_no_nan(x, y):
+    return apply(lambda a, b: jnp.where(b == 0, jnp.zeros_like(a * b), a / b), x, y,
+                 op_name="divide_no_nan")
+_export("divide_no_nan", divide_no_nan)
+
+
+# ---------- unary elementwise ----------
+
+def _unop(name, jax_fn):
+    def op(x, name_=None):
+        return apply(jax_fn, x, op_name=name)
+    op.__name__ = name
+    return _export(name, op)
+
+
+abs = _unop("abs", jnp.abs)
+neg = _unop("neg", jnp.negative)
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", jax.lax.rsqrt)
+square = _unop("square", jnp.square)
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round = _unop("round", jnp.round)
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda v: v - jnp.trunc(v))
+sign = _unop("sign", jnp.sign)
+sgn = _export("sgn", sign)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+i0 = _unop("i0", jax.scipy.special.i0)
+i0e = _unop("i0e", jax.scipy.special.i0e)
+i1 = _unop("i1", jax.scipy.special.i1)
+i1e = _unop("i1e", jax.scipy.special.i1e)
+isnan = _unop("isnan", jnp.isnan)
+isinf = _unop("isinf", jnp.isinf)
+isfinite = _unop("isfinite", jnp.isfinite)
+logit = _unop("logit", jax.scipy.special.logit)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+
+
+def clip(x, min=None, max=None):
+    lo = _u(min) if min is not None else None
+    hi = _u(max) if max is not None else None
+    return apply(lambda v: jnp.clip(v, lo, hi), x, op_name="clip")
+_export("clip", clip)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf),
+                 x, op_name="nan_to_num")
+_export("nan_to_num", nan_to_num)
+
+
+def lerp(x, y, weight):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight, op_name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), x, y, op_name="lerp")
+_export("lerp", lerp)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    def f(v):
+        out = v * scale + bias if bias_after_scale else (v + bias) * scale
+        return out
+    return apply(f, x, op_name="scale")
+_export("scale", scale)
+
+
+def increment(x, value=1.0):
+    out = apply(lambda v: v + value, x, op_name="increment")
+    x._set_value(out._value)
+    return x
+_export("increment", increment)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), x, op_name="stanh")
+_export("stanh", stanh)
+
+
+def rsqrt_(x):
+    return rsqrt(x)
+_export("rsqrt_", rsqrt_)
+
+
+# ---------- matmul family ----------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return apply(f, x, y, op_name="matmul")
+_export("matmul", matmul)
+
+
+def mm(x, y):
+    return matmul(x, y)
+_export("mm", mm)
+
+
+def bmm(x, y):
+    return matmul(x, y)
+_export("bmm", bmm)
+
+
+def dot(x, y):
+    return apply(lambda a, b: (a * b).sum(-1), x, y, op_name="dot")
+_export("dot", dot)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y,
+                 op_name="addmm")
+_export("addmm", addmm)
+
+
+def mv(x, vec):
+    return matmul(x, vec)
+_export("mv", mv)
+
+
+def t(x):
+    return apply(lambda v: jnp.swapaxes(v, -1, -2) if v.ndim >= 2 else v, x, op_name="t")
+_export("t", t)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+                 x, op_name="trace")
+_export("trace", trace)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+                 x, op_name="diagonal")
+_export("diagonal", diagonal)
+
+
+def einsum(equation, *operands):
+    return apply(lambda *ops: jnp.einsum(equation, *ops), *operands, op_name="einsum")
+_export("einsum", einsum)
+
+
+# ---------- reductions ----------
+
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, jax_fn, default_keepdim=False):
+    def op(x, axis=None, keepdim=default_keepdim, name_=None):
+        ax = _axis_arg(axis)
+        return apply(lambda v: jax_fn(v, axis=ax, keepdims=keepdim), x, op_name=name)
+    op.__name__ = name
+    return _export(name, op)
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+logsumexp = _reduce("logsumexp", jax.scipy.special.logsumexp)
+all = _reduce("all", jnp.all)
+any = _reduce("any", jnp.any)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    ax = _axis_arg(axis)
+    return apply(lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+                 x, op_name="std")
+_export("std", std)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    ax = _axis_arg(axis)
+    return apply(lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+                 x, op_name="var")
+_export("var", var)
+
+
+def median(x, axis=None, keepdim=False):
+    ax = _axis_arg(axis)
+    return apply(lambda v: jnp.median(v, axis=ax, keepdims=keepdim), x, op_name="median")
+_export("median", median)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    ax = _axis_arg(axis)
+    return apply(lambda v: jnp.quantile(v, jnp.asarray(q), axis=ax, keepdims=keepdim),
+                 x, op_name="quantile")
+_export("quantile", quantile)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    ax = _axis_arg(axis)
+    dt = dtypes.convert_dtype(dtype)
+    return apply(lambda v: jnp.argmax(v, axis=ax, keepdims=keepdim).astype(dt),
+                 x, op_name="argmax")
+_export("argmax", argmax)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    ax = _axis_arg(axis)
+    dt = dtypes.convert_dtype(dtype)
+    return apply(lambda v: jnp.argmin(v, axis=ax, keepdims=keepdim).astype(dt),
+                 x, op_name="argmin")
+_export("argmin", argmin)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    ax = _axis_arg(axis)
+    return apply(lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim), x,
+                 op_name="count_nonzero")
+_export("count_nonzero", count_nonzero)
+
+
+def cumsum(x, axis=None, dtype=None):
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        out = jnp.cumsum(vv, axis=0 if axis is None else axis)
+        return out.astype(dtypes.convert_dtype(dtype)) if dtype else out
+    return apply(f, x, op_name="cumsum")
+_export("cumsum", cumsum)
+
+
+def cumprod(x, dim=None, dtype=None):
+    def f(v):
+        vv = v.reshape(-1) if dim is None else v
+        out = jnp.cumprod(vv, axis=0 if dim is None else dim)
+        return out.astype(dtypes.convert_dtype(dtype)) if dtype else out
+    return apply(f, x, op_name="cumprod")
+_export("cumprod", cumprod)
+
+
+def cummax(x, axis=None):
+    ax = 0 if axis is None else axis
+
+    def g(v):
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.cummax(vv, axis=ax)
+        idx = jnp.arange(vv.shape[ax]).reshape(
+            [-1 if i == (ax % vv.ndim) else 1 for i in range(vv.ndim)])
+        idx = jnp.broadcast_to(idx, vv.shape)
+        is_new = vv >= vals
+        ind = jax.lax.cummax(jnp.where(is_new, idx, -1), axis=ax)
+        return vals, ind.astype(jnp.int64)
+    out = apply(g, x, op_name="cummax")
+    return out[0], out[1]
+_export("cummax", cummax)
+
+
+def cummin(x, axis=None):
+    ax = 0 if axis is None else axis
+
+    def g(v):
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.cummin(vv, axis=ax)
+        idx = jnp.arange(vv.shape[ax]).reshape(
+            [-1 if i == (ax % vv.ndim) else 1 for i in range(vv.ndim)])
+        idx = jnp.broadcast_to(idx, vv.shape)
+        is_new = vv <= vals
+        ind = jax.lax.cummax(jnp.where(is_new, idx, -1), axis=ax)
+        return vals, ind.astype(jnp.int64)
+    out = apply(g, x, op_name="cummin")
+    return out[0], out[1]
+_export("cummin", cummin)
+
+
+# ---------- comparison / logic ----------
+
+def _cmp(name, jax_fn):
+    def op(x, y, name_=None):
+        return apply(jax_fn, x, y, op_name=name)
+    op.__name__ = name
+    return _export(name, op)
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+logical_not = _unop("logical_not", jnp.logical_not)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = _unop("bitwise_not", jnp.bitwise_not)
+left_shift = _cmp("left_shift", jnp.left_shift)
+right_shift = _cmp("right_shift", jnp.right_shift)
+
+
+def equal_all(x, y):
+    return apply(lambda a, b: jnp.array_equal(a, b), x, y, op_name="equal_all")
+_export("equal_all", equal_all)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 x, y, op_name="isclose")
+_export("isclose", isclose)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 x, y, op_name="allclose")
+_export("allclose", allclose)
+
+
+# ---------- casting ----------
+
+def cast(x, dtype):
+    dt = dtypes.convert_dtype(dtype)
+    return apply(lambda v: v.astype(dt), x, op_name="cast")
+_export("cast", cast)
+
+
+def astype(x, dtype):
+    return cast(x, dtype)
+_export("astype", astype)

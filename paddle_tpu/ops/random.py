@@ -1,0 +1,146 @@
+"""Random sampling ops.
+
+Analog of python/paddle/tensor/random.py. Stateful paddle-style semantics are
+provided by folding fresh subkeys off the default Generator
+(paddle_tpu/core/generator.py); inside traced/compiled code, prefer passing
+explicit keys (the functional path used by nn initializers and dropout).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core import generator as gen
+from ..core.tensor import Tensor
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal", "standard_normal",
+    "poisson", "bernoulli", "multinomial", "randperm", "exponential_", "uniform_",
+    "normal_", "gumbel_softmax",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
+
+
+def _dt(dtype):
+    return dtypes.convert_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+
+
+def rand(shape, dtype=None, key=None):
+    key = key if key is not None else gen.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, key=None):
+    key = key if key is not None else gen.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", key=None):
+    if high is None:
+        low, high = 0, low
+    key = key if key is not None else gen.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), int(low), int(high),
+                                     dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    dtype = dtype or x.dtype
+    return randint(low, high, x.shape, dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, key=None):
+    key = key if key is not None else gen.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=float(min), maxval=float(max)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, key=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        key = key if key is not None else gen.next_key()
+        eps = jax.random.normal(key, out_shape, dtypes.get_default_dtype())
+        return Tensor(m + s * eps)
+    key = key if key is not None else gen.next_key()
+    eps = jax.random.normal(key, _shape(shape), dtypes.get_default_dtype())
+    return Tensor(mean + std * eps)
+
+
+def poisson(x, key=None):
+    key = key if key is not None else gen.next_key()
+    lam = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(key, lam, dtype=jnp.int64).astype(lam.dtype))
+
+
+def bernoulli(x, key=None):
+    key = key if key is not None else gen.next_key()
+    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(key, p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, key=None):
+    key = key if key is not None else gen.next_key()
+    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(*p.shape[:-1], int(num_samples)))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, p.shape, logits.dtype)
+        _, out = jax.lax.top_k(logits + g, int(num_samples))
+    return Tensor(out.astype(jnp.int64))
+
+
+def randperm(n, dtype="int64", key=None):
+    key = key if key is not None else gen.next_key()
+    return Tensor(jax.random.permutation(key, int(n)).astype(dtypes.convert_dtype(dtype)))
+
+
+def uniform_(x, min=-1.0, max=1.0):
+    x._set_value(uniform(x.shape, x.dtype, min, max)._value)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0):
+    x._set_value(normal(mean, std, x.shape)._value.astype(x.dtype))
+    return x
+
+
+def exponential_(x, lam=1.0, key=None):
+    key = key if key is not None else gen.next_key()
+    e = jax.random.exponential(key, tuple(x.shape), x._value.dtype) / lam
+    x._set_value(e)
+    return x
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, key=None):
+    key = key if key is not None else gen.next_key()
+    from .dispatch import apply
+
+    def f(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y).at[
+                tuple(jnp.indices(y.shape)[d] if d != (axis % y.ndim) else
+                      jnp.broadcast_to(idx, y.shape)
+                      for d in range(y.ndim))].set(0)
+            oh = jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis, dtype=y.dtype)
+            y = oh + y - jax.lax.stop_gradient(y)
+        return y
+    return apply(f, x, op_name="gumbel_softmax")

@@ -1,0 +1,201 @@
+"""Concrete optimizers (analog of python/paddle/optimizer/{sgd,momentum,adam,adamw,...}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_rule(self, val, grad, state, lr, wd):
+        if wd:
+            grad = grad + wd * val
+        return val - lr.astype(val.dtype) * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p: Parameter):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _update_rule(self, val, grad, state, lr, wd):
+        if wd:
+            grad = grad + wd * val
+        mu = self._momentum
+        v = mu * state["velocity"] + grad
+        if self._nesterov:
+            upd = grad + mu * v
+        else:
+            upd = v
+        return val - lr.astype(val.dtype) * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None,
+                 lazy_mode=False, multi_precision=False, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p: Parameter):
+        return {"moment1": jnp.zeros_like(p._value),
+                "moment2": jnp.zeros_like(p._value)}
+
+    def _decoupled(self):
+        return False
+
+    def _update_rule(self, val, grad, state, lr, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        t = state["__step__"].astype(jnp.float32)
+        if wd and not self._decoupled():
+            grad = grad + wd * val
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        mhat = m / (1 - b1 ** t).astype(val.dtype)
+        vhat = v / (1 - b2 ** t).astype(val.dtype)
+        upd = mhat / (jnp.sqrt(vhat) + eps)
+        if wd and self._decoupled():
+            upd = upd + wd * val
+        new_val = val - lr.astype(val.dtype) * upd
+        return new_val, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, grad_clip=None, name=None,
+                 lr_ratio=None, apply_decay_param_fun=None, multi_precision=False, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, name, multi_precision=multi_precision)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p: Parameter):
+        return {"moment": jnp.zeros_like(p._value),
+                "inf_norm": jnp.zeros_like(p._value)}
+
+    def _update_rule(self, val, grad, state, lr, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        t = state["__step__"].astype(jnp.float32)
+        if wd:
+            grad = grad + wd * val
+        m = b1 * state["moment"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(grad))
+        new_val = val - (lr / (1 - b1 ** t)).astype(val.dtype) * m / (u + eps)
+        return new_val, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p: Parameter):
+        return {"moment": jnp.full_like(p._value, self._init_acc)}
+
+    def _update_rule(self, val, grad, state, lr, wd):
+        if wd:
+            grad = grad + wd * val
+        acc = state["moment"] + jnp.square(grad)
+        new_val = val - lr.astype(val.dtype) * grad / (jnp.sqrt(acc) + self._eps)
+        return new_val, {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_state(self, p: Parameter):
+        return {"avg_squared_grad": jnp.zeros_like(p._value),
+                "avg_squared_update": jnp.zeros_like(p._value)}
+
+    def _update_rule(self, val, grad, state, lr, wd):
+        if wd:
+            grad = grad + wd * val
+        rho, eps = self._rho, self._eps
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(grad)
+        upd = grad * jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(asg + eps)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(upd)
+        return val - lr.astype(val.dtype) * upd, \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _init_state(self, p: Parameter):
+        s = {"mean_square": jnp.zeros_like(p._value),
+             "momentum_acc": jnp.zeros_like(p._value)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p._value)
+        return s
+
+    def _update_rule(self, val, grad, state, lr, wd):
+        if wd:
+            grad = grad + wd * val
+        rho, eps = self._rho, self._eps
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(grad)
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["momentum_acc"] + lr.astype(val.dtype) * grad / denom
+        new_state = {"mean_square": ms, "momentum_acc": mom}
+        if mg is not None:
+            new_state["mean_grad"] = mg
+        return val - mom, new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p: Parameter):
+        return {"moment1": jnp.zeros_like(p._value),
+                "moment2": jnp.zeros_like(p._value)}
+
+    def _update_rule(self, val, grad, state, lr, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        t = state["__step__"].astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        mhat = m / (1 - b1 ** t).astype(val.dtype)
+        vhat = v / (1 - b2 ** t).astype(val.dtype)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * val
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(val)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return val - lr.astype(val.dtype) * trust * r, {"moment1": m, "moment2": v}

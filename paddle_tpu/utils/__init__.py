@@ -1,0 +1,15 @@
+from . import flags  # noqa: F401
+from .enforce import enforce, EnforceNotMet  # noqa: F401
+from .log import get_logger  # noqa: F401
+
+
+def run_check():
+    """Analog of paddle.utils.run_check: verify the device works end to end."""
+    import jax
+    import jax.numpy as jnp
+    d = jax.devices()[0]
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    print(f"paddle_tpu is installed and working on {d.platform}:{d.id} "
+          f"({float(y[0, 0])} == 128.0)")
+    return True

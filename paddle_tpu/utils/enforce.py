@@ -1,0 +1,18 @@
+"""Error enforcement — analog of PADDLE_ENFORCE_* (paddle/phi/core/enforce.h)."""
+from __future__ import annotations
+
+import traceback
+
+
+class EnforceNotMet(RuntimeError):
+    """Raised when an enforce check fails; carries a python-side stack summary."""
+
+    def __init__(self, msg: str):
+        stack = "".join(traceback.format_stack()[:-2][-6:])
+        super().__init__(f"{msg}\n\n[operator stack]\n{stack}")
+
+
+def enforce(cond, msg: str = "enforce failed", *fmt_args):
+    if not cond:
+        raise EnforceNotMet(msg % fmt_args if fmt_args else msg)
+    return True

@@ -1,0 +1,53 @@
+"""Global flag registry.
+
+Analog of the reference's exported-flag system (paddle/phi/core/flags.h:180,
+python paddle.set_flags/get_flags, python/paddle/fluid/framework.py:7754):
+a process-global registry seeded from FLAGS_* environment variables.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_: str = ""):
+    env = os.environ.get(name)
+    if env is not None:
+        if isinstance(default, bool):
+            val = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            val = int(env)
+        elif isinstance(default, float):
+            val = float(env)
+        else:
+            val = env
+    else:
+        val = default
+    _REGISTRY[name] = val
+    return val
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise KeyError(f"unknown flag {k!r}")
+        _REGISTRY[k] = v
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {n: _REGISTRY[n] for n in names}
+
+
+def flag(name: str):
+    return _REGISTRY.get(name)
+
+
+# core flags (subset of paddle/phi/core/flags.cc that is meaningful on TPU)
+define_flag("FLAGS_check_nan_inf", False, "scan outputs for nan/inf after each op")
+define_flag("FLAGS_use_bf16_matmul", True, "prefer bf16 matmul accumulation under AMP")
+define_flag("FLAGS_allocator_strategy", "xla", "memory handled by XLA/PJRT arena")
+define_flag("FLAGS_log_level", "info", "framework log level")

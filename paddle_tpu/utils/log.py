@@ -1,0 +1,24 @@
+"""Logging — analog of glog VLOG usage across the reference."""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LOGGERS = {}
+
+
+def get_logger(name: str = "paddle_tpu", level=None):
+    if name in _LOGGERS:
+        return _LOGGERS[name]
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s] %(message)s"))
+        logger.addHandler(h)
+    lvl = level or os.environ.get("PADDLE_TPU_LOG_LEVEL", "INFO")
+    logger.setLevel(lvl.upper() if isinstance(lvl, str) else lvl)
+    logger.propagate = False
+    _LOGGERS[name] = logger
+    return logger

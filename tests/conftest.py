@@ -1,0 +1,30 @@
+"""Test config: force an 8-device virtual CPU platform.
+
+Mirrors the reference's strategy of testing distributed logic without real
+accelerators (SURVEY.md §4: fake/Gloo backends, multi-process single host) —
+here a single-process 8-device CPU mesh exercises the same SPMD code paths the
+TPU mesh uses.
+
+Note: the environment's sitecustomize registers the axon (TPU) PJRT plugin and
+overrides jax_platforms, so we must force CPU via jax.config, not env vars.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as P
+    P.seed(2024)
+    np.random.seed(2024)
+    yield

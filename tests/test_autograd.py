@@ -1,0 +1,176 @@
+"""Define-by-run autograd tests — numeric-gradient checks in the spirit of the
+reference's OpTest gradient checking (test/legacy_test/eager_op_test.py:379)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Central finite difference d(sum(fn(x)))/dx."""
+    x = x.astype(np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (fn(xp).sum() - fn(xm).sum()) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_simple_backward():
+    x = P.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain_and_broadcast():
+    x = P.to_tensor(np.random.randn(3, 4).astype(np.float32), stop_gradient=False)
+    b = P.to_tensor(np.random.randn(4).astype(np.float32), stop_gradient=False)
+    y = ((x + b) * 2.0).mean()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3, 4), 2.0 / 12), rtol=1e-6)
+    np.testing.assert_allclose(b.grad.numpy(), np.full(4, 2.0 * 3 / 12), rtol=1e-6)
+
+
+def test_matmul_grad_numeric():
+    a_np = np.random.randn(3, 4).astype(np.float64)
+    b_np = np.random.randn(4, 2).astype(np.float64)
+    a = P.to_tensor(a_np, dtype="float64", stop_gradient=False)
+    b = P.to_tensor(b_np, dtype="float64", stop_gradient=False)
+    out = P.matmul(a, b)
+    out.backward(P.ones_like(out))
+    ng = numeric_grad(lambda x: x @ b_np, a_np)
+    np.testing.assert_allclose(a.grad.numpy(), ng, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accumulation():
+    x = P.to_tensor([2.0], stop_gradient=False)
+    y1 = x * 3.0
+    y2 = x * 4.0
+    y1.backward()
+    y2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_reuse_same_input():
+    x = P.to_tensor([3.0], stop_gradient=False)
+    y = x * x  # both args are x
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_stop_gradient_blocks():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    z = y.detach() * 3.0
+    w = y + z
+    w.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_no_grad_context():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    with P.no_grad():
+        y = x * 2.0
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_paddle_grad_api():
+    x = P.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = P.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), 3 * np.array([4.0, 9.0]), rtol=1e-6)
+    # .grad must not be polluted by paddle.grad
+    assert x.grad is None
+
+
+def test_backward_with_grad_tensor():
+    x = P.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2.0
+    y.backward(P.to_tensor([0.5, 0.25]))
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.5])
+
+
+def test_multi_output_op_grad():
+    x = P.to_tensor(np.random.randn(4, 6).astype(np.float32), stop_gradient=False)
+    parts = P.split(x, 2, axis=1)
+    loss = parts[0].sum() * 2.0 + parts[1].sum() * 3.0
+    loss.backward()
+    expect = np.concatenate([np.full((4, 3), 2.0), np.full((4, 3), 3.0)], axis=1)
+    np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+def test_retain_grads_intermediate():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    y.retain_grads()
+    z = y * 3.0
+    z.backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_hook():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10.0)
+    y = x * 2.0
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_softmax_ce_grad_numeric():
+    logits_np = np.random.randn(5, 7)
+    labels_np = np.random.randint(0, 7, (5,))
+    logits = P.to_tensor(logits_np, dtype="float64", stop_gradient=False)
+    labels = P.to_tensor(labels_np)
+    loss = P.nn.functional.cross_entropy(logits, labels)
+    loss.backward()
+
+    def ref(z):
+        zz = z - zz_max(z)
+        p = np.exp(zz) / np.exp(zz).sum(-1, keepdims=True)
+        return np.array([-np.log(p[i, labels_np[i]]) for i in range(5)]).mean()
+
+    def zz_max(z):
+        return z.max(-1, keepdims=True)
+
+    ng = numeric_grad(lambda z: np.array(ref(z)), logits_np, eps=1e-5)
+    np.testing.assert_allclose(logits.grad.numpy(), ng, rtol=1e-4, atol=1e-6)
+
+
+def test_pylayer():
+    class Double(P.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2.0
+
+        @staticmethod
+        def backward(ctx, gy):
+            return gy * 2.0
+
+    x = P.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_second_backward_after_free_is_inert():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    y.backward()
+    y.backward()  # graph freed: must not flow to x again
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_retain_graph_double_backward():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])

@@ -1,0 +1,101 @@
+"""to_static / jit save-load tests (analog of test/dygraph_to_static/)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import InputSpec
+
+
+def test_to_static_function():
+    calls = []
+
+    @P.to_static
+    def f(x):
+        calls.append(1)  # python body runs only at trace time
+        return x * 2.0 + 1.0
+
+    x = P.to_tensor([1.0, 2.0])
+    y1 = f(x)
+    y2 = f(P.to_tensor([3.0, 4.0]))
+    np.testing.assert_allclose(y1.numpy(), [3.0, 5.0])
+    np.testing.assert_allclose(y2.numpy(), [7.0, 9.0])
+    # second call hit the cache: traced at most twice (fwd + potential vjp retrace)
+    assert len(calls) <= 2
+
+
+def test_to_static_layer_grads_match_eager():
+    P.seed(3)
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    x = P.randn([5, 4])
+
+    # eager
+    out_e = model(x)
+    loss_e = out_e.sum()
+    loss_e.backward()
+    grads_e = [p.grad.numpy().copy() for p in model.parameters()]
+    model.clear_gradients()
+
+    # static
+    static_model = P.to_static(model)
+    out_s = static_model(x)
+    np.testing.assert_allclose(out_s.numpy(), out_e.numpy(), rtol=1e-5, atol=1e-6)
+    loss_s = out_s.sum()
+    loss_s.backward()
+    grads_s = [p.grad.numpy() for p in model.parameters()]
+    for ge, gs in zip(grads_e, grads_s):
+        np.testing.assert_allclose(gs, ge, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_training_loop():
+    P.seed(5)
+    model = nn.Sequential(nn.Linear(2, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = P.to_static(model)
+    opt = P.optimizer.Adam(learning_rate=0.02, parameters=model.parameters())
+    x = P.randn([64, 2])
+    y = P.to_tensor(x.numpy()[:, :1] * 2.0 + 1.0)
+    first = None
+    for _ in range(100):
+        loss = P.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+    assert float(loss.numpy()) < 0.05 * first
+
+
+def test_jit_save_load(tmp_path):
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model.eval()
+    path = str(tmp_path / "model/infer")
+    P.jit.save(model, path, input_spec=[InputSpec([None, 4], "float32")])
+    assert os.path.exists(path + ".pdmodel")
+
+    loaded = P.jit.load(path)
+    x = P.randn([1, 4])
+    np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_paddle_save_load(tmp_path):
+    model = nn.Linear(3, 3)
+    path = str(tmp_path / "ckpt.pdparams")
+    P.save(model.state_dict(), path)
+    sd = P.load(path)
+    model2 = nn.Linear(3, 3)
+    model2.set_state_dict(sd)
+    np.testing.assert_allclose(model2.weight.numpy(), model.weight.numpy())
+
+
+def test_static_dropout_varies_across_calls():
+    drop = nn.Dropout(0.5)
+    drop.train()
+    model = P.to_static(drop)
+    x = P.ones([1000])
+    y1 = model(x).numpy()
+    y2 = model(x).numpy()
+    # different rng key per call => different masks
+    assert not np.allclose(y1, y2)

@@ -1,0 +1,223 @@
+"""nn.Layer / layers / losses tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_shapes_and_grad():
+    layer = nn.Linear(8, 4)
+    x = P.randn([2, 8])
+    y = layer(x)
+    assert y.shape == [2, 4]
+    loss = y.sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert layer.weight.grad.shape == [8, 4]
+    assert layer.bias.grad.shape == [4]
+
+
+def test_parameters_registry():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+    assert len(m.parameters()) == 4
+
+
+def test_state_dict_roundtrip():
+    m = nn.Linear(3, 3)
+    sd = m.state_dict()
+    m2 = nn.Linear(3, 3)
+    m2.set_state_dict(sd)
+    np.testing.assert_allclose(m2.weight.numpy(), m.weight.numpy())
+
+
+def test_conv2d():
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = P.randn([2, 3, 16, 16])
+    y = conv(x)
+    assert y.shape == [2, 8, 16, 16]
+    y.sum().backward()
+    assert conv.weight.grad.shape == [8, 3, 3, 3]
+
+
+def test_conv2d_matches_numpy():
+    # 1x1 conv == per-pixel linear
+    conv = nn.Conv2D(2, 3, 1, bias_attr=False)
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    y = conv(P.to_tensor(x)).numpy()
+    w = conv.weight.numpy()  # [3,2,1,1]
+    ref = np.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose():
+    deconv = nn.Conv2DTranspose(4, 2, 2, stride=2)
+    x = P.randn([1, 4, 8, 8])
+    y = deconv(x)
+    assert y.shape == [1, 2, 16, 16]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = P.randn([4, 3, 8, 8]) * 3.0 + 1.0
+    bn.train()
+    y = bn(x)
+    m = y.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+    # running stats updated
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [4, 3, 8, 8]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(16)
+    x = P.randn([2, 5, 16])
+    y = ln(x)
+    np.testing.assert_allclose(y.numpy().mean(-1), np.zeros((2, 5)), atol=1e-5)
+    np.testing.assert_allclose(y.numpy().std(-1), np.ones((2, 5)), atol=1e-2)
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(8)
+    x = P.randn([3, 8])
+    y = rn(x)
+    ms = np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y.numpy(), x.numpy() / ms, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = P.ones([1000])
+    d.train()
+    y = d(x)
+    frac_zero = (y.numpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    # upscale keeps expectation
+    assert abs(y.numpy().mean() - 1.0) < 0.2
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = P.to_tensor([[1, 2, 0]])
+    out = emb(ids)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 2], np.zeros(4))
+
+
+def test_pooling():
+    x = P.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    y = F.max_pool2d(x, 2, 2)
+    np.testing.assert_allclose(y.numpy()[0, 0], [[5, 7], [13, 15]])
+    y = F.avg_pool2d(x, 2, 2)
+    np.testing.assert_allclose(y.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    y = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(y.numpy()[0, 0], [[7.5]])
+
+
+def test_activations():
+    x = P.to_tensor([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 0, 0.5, 2])
+    np.testing.assert_allclose(F.sigmoid(x).numpy(),
+                               1 / (1 + np.exp(-x.numpy())), rtol=1e-6)
+    s = F.softmax(x).numpy()
+    np.testing.assert_allclose(s.sum(), 1.0, rtol=1e-6)
+    assert F.gelu(x).shape == [5]
+    assert F.silu(x).shape == [5]
+
+
+def test_losses():
+    logits = P.randn([4, 10])
+    labels = P.to_tensor([1, 2, 3, 4])
+    loss = F.cross_entropy(logits, labels)
+    assert loss.shape == []
+    ref = -np.log(np.exp(logits.numpy())
+                  / np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = np.array([ref[i, labels.numpy()[i]] for i in range(4)]).mean()
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    a, b = P.randn([3, 4]), P.randn([3, 4])
+    np.testing.assert_allclose(F.mse_loss(a, b).numpy(),
+                               ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-6)
+    np.testing.assert_allclose(F.l1_loss(a, b).numpy(),
+                               np.abs(a.numpy() - b.numpy()).mean(), rtol=1e-6)
+
+
+def test_bce_with_logits_stable():
+    z = P.to_tensor([100.0, -100.0], stop_gradient=False)
+    y = P.to_tensor([1.0, 0.0])
+    loss = F.binary_cross_entropy_with_logits(z, y)
+    assert np.isfinite(loss.numpy())
+    loss.backward()
+    assert np.all(np.isfinite(z.grad.numpy()))
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = P.randn([2, 6, 16])
+    y = mha(x)
+    assert y.shape == [2, 6, 16]
+    y.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32,
+                                       dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = P.randn([2, 5, 16])
+    y = enc(x)
+    assert y.shape == [2, 5, 16]
+
+
+def test_lstm():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = P.randn([3, 7, 4])  # [B, T, I]
+    y, (h, c) = lstm(x)
+    assert y.shape == [3, 7, 8]
+    assert h.shape == [2, 3, 8]
+    y.sum().backward()
+
+
+def test_gru_bidirectional():
+    gru = nn.GRU(4, 6, direction="bidirect")
+    x = P.randn([2, 5, 4])
+    y, h = gru(x)
+    assert y.shape == [2, 5, 12]
+
+
+def test_sdpa_matches_ref():
+    q = P.randn([2, 5, 4, 8])
+    k = P.randn([2, 5, 4, 8])
+    v = P.randn([2, 5, 4, 8])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    assert out.shape == [2, 5, 4, 8]
+    # causality: output at position 0 must not depend on later keys
+    v2 = v.numpy().copy()
+    v2[:, 1:] = 0.0
+    out2 = F.scaled_dot_product_attention(q, k, P.to_tensor(v2), is_causal=True)
+    np.testing.assert_allclose(out.numpy()[:, 0], out2.numpy()[:, 0], rtol=1e-5)
+
+
+def test_clip_grad_by_global_norm():
+    p1 = P.Parameter(P.to_tensor([3.0])._value)
+    p2 = P.Parameter(P.to_tensor([4.0])._value)
+    g1, g2 = P.to_tensor([3.0]), P.to_tensor([4.0])
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip([(p1, g1), (p2, g2)])
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_hooks():
+    m = nn.Linear(2, 2)
+    calls = []
+    m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    m(P.randn([1, 2]))
+    assert calls == [1]

@@ -1,0 +1,184 @@
+"""Tensor + op library unit tests (pattern: numpy-reference checks, SURVEY.md §4.1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+def test_to_tensor_roundtrip():
+    x = P.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == np.float32
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_int_default_dtype():
+    x = P.to_tensor([1, 2, 3])
+    assert x.dtype == np.int64
+
+
+def test_creation_ops():
+    assert P.zeros([2, 3]).numpy().sum() == 0
+    assert P.ones([2, 3]).numpy().sum() == 6
+    np.testing.assert_allclose(P.full([2], 7.0).numpy(), [7, 7])
+    np.testing.assert_allclose(P.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(P.eye(3).numpy(), np.eye(3))
+    np.testing.assert_allclose(P.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5),
+                               rtol=1e-6)
+
+
+def test_arith_operators():
+    a = P.to_tensor([1.0, 2.0, 3.0])
+    b = P.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2.0 - a).numpy(), [1, 0, -1])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+
+
+def test_matmul():
+    a = P.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = P.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy())
+    np.testing.assert_allclose(P.matmul(a, b, transpose_x=False).numpy(),
+                               a.numpy() @ b.numpy())
+    np.testing.assert_allclose(
+        P.matmul(b, a, transpose_x=True, transpose_y=True).numpy(),
+        b.numpy().T @ a.numpy().T)
+
+
+def test_reductions():
+    x = np.random.randn(3, 4, 5).astype(np.float32)
+    t = P.to_tensor(x)
+    np.testing.assert_allclose(P.sum(t).numpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(P.mean(t, axis=1).numpy(), x.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(P.max(t, axis=[0, 2]).numpy(), x.max((0, 2)))
+    np.testing.assert_allclose(t.std().numpy(), x.std(ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(P.logsumexp(t, axis=-1).numpy(),
+                               np.log(np.exp(x).sum(-1)), rtol=1e-4)
+    assert P.argmax(t, axis=2).dtype == np.int64
+
+
+def test_manip():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = P.to_tensor(x)
+    assert P.reshape(t, [6, 4]).shape == [6, 4]
+    assert P.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert P.flatten(t, 1).shape == [2, 12]
+    assert P.unsqueeze(t, 0).shape == [1, 2, 3, 4]
+    assert P.squeeze(P.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+    parts = P.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts = P.split(t, [1, 3], axis=2)
+    assert parts[1].shape == [2, 3, 3]
+    c = P.concat([t, t], axis=0)
+    assert c.shape == [4, 3, 4]
+    s = P.stack([t, t], axis=1)
+    assert s.shape == [2, 2, 3, 4]
+
+
+def test_indexing():
+    x = np.arange(20, dtype=np.float32).reshape(4, 5)
+    t = P.to_tensor(x)
+    np.testing.assert_allclose(t[1].numpy(), x[1])
+    np.testing.assert_allclose(t[1:3, 2].numpy(), x[1:3, 2])
+    np.testing.assert_allclose(t[:, ::2].numpy(), x[:, ::2])
+    idx = P.to_tensor([0, 2])
+    np.testing.assert_allclose(t[idx].numpy(), x[[0, 2]])
+    mask = t > 9.0
+    np.testing.assert_allclose(P.masked_select(t, mask).numpy(), x[x > 9])
+
+
+def test_setitem():
+    t = P.zeros([3, 3])
+    t[1] = 5.0
+    assert t.numpy()[1].sum() == 15.0
+
+
+def test_gather_scatter():
+    x = np.random.randn(5, 3).astype(np.float32)
+    t = P.to_tensor(x)
+    idx = P.to_tensor([0, 2, 4])
+    np.testing.assert_allclose(P.gather(t, idx).numpy(), x[[0, 2, 4]])
+    upd = P.ones([3, 3])
+    out = P.scatter(t, idx, upd)
+    assert out.numpy()[0].sum() == 3.0
+
+
+def test_topk_sort():
+    x = np.random.randn(4, 10).astype(np.float32)
+    t = P.to_tensor(x)
+    vals, idx = P.topk(t, 3, axis=-1)
+    np.testing.assert_allclose(vals.numpy(), np.sort(x, -1)[:, ::-1][:, :3], rtol=1e-6)
+    np.testing.assert_allclose(P.sort(t, axis=-1).numpy(), np.sort(x, -1))
+
+
+def test_where_comparison():
+    a = P.to_tensor([1.0, 5.0, 3.0])
+    b = P.to_tensor([4.0, 2.0, 3.0])
+    np.testing.assert_allclose(P.where(a > b, a, b).numpy(), [4, 5, 3])
+    assert bool(P.all(P.to_tensor([True, True])).numpy())
+    assert (a == b).numpy().tolist() == [False, False, True]
+
+
+def test_cast():
+    t = P.to_tensor([1.5, 2.5])
+    assert P.cast(t, "int32").dtype == np.int32
+    assert t.astype("float64").dtype == np.float64
+
+
+def test_linalg():
+    a = np.random.randn(4, 4).astype(np.float32)
+    a = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    t = P.to_tensor(a)
+    np.testing.assert_allclose(P.linalg.inv(t).numpy(), np.linalg.inv(a), atol=1e-4)
+    np.testing.assert_allclose(P.linalg.det(t).numpy(), np.linalg.det(a), rtol=1e-4)
+    np.testing.assert_allclose(P.linalg.cholesky(t).numpy(), np.linalg.cholesky(a),
+                               atol=1e-4)
+    np.testing.assert_allclose(P.linalg.norm(t).numpy(),
+                               np.linalg.norm(a), rtol=1e-5)
+
+
+def test_einsum():
+    a = np.random.randn(2, 3).astype(np.float32)
+    b = np.random.randn(3, 4).astype(np.float32)
+    out = P.einsum("ij,jk->ik", P.to_tensor(a), P.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_random_shapes_and_determinism():
+    P.seed(7)
+    a = P.rand([3, 4])
+    P.seed(7)
+    b = P.rand([3, 4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    assert P.randn([2, 2]).shape == [2, 2]
+    r = P.randint(0, 10, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+    p = P.randperm(16)
+    assert sorted(p.numpy().tolist()) == list(range(16))
+
+
+def test_inplace_ops():
+    t = P.ones([3])
+    t.add_(P.ones([3]))
+    np.testing.assert_allclose(t.numpy(), [2, 2, 2])
+    t.zero_()
+    assert t.numpy().sum() == 0
+
+
+def test_cumsum_cumprod():
+    x = np.random.rand(3, 4).astype(np.float32)
+    t = P.to_tensor(x)
+    np.testing.assert_allclose(P.cumsum(t, axis=1).numpy(), np.cumsum(x, 1), rtol=1e-5)
+    np.testing.assert_allclose(P.cumprod(t, dim=0).numpy(), np.cumprod(x, 0), rtol=1e-5)
+
+
+def test_pad():
+    x = np.ones((1, 1, 2, 2), np.float32)
+    out = P.nn.functional.pad(P.to_tensor(x), [1, 1, 1, 1])
+    assert out.shape == [1, 1, 4, 4]
+    assert out.numpy().sum() == 4.0
