@@ -1,0 +1,86 @@
+"""Benchmark: LLaMA causal-LM training throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no absolute numbers (BASELINE.md), so vs_baseline is
+reported against the driver-tracked north-star proxy: achieved model FLOPs
+utilization (MFU) fraction of the 40% target on this chip.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as P
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, build_hybrid_train_step
+
+    P.seed(0)
+    # a single-chip-sized LLaMA (fits v5e HBM with fp32 master params + Adam)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2752,
+                      num_hidden_layers=8, num_attention_heads=16,
+                      max_position_embeddings=1024)
+    seq = 1024
+    batch = 8
+
+    model = LlamaForCausalLM(cfg)
+    opt = P.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = build_hybrid_train_step(model, opt, n_microbatches=1, remat=True)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
+    b = {"input_ids": P.to_tensor(ids[:, :-1]), "labels": P.to_tensor(ids[:, 1:])}
+
+    import jax as _jax
+
+    last = {}
+
+    def run_blocked(n):
+        """Run n steps and force REAL completion by fetching data that
+        depends on the last step's updates (block_until_ready on relayed
+        buffers can return early in this environment)."""
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = step(b)
+        last["loss"] = float(loss.numpy())
+        leaf = _jax.tree_util.tree_leaves(step.state["params"])[0]
+        _ = np.asarray(leaf)[:1]
+        return time.perf_counter() - t0
+
+    # warmup (compile + steady state)
+    run_blocked(3)
+
+    # two-point measurement cancels fixed per-fetch overhead
+    t_small = min(run_blocked(5), run_blocked(5))
+    t_large = min(run_blocked(25), run_blocked(25))
+    dt = (t_large - t_small) / 20
+
+    tokens_per_sec = batch * seq / dt
+
+    # param count & rough train FLOPs (6 * N * tokens, PaLM-style)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_step = 6.0 * n_params * batch * seq
+    achieved_tflops = flops_per_step / dt / 1e12
+    # v5e peak ~197 TFLOP/s bf16, ~98 fp32; use bf16 peak as the MFU denom
+    mfu = achieved_tflops / 197.0
+    vs_baseline = mfu / 0.40  # fraction of the 40%-MFU north-star
+
+    print(json.dumps({
+        "metric": "llama_1b-ish_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+    # extra context on stderr for humans
+    import sys
+    print(f"# params={n_params/1e6:.1f}M step={dt*1000:.1f}ms "
+          f"achieved={achieved_tflops:.1f}TFLOP/s mfu={mfu*100:.1f}% "
+          f"loss={last['loss']:.3f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

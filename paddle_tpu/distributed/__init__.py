@@ -1,0 +1,23 @@
+"""paddle_tpu.distributed — analog of python/paddle/distributed/."""
+from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    all_gather_object, all_to_all, alltoall, reduce_scatter, broadcast, reduce,
+    scatter, gather, send, recv, isend, irecv, barrier, batch_isend_irecv,
+    P2POp, wait, destroy_process_group, get_backend,
+)
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, is_initialized,
+)
+from .parallel import DataParallel, shard_batch  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+
+from ..parallel.mesh import init_mesh, get_mesh  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Analog of paddle.distributed.spawn. Single-controller SPMD: the function
+    runs once in-process with the global device view (multi-host uses
+    paddle_tpu.distributed.launch to start one process per host)."""
+    func(*args)

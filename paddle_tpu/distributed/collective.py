@@ -1,0 +1,374 @@
+"""Collective communication API.
+
+Analog of python/paddle/distributed/communication/ + collective.py and the C++
+ProcessGroup family (paddle/fluid/distributed/collective/process_group.h:53).
+
+TPU-native semantics (single-controller SPMD):
+- A Group is a VIEW ONTO A MESH AXIS, not a NCCL ring. Collectives inside
+  compiled/shard_map regions lower to XLA collectives over ICI
+  (psum/all_gather/ppermute/all_to_all) — the CommContext-in-kernel pattern
+  (paddle/phi/kernels/gpu/all_reduce_kernel.cu:36).
+- Outside shard_map, the same functions operate on GLOBAL (sharded or
+  replicated) arrays: jax's eager SPMD executes them with the same XLA
+  collectives under the hood, so the eager API keeps paddle's shape.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply
+from ..parallel import mesh as mesh_mod
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
+    "all_gather_object", "all_to_all", "reduce_scatter", "broadcast", "reduce",
+    "scatter", "gather", "send", "recv", "isend", "irecv", "barrier",
+    "batch_isend_irecv", "P2POp", "wait", "destroy_process_group",
+    "get_backend",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a mesh axis (or the whole mesh)."""
+
+    _next_id = 0
+
+    def __init__(self, axis: Optional[str], ranks=None, gid=None):
+        self.axis = axis  # None == all devices
+        self.ranks = ranks
+        Group._next_id += 1
+        self.id = gid if gid is not None else Group._next_id
+
+    @property
+    def nranks(self):
+        if self.axis is None:
+            mesh = mesh_mod.get_mesh()
+            return mesh.size if mesh is not None else len(jax.devices())
+        return mesh_mod.mesh_axis_size(self.axis)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        return 0  # single-controller: the process sees the global view
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return rank
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, nranks={self.nranks})"
+
+
+_groups = {}
+
+
+def _default_group() -> Group:
+    if 0 not in _groups:
+        _groups[0] = Group(None, gid=0)
+    return _groups[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis: Optional[str] = None) -> Group:
+    """paddle-compat group creation. TPU-native callers pass `axis=` to bind a
+    mesh axis; rank-list groups are mapped onto the mesh axis along which the
+    given ranks' coordinates vary (not just a size match)."""
+    if axis is None and ranks is not None:
+        axis = _axis_from_ranks(list(ranks))
+    g = Group(axis, ranks=ranks)
+    _groups[g.id] = g
+    return g
+
+
+def _axis_from_ranks(ranks) -> Optional[str]:
+    """Identify the mesh axis whose coordinate varies across `ranks` while all
+    other coordinates stay fixed (rank = C-order index into the mesh grid)."""
+    import numpy as np
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or not ranks:
+        return None
+    dims = [mesh.shape[a] for a in mesh.axis_names]
+    try:
+        coords = np.array([np.unravel_index(r, dims) for r in sorted(ranks)])
+    except ValueError:
+        return None
+    varying = [i for i in range(len(dims))
+               if len(set(coords[:, i].tolist())) > 1]
+    if len(varying) == 1 and len(ranks) == dims[varying[0]]:
+        return mesh.axis_names[varying[0]]
+    if len(ranks) == 1:
+        return None
+    # ambiguous (single rank spread over several axes, or partial axis): fall
+    # back to unique size match only
+    matches = [a for a in mesh.axis_names if mesh.shape[a] == len(ranks)]
+    return matches[0] if len(matches) == 1 else None
+
+
+def get_group(gid: int = 0) -> Group:
+    return _groups.get(gid, _default_group())
+
+
+def get_backend(group=None) -> str:
+    return "xla-ici"
+
+
+def destroy_process_group(group=None):
+    _groups.clear()
+
+
+def _axis_of(group) -> Optional[str]:
+    if group is not None and group.axis is not None:
+        return group.axis
+    # default/world group (or axis-less group): all non-trivial mesh axes
+    mesh = mesh_mod.get_mesh()
+    if mesh is None:
+        return None
+    names = [a for a in mesh.axis_names if mesh.shape[a] > 1]
+    return tuple(names) if len(names) > 1 else (names[0] if names else None)
+
+
+def _in_shard_map(axis) -> bool:
+    """True when `axis` is a bound named axis (i.e. we're inside shard_map)."""
+    try:
+        ax = axis if not isinstance(axis, tuple) else axis[0]
+        jax.lax.axis_size(ax)
+        return True
+    except (NameError, Exception):
+        return False
+
+
+def _u(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+class _Task:
+    """Async task handle (ProcessGroup::Task analog). XLA dispatch is already
+    async; wait() blocks on the result buffer."""
+
+    def __init__(self, tensor):
+        self._tensor = tensor
+
+    def wait(self):
+        if isinstance(self._tensor, Tensor):
+            self._tensor.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor.block_until_ready()
+
+
+# ---------------- collectives ----------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is None:
+        return _Task(tensor)  # single device / no mesh: identity
+    red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin,
+           ReduceOp.AVG: lambda v, a: jax.lax.pmean(v, a)}.get(op, jax.lax.psum)
+    if _in_shard_map(axis):
+        out = apply(lambda v: red(v, axis), tensor, op_name="all_reduce")
+        tensor._set_value(out._value)
+        tensor._grad_node, tensor._out_index = out._grad_node, out._out_index
+        tensor.stop_gradient = out.stop_gradient
+        return _Task(tensor)
+    # global view: psum over the axis via a pass-through shard_map
+    mesh = mesh_mod.get_mesh()
+    axes = axis if isinstance(axis, tuple) else (axis,)
+
+    def f(v):
+        spec = _replicated_spec(v.ndim)
+        fn = jax.shard_map(lambda x: red(x, axes),
+                           mesh=mesh, in_specs=spec, out_specs=spec)
+        return fn(v)
+    # On a replicated global array every shard is identical: psum multiplies by
+    # the axis size — matching per-rank all_reduce semantics.
+    out = apply(f, tensor, op_name="all_reduce")
+    tensor._set_value(out._value)
+    tensor._grad_node, tensor._out_index = out._grad_node, out._out_index
+    tensor.stop_gradient = out.stop_gradient
+    return _Task(tensor)
+
+
+def _replicated_spec(ndim):
+    return PartitionSpec(*([None] * ndim))
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis_concat=0):
+    axis = _axis_of(group)
+    n = group.nranks if group is not None else (
+        mesh_mod.get_mesh().size if mesh_mod.has_mesh() else 1)
+    if axis is None or n == 1:
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor)
+            return _Task(tensor)
+        return tensor
+    if _in_shard_map(axis):
+        gathered = apply(lambda v: jax.lax.all_gather(v, axis), tensor,
+                         op_name="all_gather")
+        if isinstance(tensor_list, list):
+            from ..ops.manip import unbind
+            tensor_list.extend(unbind(gathered, 0))
+            return _Task(tensor)
+        return gathered
+    # global view on replicated input: gather == stack n copies
+    from ..ops.manip import stack
+    gathered = stack([tensor] * n, axis=0)
+    if isinstance(tensor_list, list):
+        from ..ops.manip import unbind
+        tensor_list.extend(unbind(gathered, 0))
+        return _Task(tensor)
+    return gathered
+
+
+def all_gather_object(obj_list, obj, group=None):
+    n = group.nranks if group is not None else 1
+    obj_list.extend([obj] * max(n, 1))
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    axis = _axis_of(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, list):
+        from ..ops.manip import concat
+        src = concat(src, axis=0)
+    if axis is None:
+        tensor._set_value(_u(src))
+        return _Task(tensor)
+    if _in_shard_map(axis):
+        out = apply(lambda v: jax.lax.psum_scatter(v, axis, scatter_dimension=0,
+                                                   tiled=True),
+                    src, op_name="reduce_scatter")
+        tensor._set_value(out._value)
+        tensor._grad_node, tensor._out_index = out._grad_node, out._out_index
+        tensor.stop_gradient = out.stop_gradient
+        return _Task(tensor)
+    raise NotImplementedError("reduce_scatter outside shard_map: shard the "
+                              "tensor over the mesh axis instead (GSPMD)")
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # single-controller global view: every device already holds the value
+    return _Task(tensor)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor._set_value(_u(tensor_list[0 if src is None else 0]))
+    return _Task(tensor)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    out = []
+    all_gather(out, tensor, group=group)
+    if gather_list is not None:
+        gather_list.extend(out)
+    return _Task(tensor)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    axis = _axis_of(group)
+    from ..ops.manip import concat, split, unbind
+    from ..ops.manip import stack as stack_op
+    if axis is None:
+        out_tensor_list.extend(in_tensor_list)
+        return _Task(in_tensor_list[0] if in_tensor_list else None)
+    stacked = stack_op(list(in_tensor_list), axis=0)
+    if _in_shard_map(axis):
+        out = apply(lambda v: jax.lax.all_to_all(v, axis, split_axis=0,
+                                                 concat_axis=0, tiled=False),
+                    stacked, op_name="all_to_all")
+        out_tensor_list.extend(unbind(out, 0))
+        return _Task(out_tensor_list[0])
+    out_tensor_list.extend(in_tensor_list)
+    return _Task(in_tensor_list[0])
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+
+
+# ------------- p2p (pipeline edges) -------------
+
+def _shift(tensor, axis, offset):
+    """ppermute by offset along the axis (the send/recv pair fused as one
+    collective — how PP edges compile on ICI)."""
+    if not _in_shard_map(axis):
+        return tensor
+
+    def f(v):
+        n = jax.lax.axis_size(axis)
+        perm = [(i, (i + offset) % n) for i in range(n)]
+        return jax.lax.ppermute(v, axis, perm)
+    return apply(f, tensor, op_name="ppermute")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    axis = _axis_of(group)
+    return _Task(_shift(tensor, axis, +1))
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    axis = _axis_of(group)
+    out = _shift(tensor, axis, +1)
+    if out is not tensor:
+        tensor._set_value(out._value)
+    return _Task(tensor)
+
+
+def isend(tensor, dst, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=None, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def barrier(group=None):
+    for d in jax.devices():
+        pass
+    jnp.zeros(()).block_until_ready()
+    return None

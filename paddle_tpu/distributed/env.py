@@ -1,0 +1,94 @@
+"""Distributed environment bootstrap.
+
+Analog of paddle.distributed.init_parallel_env / ParallelEnv
+(python/paddle/distributed/parallel.py:925) and the TCPStore rendezvous
+(paddle/phi/core/distributed/store/tcp_store.h:120). On TPU pods the
+coordination service behind jax.distributed.initialize plays the TCPStore role;
+single-process SPMD over the local mesh needs no rendezvous at all.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..parallel import mesh as mesh_mod
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Analog of paddle.distributed.ParallelEnv (env-derived rank info)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", jax.process_index()))
+
+    @property
+    def device_id(self):
+        return self.local_rank
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+
+def init_parallel_env(mesh_shape: Optional[dict] = None):
+    """Initialize distribution.
+
+    - Multi-host (PADDLE_TRAINER_ENDPOINTS / coordinator env set): boots the
+      JAX distributed runtime (coordination-service rendezvous — the TCPStore
+      analog) so all hosts see the global device set.
+    - Then installs a global mesh: caller-provided shape, or 1-D "dp" over all
+      devices (pure data parallel, matching init_parallel_env semantics).
+    """
+    global _initialized
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+    if coord and nproc > 1 and not _initialized:
+        port = os.environ.get("MASTER_PORT", "8476")
+        jax.distributed.initialize(coordinator_address=f"{coord}:{port}",
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True
+    if mesh_mod.get_mesh() is None:
+        if mesh_shape is None:
+            mesh_shape = {"dp": len(jax.devices())}
+        mesh_mod.init_mesh(mesh_shape)
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized or mesh_mod.has_mesh()
+
+
+def get_rank(group=None) -> int:
+    """Process rank (multi-host) — single-controller SPMD has one process per
+    host; per-device 'rank' semantics live on mesh axes instead."""
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    # paddle semantics: number of parallel workers == number of devices
+    return len(jax.devices())
+
+
+def parallel_device_count() -> int:
+    return len(jax.devices())

@@ -1,0 +1,17 @@
+"""paddle_tpu.distributed.fleet — analog of python/paddle/distributed/fleet/."""
+from . import meta_parallel  # noqa: F401
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet import (  # noqa: F401
+    init, distributed_model, distributed_optimizer, worker_num, worker_index,
+    is_first_worker, get_hybrid_communicate_group,
+)
+from .hybrid_optimizer import HybridParallelOptimizer  # noqa: F401
+from .moe import MoELayer, NaiveGate, GShardGate, SwitchGate  # noqa: F401
+from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, ParallelMode, get_hcg, set_hcg,
+)
+
+# paddle-compat: fleet.utils.recompute
+class utils:  # noqa: N801
+    from .recompute import recompute, recompute_sequential  # noqa: F401

@@ -1,0 +1,108 @@
+"""Fleet facade.
+
+Analog of python/paddle/distributed/fleet/fleet.py (init:169,
+_init_hybrid_parallel_env:372, distributed_optimizer:1053) + fleet/model.py:30
+(distributed_model).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...optimizer.optimizer import Optimizer
+from ..env import init_parallel_env
+from .distributed_strategy import DistributedStrategy
+from .meta_parallel.parallel_wrappers import (
+    PipelineParallel, PipelineParallelWithInterleave, ShardingParallel,
+    TensorParallel,
+)
+from .meta_parallel.pp_layers import PipelineLayer
+from .topology import (
+    CommunicateTopology, HybridCommunicateGroup, ParallelMode, get_hcg, set_hcg,
+)
+
+
+class _FleetState:
+    def __init__(self):
+        self.strategy: Optional[DistributedStrategy] = None
+        self.is_collective = True
+        self.initialized = False
+
+
+_state = _FleetState()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    if strategy is None:
+        strategy = DistributedStrategy()
+    _state.strategy = strategy
+    _state.is_collective = is_collective
+
+    # multi-host rendezvous (jax.distributed / coordination service) must run
+    # BEFORE the mesh is built so jax.devices() covers the whole pod; the mesh
+    # itself is installed below by HybridCommunicateGroup
+    from ...parallel import mesh as mesh_mod
+    prev_mesh = mesh_mod.get_mesh()
+    init_parallel_env(mesh_shape=None)
+    mesh_mod.set_mesh(prev_mesh)  # undo init's default dp-mesh; HCG installs its own
+
+    hc = strategy.hybrid_configs
+    order = hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])
+    name_map = {"dp": "data", "pp": "pipe", "sharding": "sharding",
+                "sep": "sep", "mp": "model"}
+    deg = {"dp": hc.get("dp_degree", 1), "pp": hc.get("pp_degree", 1),
+           "sharding": hc.get("sharding_degree", 1), "sep": hc.get("sep_degree", 1),
+           "mp": hc.get("mp_degree", 1)}
+    names = [name_map[a] for a in order if a in name_map]
+    dims = [int(deg.get(a, 1)) for a in order if a in name_map]
+
+    topo = CommunicateTopology(names, dims)
+    hcg = HybridCommunicateGroup(topo)
+    set_hcg(hcg)
+    _state.initialized = True
+    return None
+
+
+def distributed_model(model):
+    """Wrap per parallel mode (fleet/model.py:30)."""
+    hcg = get_hcg()
+    if hcg is None:
+        return model
+    strategy = _state.strategy
+    mode = hcg.get_parallel_mode()
+    if mode == ParallelMode.DATA_PARALLEL:
+        from ..parallel import DataParallel
+        return DataParallel(model)
+    if mode == ParallelMode.PIPELINE_PARALLEL:
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, strategy)
+        raise TypeError("pipeline parallel requires a PipelineLayer model")
+    if mode == ParallelMode.SHARDING_PARALLEL:
+        return ShardingParallel(model, hcg, strategy)
+    return TensorParallel(model, hcg, strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    hcg = get_hcg()
+    if hcg is None:
+        return optimizer
+    from .hybrid_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, hcg, _state.strategy)
+
+
+# introspection API parity
+def worker_num():
+    from ..env import get_world_size
+    return get_world_size()
+
+
+def worker_index():
+    from ..env import get_rank
+    return get_rank()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def get_hybrid_communicate_group():
+    return get_hcg()

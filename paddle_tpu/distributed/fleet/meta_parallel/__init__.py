@@ -1,0 +1,20 @@
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy, ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+    ScatterOp, GatherOp, AllGatherOp, ReduceScatterOp,
+    mark_as_sequence_parallel_parameter, register_sequence_parallel_allreduce_hooks,
+)
+from .random_state import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .pp_layers import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer,
+)
+from .parallel_wrappers import (  # noqa: F401
+    TensorParallel, ShardingParallel, SegmentParallel, PipelineParallel,
+    PipelineParallelWithInterleave,
+)
+from .sharding_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer, GroupShardedOptimizerStage2, GroupShardedStage2,
+    GroupShardedStage3, group_sharded_parallel, save_group_sharded_model,
+)

@@ -1,0 +1,107 @@
+"""Model wrappers per parallel mode.
+
+Analogs of meta_parallel/{tensor_parallel.py:27, sharding_parallel.py,
+pipeline_parallel.py:132}. In the single-controller SPMD design the wrappers
+are thin: parameter broadcast is implicit (one global copy), grad sync is
+inserted by XLA from shardings, so the wrappers mainly carry API + the
+compiled-train-step integration.
+"""
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+from ....nn.layer.layers import Layer
+from .pp_layers import PipelineLayer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self.add_sublayer("_layers_holder", layers)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+class TensorParallel(MetaParallelBase):
+    """mp wrapper: reference broadcasts params inside the mp group at init
+    (tensor_parallel.py:27); global view needs no broadcast."""
+
+
+class ShardingParallel(MetaParallelBase):
+    pass
+
+
+class SegmentParallel(MetaParallelBase):
+    pass
+
+
+class PipelineParallel(MetaParallelBase):
+    """PP runtime (pipeline_parallel.py:132).
+
+    Eager `train_batch` runs the stages sequentially over microbatches
+    (numerically identical to 1F1B); the pipelined execution happens in the
+    compiled train step (parallel/pipeline.py spmd_pipeline), where the
+    schedule is one XLA program over the 'pp' mesh axis.
+    """
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        cfg = strategy.pipeline_configs if strategy is not None else {}
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", 1)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        x, y = data
+        n = self.accumulate_steps
+        bsz = x.shape[0]
+        mb = max(bsz // n, 1)
+        weighted = 0.0
+        for i in range(0, bsz, mb):
+            xi = x[i:i + mb]
+            yi = y[i:i + mb]
+            size = xi.shape[0]  # last microbatch may be smaller
+            out = self._layers(xi)
+            loss = self._layers._loss_fn(out, yi)
+            scaled = loss * (size / bsz)  # per-sample weight stays uniform
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            weighted += float(loss.numpy()) * size / bsz
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        from ....core.tensor import Tensor
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(weighted))
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x)
+        if compute_loss and self._layers._loss_fn is not None:
+            return self._layers._loss_fn(out, y)
+        return out
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """VPP (pipeline_parallel.py:822): same eager semantics; the compiled path
+    treats virtual stages as extra leading stage dim (round 2+ optimization)."""

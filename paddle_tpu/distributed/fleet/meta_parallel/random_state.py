@@ -1,0 +1,64 @@
+"""RNG state tracking across parallel axes.
+
+Analog of fleet/layers/mpu/random.py:34 RNGStatesTracker: named RNG states so
+e.g. dropout inside TP layers is identical across mp ranks but different across
+dp ranks. With JAX keys this is pure bookkeeping: a named registry of
+Generators plus a contextmanager to switch.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from ....core import generator as gen
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = gen.Generator(seed, name=name)
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            if n in self.states_:
+                self.states_[n].set_state(s)
+
+    @contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        g = self.states_[name]
+        with gen.key_override(g.next_key()):
+            yield
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import numpy as np
+    seed = seed if seed is not None else np.random.randint(0, 2**31)
+    _RNG_STATE_TRACKER.reset()
+    # same mp seed on every rank (single-controller: trivially true), distinct
+    # global seed stream
+    _RNG_STATE_TRACKER.add("model_parallel_rng", seed + 1)
+    _RNG_STATE_TRACKER.add("global_seed", seed)
+    gen.seed(seed)

@@ -1,0 +1,139 @@
+"""ZeRO sharding stages.
+
+Analogs:
+- stage 1: DygraphShardingOptimizer (dygraph_optimizer/dygraph_sharding_optimizer.py:39)
+- stage 2: GroupShardedStage2 + GroupShardedOptimizerStage2 (sharding/group_sharded_stage2.py:46)
+- stage 3: GroupShardedStage3 (sharding/group_sharded_stage3.py:59)
+- facade:  group_sharded_parallel (distributed/sharding/group_sharded.py:37)
+
+TPU-native mapping: the reference manually partitions params/grads/opt-states
+across ranks and re-gathers with broadcasts/hooks. Under GSPMD the same memory
+win is a SHARDING SPEC: stage 1/2 shard optimizer state (and grads) over the
+'sharding' axis, stage 3 shards the parameters themselves (≈FSDP). The
+compiled train step (parallel/trainer.py) reads `optimizer._shard_stage` and
+annotates the corresponding pytrees; XLA inserts the reduce-scatter /
+all-gather pairs the reference implements as reduce-to-owner + broadcast.
+The eager wrapper keeps the reference API shape for porting.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ....optimizer.optimizer import Optimizer
+
+SHARDING_AXIS = "sharding"
+
+
+class DygraphShardingOptimizer:
+    """Stage-1 wrapper: optimizer states sharded over the sharding axis."""
+
+    def __init__(self, optimizer: Optimizer, hcg=None):
+        self._inner_opt = optimizer
+        optimizer._shard_stage = 1
+        optimizer._shard_axis = SHARDING_AXIS
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+
+class GroupShardedOptimizerStage2:
+    def __init__(self, params, optim: Optimizer, group=None, offload=False,
+                 device="tpu", **kw):
+        self._optim = optim
+        optim._shard_stage = 2
+        optim._shard_axis = SHARDING_AXIS
+
+    def __getattr__(self, item):
+        return getattr(self._optim, item)
+
+    def step(self):
+        self._optim.step()
+
+
+class GroupShardedStage2:
+    """Stage-2 model wrapper: grads reduce-scattered over the sharding axis."""
+
+    def __init__(self, layer, sharding_optimizer, group=None, sync_buffers=False,
+                 buffer_max_size=2 ** 23, auto_refresh_trainable=True,
+                 device="tpu", **kw):
+        self._layer = layer
+        self._sharding_optimizer = sharding_optimizer
+
+    def __call__(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._layer, item)
+
+    def forward(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+
+class GroupShardedStage3:
+    """Stage-3 (FSDP): parameters themselves sharded; re-gather at use is the
+    all-gather XLA inserts from the param spec (replaces fwd pre/post hooks,
+    group_sharded_stage3.py:59)."""
+
+    def __init__(self, layer, optimizer, group=None, sync_buffers=False,
+                 device="tpu", segment_size=2 ** 20, offload=False, **kw):
+        self._layer = layer
+        self._optimizer = optimizer
+        optimizer._shard_stage = 3
+        optimizer._shard_axis = SHARDING_AXIS
+        # annotate every trainable param for FSDP-style sharding along its
+        # largest dim
+        for p in layer.parameters():
+            if p._sharding is None and p.ndim >= 1:
+                dims = list(p.shape)
+                big = int(max(range(len(dims)), key=lambda i: dims[i]))
+                spec = [None] * len(dims)
+                spec[big] = SHARDING_AXIS
+                p._sharding = tuple(spec)
+
+    def __call__(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._layer, item)
+
+    def forward(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    """Facade (group_sharded.py:37). level: 'os' | 'os_g' | 'p_g_os'."""
+    if level == "os":
+        opt = DygraphShardingOptimizer(optimizer)
+        return model, opt, scaler
+    if level == "os_g":
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer, group)
+        model = GroupShardedStage2(model, opt, group)
+        return model, opt, scaler
+    if level == "p_g_os":
+        model = GroupShardedStage3(model, optimizer, group)
+        return model, optimizer, scaler
+    raise ValueError(f"unknown group_sharded level {level!r}")
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+    from ....framework_io import save
+    os.makedirs(output, exist_ok=True)
+    layer = getattr(model, "_layer", model)
+    save(layer.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        inner = getattr(optimizer, "_optim", getattr(optimizer, "_inner_opt", optimizer))
+        save(inner.state_dict(), os.path.join(output, "model.pdopt"))

@@ -1,0 +1,155 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Analog of python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+(MoELayer + gshard/switch/naive gates; dispatch via global_scatter/
+global_gather collective ops, moe_layer.py:119,140).
+
+TPU-native design: dense dispatch/combine einsums over a capacity-bucketed
+one-hot routing tensor; experts' weights carry an 'ep' (expert-parallel)
+sharding spec on the expert dim. Under GSPMD the dispatch einsum against
+ep-sharded experts lowers to the all-to-all that global_scatter implements
+manually — and stays fused with the expert matmuls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import generator as gen
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn.initializer import XavierNormal
+from ...nn.layer.layers import Layer
+from ...ops.dispatch import apply
+from .meta_parallel.mp_layers import shard_constraint_t
+
+EP_AXIS = "ep"
+
+
+class NaiveGate(Layer):
+    def __init__(self, d_model, num_experts, top_k=2):
+        super().__init__()
+        self.top_k = top_k
+        self.num_experts = num_experts
+        self.weight = self.create_parameter([d_model, num_experts])
+        XavierNormal()(self.weight)
+
+    def forward(self, x):
+        return F.linear(x, self.weight)
+
+
+class GShardGate(NaiveGate):
+    """gshard gate w/ aux load-balancing loss (moe/gate/gshard_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity=(1.2, 2.4)):
+        super().__init__(d_model, num_experts, top_k)
+        self.capacity_factor = capacity[0]
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model, num_experts, top_k=1, capacity=(1.2, 2.4)):
+        super().__init__(d_model, num_experts, 1)
+        self.capacity_factor = capacity[0]
+
+
+class MoELayer(Layer):
+    """MoE block: gate -> capacity-bucketed dispatch -> experts -> combine.
+
+    experts: a list of Layers (applied vectorized: their params are stacked on
+    an expert dim and the expert matmuls batch over it).
+    """
+
+    def __init__(self, d_model, experts=None, gate=None, num_experts=None,
+                 top_k=2, capacity_factor=1.25, moe_group=None, recompute_interval=0,
+                 expert_fn=None, d_hidden=None):
+        super().__init__()
+        self.d_model = d_model
+        if experts is not None:
+            self.num_experts = len(experts)
+            from ...nn.layer.container import LayerList
+            self.experts = LayerList(experts)
+        else:
+            assert num_experts and d_hidden
+            from ...nn.layer.container import LayerList
+            from ...nn.layer.common import Linear
+            from ...nn.layer.activation import GELU
+            from ...nn.layer.container import Sequential
+            self.num_experts = num_experts
+            self.experts = LayerList([
+                Sequential(Linear(d_model, d_hidden), GELU(), Linear(d_hidden, d_model))
+                for _ in range(num_experts)])
+        if gate is None or gate == "gshard":
+            self.gate = GShardGate(d_model, self.num_experts, top_k)
+        elif gate == "switch":
+            self.gate = SwitchGate(d_model, self.num_experts)
+        elif gate == "naive":
+            self.gate = NaiveGate(d_model, self.num_experts, top_k)
+        else:
+            self.gate = gate
+        self.top_k = getattr(self.gate, "top_k", top_k)
+        self.capacity_factor = capacity_factor
+        self.l_aux = None
+
+    def forward(self, x):
+        """x: [batch, seq, d] or [tokens, d]."""
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        from ...ops.manip import reshape
+        tokens = reshape(x, [-1, d])
+        logits = self.gate(tokens)  # [T, E]
+
+        T = tokens.shape[0]
+        E = self.num_experts
+        capacity = int(np.ceil(self.capacity_factor * T * self.top_k / E))
+        capacity = max(capacity, self.top_k)
+
+        def route(lg):
+            probs = jax.nn.softmax(lg, -1)
+            topv, topi = jax.lax.top_k(probs, self.top_k)  # [T, k]
+            # normalized combine weights
+            topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+            # position of each (token, k) within its expert queue
+            onehot = jax.nn.one_hot(topi, E, dtype=jnp.int32)  # [T, k, E]
+            flat = onehot.reshape(T * self.top_k, E)
+            pos = jnp.cumsum(flat, 0) - flat  # positions before this slot
+            pos = (pos * flat).sum(-1).reshape(T, self.top_k)
+            keep = pos < capacity
+            # dispatch tensor [T, E, C]
+            disp = jnp.zeros((T, E, capacity), probs.dtype)
+            tok_idx = jnp.arange(T)[:, None].repeat(self.top_k, 1)
+            disp = disp.at[tok_idx.reshape(-1),
+                           topi.reshape(-1),
+                           jnp.clip(pos, 0, capacity - 1).reshape(-1)].add(
+                jnp.where(keep, 1.0, 0.0).reshape(-1).astype(probs.dtype))
+            # combine weights: same sparsity pattern scaled by gate prob
+            w = jnp.zeros((T, E, capacity), probs.dtype)
+            w = w.at[tok_idx.reshape(-1), topi.reshape(-1),
+                     jnp.clip(pos, 0, capacity - 1).reshape(-1)].add(
+                (jnp.where(keep, 1.0, 0.0) * topv).reshape(-1).astype(probs.dtype))
+            # aux load-balancing loss (gshard)
+            me = probs.mean(0)
+            ce = flat.reshape(T, self.top_k, E)[:, 0, :].astype(probs.dtype).mean(0)
+            l_aux = (me * ce).sum() * E
+            return disp, w, l_aux
+
+        out = apply(route, logits, op_name="moe_route")
+        disp, comb, l_aux = out[0], out[1], out[2]
+        self.l_aux = l_aux
+
+        # dispatch: [E, C, d] expert inputs
+        exp_in = apply(lambda dd, tt: jnp.einsum("tec,td->ecd", dd, tt),
+                       disp, tokens, op_name="moe_dispatch")
+        exp_in = shard_constraint_t(exp_in, EP_AXIS, None, None)
+
+        # run experts (global view: loop; expert dim sharded in compiled path)
+        from ...ops.manip import unbind, stack as stack_op
+        pieces = unbind(exp_in, 0)
+        outs = [self.experts[e](pieces[e]) for e in range(E)]
+        exp_out = stack_op(outs, axis=0)  # [E, C, d]
+        exp_out = shard_constraint_t(exp_out, EP_AXIS, None, None)
+
+        # combine back to tokens
+        mixed = apply(lambda ww, ee: jnp.einsum("tec,ecd->td", ww, ee),
+                      comb, exp_out, op_name="moe_combine")
+        return reshape(mixed, list(orig_shape))

@@ -1,0 +1,114 @@
+"""Recompute (activation checkpointing).
+
+Analog of fleet/recompute/recompute.py:69 (RecomputeFunction PyLayer) +
+recompute_hybrid.py. Two paths:
+- traced/compiled: jax.checkpoint — XLA rematerializes, which is the whole
+  point on TPU (trade FLOPs for HBM).
+- eager: a PyLayer that stores only inputs and re-runs the function under an
+  inner tape in backward, replaying RNG state for dropout determinism
+  (swith_rng_state_tracker analog).
+"""
+from __future__ import annotations
+
+import jax
+
+from ...autograd.backward import grad as grad_api
+from ...autograd.grad_mode import enable_grad, no_grad
+from ...autograd.py_layer import PyLayer
+from ...core import generator as gen
+from ...core.tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    # under jax tracing, defer to jax.checkpoint (compiled remat)
+    if any(isinstance(a, Tensor) and isinstance(a._value, jax.core.Tracer)
+           for a in args):
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+        def pure(*vals):
+            wrapped = []
+            it = iter(vals)
+            for a in args:
+                wrapped.append(Tensor(next(it)) if isinstance(a, Tensor) else a)
+            out = function(*wrapped, **kwargs)
+            return out._value if isinstance(out, Tensor) else \
+                tuple(o._value for o in out)
+        ck = jax.checkpoint(pure)
+        from ...ops.dispatch import apply
+        return apply(ck, *tensor_args, op_name="recompute")
+
+    rng_state = gen.default_generator().get_state() if preserve_rng_state else None
+
+    class _Recompute(PyLayer):
+        @staticmethod
+        def forward(ctx, *tensor_inputs):
+            ctx.save_for_backward(*tensor_inputs)
+            ctx.rng_state = rng_state
+            with no_grad():
+                out = function(*tensor_inputs, **kwargs)
+            return out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            inputs = [t.detach() for t in ctx.saved_tensor]
+            for t, orig in zip(inputs, ctx.saved_tensor):
+                t.stop_gradient = orig.stop_gradient
+            if ctx.rng_state is not None:
+                saved_now = gen.default_generator().get_state()
+                gen.default_generator().set_state(ctx.rng_state)
+            try:
+                with enable_grad():
+                    out = function(*inputs, **kwargs)
+            finally:
+                if ctx.rng_state is not None:
+                    gen.default_generator().set_state(saved_now)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            diff_inputs = [t for t in inputs if not t.stop_gradient]
+            gs = grad_api(list(outs), diff_inputs,
+                          grad_outputs=list(grads), allow_unused=True)
+            gi = iter(gs)
+            return tuple(next(gi) if not t.stop_gradient else None for t in inputs)
+
+    tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+    if len(tensor_inputs) != len(args):
+        # keep PyLayer simple: only tensor args flow through it; close over rest
+        idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        saved_fn = function
+        saved_kwargs = dict(kwargs)
+
+        def fn2(*tensors, **_ignored):
+            full = list(args)
+            for k, i in enumerate(idx):
+                full[i] = tensors[k]
+            return saved_fn(*full, **saved_kwargs)
+        function = fn2
+        kwargs = {}
+        return _Recompute.apply(*tensor_inputs)
+    return _Recompute.apply(*args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    seg_size = max(len(funcs) // max(segments, 1), 1)
+    out = args[0] if len(args) == 1 else args
+
+    def run_segment(fs):
+        def seg(x):
+            for f in fs:
+                x = f(x)
+            return x
+        return seg
+    i = 0
+    while i < len(funcs):
+        fs = funcs[i:i + seg_size]
+        out = recompute(run_segment(fs), out)
+        i += seg_size
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,183 @@
+"""Hybrid-parallel topology.
+
+Analog of CommunicateTopology / HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py:60,146). The 4-D (dp, pp,
+sharding, mp) process grid becomes a named jax Mesh; per-axis "groups" are axis
+views used by the strategy layers and by shard_map programs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...parallel import mesh as mesh_mod
+from ..collective import Group, new_group
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self._world_size = int(np.prod(self._dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[n] for n in self._parallel_names]
+        return int(np.ravel_multi_index(coords, self._dims))
+
+    def get_coord(self, rank):
+        return tuple(int(c) for c in np.unravel_index(rank, self._dims))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        ranks = []
+        for r in range(self._world_size):
+            if self.get_coord(r)[axis] == index:
+                ranks.append(r)
+        return ranks
+
+    def get_comm_list(self, axis_name):
+        """All rank-groups along `axis_name` (one per combination of the other
+        coordinates) — mirrors topology.py get_comm_list."""
+        axis = self._parallel_names.index(axis_name)
+        others = [i for i in range(len(self._dims)) if i != axis]
+        out = []
+        for combo in np.ndindex(*[self._dims[i] for i in others]):
+            ranks = []
+            for k in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, o in enumerate(others):
+                    coord[o] = combo[i]
+                coord[axis] = k
+                ranks.append(int(np.ravel_multi_index(coord, self._dims)))
+            out.append(ranks)
+        return out
+
+
+# fleet axis name -> mesh axis name
+_AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp",
+             "sep": "sep"}
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        self.nranks = topology.world_size()
+
+        self._dp_degree = topology.get_dim("data") if "data" in names else 1
+        self._pp_degree = topology.get_dim("pipe") if "pipe" in names else 1
+        self._sharding_degree = topology.get_dim("sharding") if "sharding" in names else 1
+        self._mp_degree = topology.get_dim("model") if "model" in names else 1
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+
+        # install the global mesh with fleet's canonical order
+        mesh_shape = {}
+        for n, d in zip(names, dims):
+            mesh_shape[_AXIS_MAP.get(n, n)] = d
+        self._mesh = mesh_mod.init_mesh(mesh_shape)
+
+        self._dp_group = new_group(axis="dp")
+        self._pp_group = new_group(axis="pp")
+        self._sharding_group = new_group(axis="sharding")
+        self._mp_group = new_group(axis="mp")
+        self._sep_group = new_group(axis="sep") if self._sep_degree > 1 else None
+
+    # --- degrees / world info ---
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._sharding_degree == 1:
+            return ParallelMode.DATA_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1 and self._mp_degree == 1:
+            return ParallelMode.SHARDING_PARALLEL
+        return ParallelMode.TENSOR_PARALLEL
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    # single-controller: ranks are coordinates in the global view
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    # --- groups ---
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._mp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def topology(self):
+        return self._topo
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hcg(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hcg() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -1,0 +1,60 @@
+"""DataParallel.
+
+Analog of python/paddle/distributed/parallel.py:201. The reference wires an
+EagerReducer doing bucketed NCCL all-reduce from backward hooks
+(collective/reducer.h:88). Global-view SPMD needs neither: sharding the input
+batch over the 'dp' mesh axis makes XLA insert the gradient all-reduce (as a
+fused reduce inside the backward), overlapping it with compute on ICI.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+from ..parallel import mesh as mesh_mod
+
+
+def shard_batch(x, axis="dp", dim=0):
+    """Place a global batch sharded over the dp axis."""
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return x
+    spec = [None] * x.ndim
+    spec[dim] = axis
+    sharding = NamedSharding(mesh, PartitionSpec(*spec))
+    if isinstance(x._value, jax.core.Tracer):
+        return apply(lambda v: jax.lax.with_sharding_constraint(v, sharding),
+                     x, op_name="shard_batch")
+    out = Tensor(jax.device_put(x._value, sharding),
+                 stop_gradient=x.stop_gradient)
+    out._grad_node, out._out_index = x._grad_node, x._out_index
+    return out
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers_holder", layers)
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        sharded = [shard_batch(i) if isinstance(i, Tensor) else i for i in inputs]
+        return self._layers(*sharded, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def scale_loss(self, loss):
+        return loss  # global mean already includes the 1/world factor
+
+    def apply_collective_grads(self):
+        pass  # XLA inserts the reduction

@@ -1,0 +1,5 @@
+"""paddle.distributed.sharding facade — re-exports the group_sharded API
+(analog of python/paddle/distributed/sharding/group_sharded.py)."""
+from .fleet.meta_parallel.sharding_optimizer import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model,
+)

@@ -1,0 +1,5 @@
+"""Model zoo covering the BASELINE configs (SURVEY.md §6)."""
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaDecoderLayer,
+    build_hybrid_train_step,
+)

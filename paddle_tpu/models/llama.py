@@ -1,0 +1,469 @@
+"""LLaMA model family — the flagship (BASELINE config 5: LLaMA-7B pretrain
+under hybrid parallel; reference: PaddleNLP llama + fleet meta_parallel).
+
+Layers use the TP building blocks (VocabParallelEmbedding, Column/Row
+ParallelLinear) so one model definition runs:
+- single device (specs degrade to no-ops),
+- tp/sp via GSPMD sharding constraints over the 'mp' axis,
+- dp via batch sharding,
+- pp via `build_hybrid_train_step` which stacks decoder-block params on a
+  leading stage dim and runs them through parallel/pipeline.spmd_pipeline
+  (shard_map + ppermute over the 'pp' axis, manual; dp/mp stay GSPMD-auto).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import generator as gen
+from ..core.tensor import Parameter, Tensor
+from ..autograd.grad_mode import no_grad
+from ..nn import functional as F
+from ..nn.layer.common import Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+from ..ops.dispatch import apply
+from ..ops import manip
+from ..parallel import mesh as mesh_mod
+from ..parallel.pipeline import spmd_pipeline
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    shard_constraint_t,
+)
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None
+    max_position_embeddings: int = 2048
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    sequence_parallel: bool = False
+    recompute: bool = False
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @staticmethod
+    def llama_7b():
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab=128, hidden=64, layers=2, heads=4, inter=128, seq=64):
+        return LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                           intermediate_size=inter, num_hidden_layers=layers,
+                           num_attention_heads=heads,
+                           max_position_embeddings=seq)
+
+
+def _rope(q, k, theta, position_offset=0):
+    """Rotary embeddings on [B, S, H, D] (fp32 trig, matches reference
+    fused_rotary_position_embedding semantics)."""
+    b, s, h, d = q.shape
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    pos = jnp.arange(position_offset, position_offset + s, dtype=jnp.float32)
+    freqs = jnp.outer(pos, inv)  # [S, D/2]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+
+    def rot(x):
+        x1 = x[..., 0::2].astype(jnp.float32)
+        x2 = x[..., 1::2].astype(jnp.float32)
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+        return out.astype(x.dtype)
+    return rot(q), rot(k)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = h // self.num_heads
+        kv_out = self.num_kv_heads * self.head_dim
+        self.q_proj = ColumnParallelLinear(h, h, has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, kv_out, has_bias=False,
+                                           gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, kv_out, has_bias=False,
+                                           gather_output=False)
+        self.o_proj = RowParallelLinear(h, h, has_bias=False, input_is_parallel=True)
+
+    def forward(self, x, position_offset=0):
+        b, s = x.shape[0], x.shape[1]
+        q = manip.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = manip.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        v = manip.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        out = apply(lambda qq, kk: _rope(qq, kk, self.config.rope_theta,
+                                         position_offset),
+                    q, k, op_name="rope")
+        q, k = out[0], out[1]
+        # heads sharded over mp
+        q = shard_constraint_t(q, None, None, "mp", None)
+        k = shard_constraint_t(k, None, None, "mp", None)
+        v = shard_constraint_t(v, None, None, "mp", None)
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        attn = manip.reshape(attn, [b, s, self.num_heads * self.head_dim])
+        return self.o_proj(attn)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, m, has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, m, has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(m, h, has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+        self._seq_parallel = config.sequence_parallel
+
+    def forward(self, x):
+        if self._seq_parallel:
+            x = shard_constraint_t(x, None, "mp", None)  # Megatron-SP resident
+        h = x + self.self_attn(self.input_layernorm(x))
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        if self._seq_parallel:
+            out = shard_constraint_t(out, None, "mp", None)
+        return out
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        x = shard_constraint_t(x, "dp", None, None)
+        for i, layer in enumerate(self.layers):
+            if self.config.recompute:
+                from ..distributed.fleet.recompute import recompute
+                x = recompute(layer, x)
+            else:
+                x = layer(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                            has_bias=False, gather_output=True)
+
+    def forward(self, input_ids):
+        h = self.llama(input_ids)
+        return self.lm_head(h)
+
+    def compute_loss(self, input_ids, labels):
+        logits = self.forward(input_ids)
+        loss = F.cross_entropy(logits, labels, reduction="mean")
+        return loss
+
+    @no_grad()
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0):
+        """Greedy / temperature sampling (full-recompute decode; KV cache is a
+        round-2 optimization)."""
+        ids = input_ids
+        for _ in range(max_new_tokens):
+            logits = self.forward(ids)
+            last = logits[:, -1, :]
+            if temperature and temperature > 0.0:
+                probs = F.softmax(last / temperature, axis=-1)
+                from ..ops.random import multinomial
+                nxt = multinomial(probs, 1)
+            else:
+                from ..ops.math import argmax
+                nxt = manip.unsqueeze(argmax(last, axis=-1), -1)
+            ids = manip.concat([ids, nxt.astype(ids.dtype)], axis=1)
+        return ids
+
+
+# ---------------------------------------------------------------------------
+# Hybrid-parallel compiled train step (dp × pp × mp [+ sharding])
+# ---------------------------------------------------------------------------
+
+def _tree_of_params(layer):
+    names, params = [], []
+    for n, p in layer.named_parameters():
+        names.append(n)
+        params.append(p)
+    return names, params
+
+
+def _call_with_params(layer, names, vals, fn):
+    params = [p for _, p in layer.named_parameters()]
+    saved = [p._value for p in params]
+    try:
+        for p, v in zip(params, vals):
+            p._value = v
+        return fn()
+    finally:
+        for p, v in zip(params, saved):
+            p._value = v
+
+
+def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
+                            n_microbatches: int = 1, remat: bool = True):
+    """Build a fully-compiled hybrid train step.
+
+    The decoder blocks' params are stacked on a leading dim of size L and
+    - pp == 1: consumed via lax.scan over layers (fast compile),
+    - pp  > 1: sharded over 'pp' (layers grouped into stages) and executed by
+      spmd_pipeline (GPipe schedule compiled into one XLA program).
+    Embedding / final norm / lm head run outside the pipeline in GSPMD.
+    Returns step(batch_dict) -> loss Tensor.
+    """
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+    cfg = model.config
+    L = cfg.num_hidden_layers
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    assert L % max(pp, 1) == 0, "layers must divide pp degree"
+
+    block0 = model.llama.layers[0]
+    block_names, _ = _tree_of_params(block0)
+
+    # stack per-layer params: dict name -> [L, ...]
+    stacked = {}
+    for n in block_names:
+        vals = []
+        for li in range(L):
+            blk = model.llama.layers[li]
+            vals.append(dict(blk.named_parameters())[n]._value)
+        stacked[n] = jnp.stack(vals, 0)
+
+    # non-block params
+    outer_names, outer_params = [], []
+    for n, p in model.named_parameters():
+        if ".layers." in n:
+            continue
+        outer_names.append(n)
+        outer_params.append(p)
+
+    def block_apply(pvals_dict, x):
+        """Pure: run one decoder block with given param values."""
+        vals = [pvals_dict[n] for n in block_names]
+        return _call_with_params(
+            block0, block_names, vals,
+            lambda: block0(Tensor(x))._value)
+
+    def blocks_scan(stacked_vals, x):
+        def body(carry, layer_params):
+            return block_apply(layer_params, carry), None
+        fn = jax.checkpoint(body) if remat else body
+        out, _ = jax.lax.scan(fn, x, stacked_vals)
+        return out
+
+    def stage_fn(stage_params, x):
+        # stage_params: dict name -> [L/pp, ...]
+        return blocks_scan(stage_params, x)
+
+    def outer_apply(outer_vals, fn):
+        saved = [p._value for p in outer_params]
+        try:
+            for p, v in zip(outer_params, outer_vals):
+                p._value = v
+            return fn()
+        finally:
+            for p, v in zip(outer_params, saved):
+                p._value = v
+
+    def loss_fn(params, batch, rng):
+        outer_vals, stacked_vals = params
+        ids, labels = batch["input_ids"], batch["labels"]
+
+        with gen.key_override(rng), no_grad():
+            def run():
+                x = model.llama.embed_tokens(Tensor(ids))._value
+                x = mesh_mod.shard_constraint(x, "dp", None, None)
+                if pp > 1:
+                    b, s, h = x.shape
+                    assert b % n_microbatches == 0
+                    mb = b // n_microbatches
+                    x_mb = x.reshape(n_microbatches, mb, s, h)
+                    y_mb = spmd_pipeline(stage_fn, stacked_vals, x_mb,
+                                         n_microbatches=n_microbatches,
+                                         mesh=mesh, remat=remat)
+                    x2 = y_mb.reshape(b, s, h)
+                else:
+                    x2 = blocks_scan(stacked_vals, x)
+                h_out = model.llama.norm(Tensor(x2))
+                logits = model.lm_head(h_out)
+                loss = F.cross_entropy(logits, Tensor(labels), reduction="mean")
+                return loss._value
+            return outer_apply(outer_vals, run)
+
+    # shardings
+    def stacked_spec(name, arr):
+        # leading L dim over pp; inner dims follow the layer's TP spec
+        p = dict(block0.named_parameters())[name]
+        inner = _clean_spec(getattr(p, "_sharding", None), arr.ndim - 1, mesh)
+        lead = "pp" if (mesh is not None and mesh.shape.get("pp", 1) > 1) else None
+        return PartitionSpec(lead, *inner) if mesh is not None else None
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def _clean_spec(spec, ndim, mesh):
+        out = []
+        spec = spec or ()
+        for i in range(ndim):
+            s = spec[i] if i < len(spec) else None
+            if s is not None and mesh is not None and s in mesh.axis_names \
+                    and mesh.shape[s] > 1:
+                out.append(s)
+            else:
+                out.append(None)
+        return out
+
+    if mesh is not None:
+        outer_sh = [NamedSharding(mesh, PartitionSpec(
+            *_clean_spec(getattr(p, "_sharding", None), p._value.ndim, mesh)))
+            for p in outer_params]
+        stacked_sh = {n: NamedSharding(mesh, stacked_spec(n, a))
+                      for n, a in stacked.items()}
+        outer_vals = [jax.device_put(p._value, s)
+                      for p, s in zip(outer_params, outer_sh)]
+        stacked = {n: jax.device_put(a, stacked_sh[n])
+                   for n, a in stacked.items()}
+    else:
+        outer_sh, stacked_sh = None, None
+        outer_vals = [p._value for p in outer_params]
+
+    params = (outer_vals, stacked)
+
+    base_opt = optimizer
+    while hasattr(base_opt, "inner_opt"):
+        base_opt = base_opt.inner_opt
+    _, opt_update = base_opt.functional_update()
+
+    def init_state(tree):
+        return jax.tree_util.tree_map(
+            lambda v: base_opt._init_state(Parameter(v)), tree,
+            is_leaf=lambda x: hasattr(x, "shape") and not isinstance(x, dict))
+    opt_state = init_state(params)
+
+    # ZeRO: shard optimizer-state leaves over the sharding axis (stage >= 1)
+    zero_axis = getattr(base_opt, "_shard_axis", None)
+    zero_stage = getattr(base_opt, "_shard_stage", 0)
+    if mesh is not None and zero_axis and zero_stage >= 1 \
+            and mesh.shape.get(zero_axis, 1) > 1:
+        from ..parallel.trainer import _zero_state_spec
+
+        def shard_states(state_tree, sharding_tree):
+            flat_s, sdef = jax.tree_util.tree_flatten(
+                state_tree, is_leaf=lambda x: isinstance(x, dict)
+                and all(hasattr(v, "shape") for v in x.values()))
+            flat_sh = jax.tree_util.tree_flatten(
+                sharding_tree, is_leaf=lambda x: isinstance(x, NamedSharding))[0]
+            out = []
+            for st, psh in zip(flat_s, flat_sh):
+                new = {}
+                for k, v in st.items():
+                    spec = _zero_state_spec(psh.spec, v.shape, zero_axis, mesh)
+                    new[k] = jax.device_put(v, NamedSharding(mesh, spec))
+                out.append(new)
+            return sdef.unflatten(out)
+
+        opt_state = (shard_states(opt_state[0], outer_sh),
+                     shard_states(opt_state[1], stacked_sh))
+
+    def pure_step(param_vals, opt_st, batch, lr, step, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(param_vals, batch, rng)
+        clip = getattr(base_opt, "_grad_clip", None)
+        if clip is not None:
+            from ..nn.clip import ClipGradByGlobalNorm
+            if isinstance(clip, ClipGradByGlobalNorm):
+                leaves = jax.tree_util.tree_leaves(grads)
+                gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                  for g in leaves))
+                scale = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * scale.astype(g.dtype), grads)
+        flat_p, tdef = jax.tree_util.tree_flatten(param_vals)
+        flat_g = jax.tree_util.tree_flatten(grads)[0]
+        flat_s = tdef.flatten_up_to(opt_st)
+        outs = []
+        for v, g, s in zip(flat_p, flat_g, flat_s):
+            s = dict(s)
+            s["__step__"] = step
+            wd = base_opt._weight_decay
+            nv, ns = base_opt._update_rule(
+                v, g.astype(v.dtype), s, lr,
+                0.0 if wd is None or callable(wd) else wd)
+            ns.pop("__step__", None)
+            outs.append((nv, ns))
+        new_p = tdef.unflatten([o[0] for o in outs])
+        new_s = tdef.unflatten([o[1] for o in outs])
+        return loss, new_p, new_s
+
+    jitted = jax.jit(pure_step, donate_argnums=(0, 1))
+
+    state = {"params": params, "opt": opt_state, "step": 0}
+
+    def step(batch):
+        vals = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                for k, v in batch.items()}
+        if mesh is not None and mesh.shape.get("dp", 1) > 1:
+            dp_sh = NamedSharding(mesh, PartitionSpec("dp"))
+            vals = {k: jax.device_put(v, dp_sh) for k, v in vals.items()}
+        state["step"] += 1
+        lr = jnp.asarray(base_opt.get_lr(), jnp.float32)
+        st = jnp.asarray(state["step"], jnp.int32)
+        rng = gen.next_key()
+        loss, state["params"], state["opt"] = jitted(
+            state["params"], state["opt"], vals, lr, st, rng)
+        return Tensor(loss)
+
+    step.state = state
+    step.write_back = lambda: _write_back(model, state["params"], outer_names,
+                                          outer_params, block_names)
+    return step
+
+
+def _write_back(model, params, outer_names, outer_params, block_names):
+    """Copy trained values back into the model's Parameters (real copies:
+    the step's own buffers get donated on the next call)."""
+    outer_vals, stacked = params
+    for p, v in zip(outer_params, outer_vals):
+        p._value = jnp.copy(v)
+    L = model.config.num_hidden_layers
+    for n in block_names:
+        layer_vals = jnp.copy(stacked[n])
+        for li in range(L):
+            dict(model.llama.layers[li].named_parameters())[n]._value = layer_vals[li]

@@ -1,0 +1,6 @@
+"""paddle_tpu.parallel — mesh/SPMD machinery (the TPU-native core that the
+paddle-shaped `paddle_tpu.distributed` API rides on)."""
+from .mesh import (  # noqa: F401
+    init_mesh, get_mesh, set_mesh, mesh_axis_size, has_mesh, axis_index,
+)
+from .trainer import compile_train_step, TrainStep  # noqa: F401

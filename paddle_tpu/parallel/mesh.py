@@ -1,0 +1,122 @@
+"""Global device-mesh management.
+
+TPU-native replacement for the reference's CommunicateTopology /
+HybridCommunicateGroup (python/paddle/distributed/fleet/base/topology.py:60,146)
+and the ProcessGroup ring registry: instead of per-ring NCCL communicators,
+a single jax.sharding.Mesh whose named axes (dp, pp, sharding, mp, sp, ep)
+carry XLA collectives over ICI; groups are views onto mesh axes.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_state = threading.local()
+
+# canonical hybrid-parallel axis order, outermost (slowest, DCN-friendly) first —
+# matches fleet's order=[dp, pp, sharding, sep, mp] (topology.py:30)
+HYBRID_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+
+
+def init_mesh(shape: dict | Sequence[int], axis_names: Optional[Sequence[str]] = None,
+              devices=None) -> Mesh:
+    """Create + install the global mesh.
+
+    init_mesh({"dp": 2, "mp": 4}) or init_mesh([2, 4], ["dp", "mp"]).
+    Axes of size 1 are kept (harmless) so strategy code can always name them.
+    """
+    if isinstance(shape, dict):
+        axis_names = tuple(shape.keys())
+        dims = tuple(int(v) for v in shape.values())
+    else:
+        dims = tuple(int(v) for v in shape)
+        axis_names = tuple(axis_names)
+    devices = devices if devices is not None else jax.devices()
+    n = int(np.prod(dims))
+    if n > len(devices):
+        raise RuntimeError(f"mesh {dict(zip(axis_names, dims))} needs {n} devices, "
+                           f"have {len(devices)}")
+    dev_array = np.asarray(devices[:n]).reshape(dims)
+    mesh = Mesh(dev_array, axis_names)
+    _state.mesh = mesh
+    return mesh
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    _state.mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return getattr(_state, "mesh", None)
+
+
+def has_mesh() -> bool:
+    return get_mesh() is not None
+
+
+def mesh_axis_size(axis: str) -> int:
+    mesh = get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+def axis_index(axis: str):
+    """Inside shard_map: this device's coordinate along `axis`."""
+    return jax.lax.axis_index(axis)
+
+
+def named_sharding(*spec) -> Optional[NamedSharding]:
+    mesh = get_mesh()
+    if mesh is None:
+        return None
+    clean = tuple(s if (s is None or isinstance(s, tuple)) else str(s) for s in spec)
+    return NamedSharding(mesh, PartitionSpec(*clean))
+
+
+def shard_constraint(value, *spec):
+    """with_sharding_constraint that degrades to no-op without a mesh.
+
+    The GSPMD annotation primitive — the analog of the reference's per-op
+    TensorDistAttr (phi/core/distributed/auto_parallel/dist_attr.h): XLA's
+    sharding propagation plays the role of the Completer/Resharder
+    (SURVEY.md §3.6).
+    """
+    mesh = get_mesh()
+    if mesh is None:
+        return value
+    # inside shard_map the context is an AbstractMesh where the manual axes
+    # (e.g. 'pp') must not appear in constraints — use it and drop them
+    use_mesh = mesh
+    manual = set()
+    try:
+        cur = jax.sharding.get_abstract_mesh()
+        if cur is not None and cur.axis_names:
+            use_mesh = cur
+            manual = {n for n, t in zip(cur.axis_names, cur.axis_types)
+                      if "Manual" in str(t)}
+    except Exception:
+        pass
+
+    def ok(a):
+        return (a in use_mesh.axis_names and use_mesh.shape[a] > 1
+                and a not in manual)
+
+    clean = []
+    for s in spec:
+        if s is None:
+            clean.append(None)
+        elif isinstance(s, tuple):
+            kept = tuple(a for a in s if ok(a))
+            clean.append(kept if kept else None)
+        else:
+            clean.append(s if ok(s) else None)
+    try:
+        return jax.lax.with_sharding_constraint(
+            value, NamedSharding(use_mesh, PartitionSpec(*clean)))
+    except Exception:
+        return value
