@@ -19,6 +19,11 @@ _PLATFORM_ALIASES = {
 }
 
 
+def is_tpu_backend() -> bool:
+    """True when the default jax backend is the TPU (incl. tunneled 'axon')."""
+    return jax.default_backend() in _PLATFORM_ALIASES["tpu"]
+
+
 def _platform_devices(platform: str):
     for alias in _PLATFORM_ALIASES.get(platform, (platform,)):
         try:

@@ -3,7 +3,7 @@
 Analog of the reference's flash-attn path (paddle/phi/kernels/gpu/flash_attn_kernel.h,
 python/paddle/nn/functional/flash_attention.py). On TPU the memory-efficient path is
 a Pallas flash-attention kernel (paddle_tpu/ops/pallas/flash_attention.py) selected
-automatically for real TPU devices; the reference implementation below is the
+automatically when the default backend is a TPU; the reference implementation below is the
 XLA-fused fallback used on CPU and for parity tests.
 """
 from __future__ import annotations
@@ -47,13 +47,12 @@ def sdp_attention_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=Non
 
 
 def _use_pallas(q_val) -> bool:
-    try:
-        dev = list(q_val.devices())[0] if hasattr(q_val, "devices") else None
-    except Exception:
-        dev = None
-    if dev is None:
-        return False
-    return dev.platform in ("tpu",)
+    # Backend check (not per-array device): under jit tracing arrays have no
+    # device, but the pallas kernel is the right path whenever we target TPU.
+    # Mosaic can't lower f64 (package default under x64), so gate on dtype too.
+    from ...core.device import is_tpu_backend
+    return is_tpu_backend() and q_val.dtype in (jnp.float32, jnp.bfloat16,
+                                                jnp.float16)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
@@ -62,13 +61,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """Inputs [batch, seq, heads, head_dim] as in the reference flash-attn API."""
     def f(q, k, v, *m):
         mask = m[0] if m else None
-        if _use_pallas(q):
-            try:
-                from ...ops.pallas.flash_attention import flash_attention_fwd
-                if mask is None:
-                    return flash_attention_fwd(q, k, v, causal=is_causal, scale=scale)
-            except Exception:
-                pass
+        if mask is None and _use_pallas(q):
+            from ...ops.pallas.flash_attention import flash_attention as fa
+            return fa(q, k, v, is_causal, scale)
         return _sdpa_ref(q, k, v, mask, dropout_p, is_causal, scale)
     if attn_mask is not None:
         return apply(f, query, key, value, attn_mask, op_name="sdpa")

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — the hand-written hot-op layer.
+
+TPU-native analog of the reference's fused CUDA kernels
+(paddle/phi/kernels/gpu/flash_attn_kernel.h, paddle/phi/kernels/fusion/): where
+Paddle drops to CUDA for ops XLA-era compilers can't fuse well, we drop to
+Pallas. Everything else rides plain XLA fusion.
+"""
+from . import flash_attention  # noqa: F401
