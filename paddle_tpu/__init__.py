@@ -61,6 +61,18 @@ def __getattr__(name):
     if name == "metric":
         import importlib
         return importlib.import_module(".metric", __name__)
+    if name == "hapi":
+        import importlib
+        return importlib.import_module(".hapi", __name__)
+    if name in ("Model", "summary"):
+        from .hapi import Model, summary
+        return {"Model": Model, "summary": summary}[name]
+    if name in ("enable_static", "disable_static", "in_dynamic_mode"):
+        from .static import framework as _sfw
+        return getattr(_sfw, name)
+    if name == "CompiledProgram":
+        from .static import CompiledProgram
+        return CompiledProgram
     if name == "profiler":
         import importlib
         return importlib.import_module(".profiler", __name__)

@@ -1,0 +1,7 @@
+"""paddle_tpu.hapi: high-level Model API (analog of python/paddle/hapi/)."""
+from .callbacks import (Callback, EarlyStopping, LRScheduler, ModelCheckpoint,
+                        ProgBarLogger)
+from .model import Model, summary
+
+__all__ = ["Model", "summary", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRScheduler"]

@@ -1,0 +1,254 @@
+"""hapi Model: Keras-like fit/evaluate/predict.
+
+Analog of python/paddle/hapi/model.py:1050 (Model) — but single-world: the
+train step is the eager autograd path, which under the hood is jax/XLA math,
+and can be wrapped by to_static for whole-step compilation.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io.dataloader import DataLoader
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "summary"]
+
+
+def _to_tensor_list(batch):
+    if isinstance(batch, (list, tuple)):
+        return [b if isinstance(b, Tensor) else Tensor(np.asarray(b))
+                for b in batch]
+    return [batch if isinstance(batch, Tensor) else Tensor(np.asarray(batch))]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- setup --------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            metrics = []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle_tpu.metric.Metric")
+
+    # -- steps --------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_tensor_list(inputs)
+        labels = _to_tensor_list(labels) if labels is not None else []
+        outputs = self.network(*inputs)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        loss = self._loss(*outs, *labels)
+        losses = loss if isinstance(loss, (list, tuple)) else [loss]
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, labels)
+        return ([float(l.numpy()) for l in losses], metrics) if metrics \
+            else [float(l.numpy()) for l in losses]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..autograd.grad_mode import no_grad
+        with no_grad():
+            inputs = _to_tensor_list(inputs)
+            labels = _to_tensor_list(labels) if labels is not None else []
+            outputs = self.network(*inputs)
+            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            losses = None
+            if self._loss is not None and labels:
+                loss = self._loss(*outs, *labels)
+                losses = loss if isinstance(loss, (list, tuple)) else [loss]
+            metrics = self._update_metrics(outs, labels)
+        out = [float(l.numpy()) for l in losses] if losses else []
+        return (out, metrics) if metrics else out
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..autograd.grad_mode import no_grad
+        with no_grad():
+            inputs = _to_tensor_list(inputs)
+            outputs = self.network(*inputs)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    def _update_metrics(self, outs, labels):
+        res = []
+        for m in self._metrics:
+            computed = m.compute(*outs, *labels)
+            if not isinstance(computed, (list, tuple)):
+                computed = [computed]
+            res.append(m.update(*computed))
+        return res
+
+    # -- loops --------------------------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def _split_batch(self, batch):
+        n_in = len(self._inputs) if self._inputs else 1
+        if isinstance(batch, (list, tuple)):
+            return list(batch[:n_in]), list(batch[n_in:])
+        return [batch], []
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = self._as_loader(train_data, batch_size, shuffle)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=self._metrics_names())
+        self.stop_training = False
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                res = self.train_batch(ins, labs)
+                logs = self._make_logs(res)
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose, callbacks=callbacks,
+                              _cbks=cbks)
+        cbks.on_train_end(logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _cbks=None):
+        loader = self._as_loader(eval_data, batch_size, False)
+        cbks = _cbks or config_callbacks(callbacks, model=self, epochs=1,
+                                         steps=None, log_freq=log_freq,
+                                         verbose=verbose,
+                                         metrics=self._metrics_names())
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            res = self.eval_batch(ins, labs)
+            logs = self._make_logs(res, prefix="")
+            cbks.on_eval_batch_end(step, logs)
+        # final accumulated metrics
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    def _metrics_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names += n if isinstance(n, list) else [n]
+        return names
+
+    def _make_logs(self, res, prefix=""):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+        else:
+            losses, metrics = res, []
+        if losses:
+            logs[prefix + "loss"] = losses[0] if len(losses) == 1 else losses
+        for m, v in zip(self._metrics, metrics):
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for n, vv in zip(names, vals):
+                logs[prefix + n] = vv
+        return logs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework_io import save as psave
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework_io import load as pload
+        self.network.set_state_dict(pload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None:
+            import os
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Analog of paddle.summary (python/paddle/hapi/model_summary.py)."""
+    rows = []
+    total, trainable = 0, 0
+    for name, layer in net.named_sublayers():
+        n_params = sum(int(np.prod(p.shape)) for p in
+                       layer.parameters(include_sublayers=False))
+        if not list(layer.sublayers()):
+            rows.append((name or type(layer).__name__,
+                         type(layer).__name__, n_params))
+    for p in net.parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+    width = max([len(r[0]) for r in rows], default=20) + 2
+    lines = [f"{'Layer':<{width}}{'Type':<24}{'Params':>12}",
+             "-" * (width + 36)]
+    for r in rows:
+        lines.append(f"{r[0]:<{width}}{r[1]:<24}{r[2]:>12,}")
+    lines.append("-" * (width + 36))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total - trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -24,7 +24,19 @@ from ..autograd.grad_mode import is_grad_enabled
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor
 
-__all__ = ["apply", "GradNode", "defprim"]
+__all__ = ["apply", "GradNode", "defprim", "set_static_recorder"]
+
+# Static-graph capture hook (installed by paddle_tpu.static.framework when
+# static mode is enabled). The analog of the reference's dual-world dispatch:
+# in static mode ops append to the current Program instead of executing
+# (python/paddle/fluid/framework.py:2679 Operator / append_op). Returns
+# NotImplemented to fall through to eager execution.
+_static_recorder = None
+
+
+def set_static_recorder(fn):
+    global _static_recorder
+    _static_recorder = fn
 
 
 class GradNode:
@@ -84,6 +96,10 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
       before execution (the eager_amp_auto_cast.h analog).
     """
     name = op_name or getattr(jax_fn, "__name__", "op")
+    if _static_recorder is not None:
+        rec = _static_recorder(jax_fn, args, static_kwargs, name)
+        if rec is not NotImplemented:
+            return rec
     vals = [_unwrap(a) for a in args]
 
     amp_dt = _get_amp_hook()(name)

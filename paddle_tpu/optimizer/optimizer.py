@@ -127,6 +127,13 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static import framework as _static_fw
+        if _static_fw.in_static_mode():
+            # static mode: record backward+update into the current Program
+            # (analog of append_backward + optimizer ops in the reference's
+            # static world, python/paddle/fluid/backward.py)
+            _static_fw.append_backward_and_update(loss, self)
+            return loss, None
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,0 +1,28 @@
+"""paddle_tpu.static: the static-graph (Program/Executor) world.
+
+Capability parity with paddle.static (python/paddle/static/) on a TPU-native
+core: Programs record jax-function applications, the Executor compiles the
+whole program with XLA, and the saved-model format is serialized StableHLO.
+"""
+from ..jit.api import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .framework import (  # noqa: F401
+    BackwardRecord, Block, CompiledProgram, Operator, Program, Variable, data,
+    default_main_program, default_startup_program, disable_static,
+    enable_static, in_dynamic_mode, in_static_mode, program_guard,
+    set_program_state,
+)
+from .io import (  # noqa: F401
+    InferenceProgram, load_inference_model, normalize_program,
+    save_inference_model,
+)
+
+__all__ = [
+    "InputSpec", "nn", "Executor", "Scope", "global_scope", "scope_guard",
+    "Program", "CompiledProgram", "Variable", "data", "default_main_program",
+    "default_startup_program", "program_guard", "enable_static",
+    "disable_static", "in_dynamic_mode", "in_static_mode",
+    "save_inference_model", "load_inference_model", "normalize_program",
+    "set_program_state",
+]

@@ -1,0 +1,246 @@
+"""Static-graph Executor: whole-program XLA compilation.
+
+TPU-native replacement for the reference's StandaloneExecutor stack
+(paddle/fluid/framework/new_executor/standalone_executor.h:34,
+program_interpreter.cc:99 RunImpl — instruction list, dependency builder,
+stream analyzer, async work queues). On TPU none of that scheduling machinery
+is needed: the recorded Program is replayed once under `jax.jit`, XLA
+fuses/schedules it, and the compiled executable is cached per
+(program version, feed spec, fetch list) — the same caching role as the
+reference's _ExecutorCache (python/paddle/fluid/executor.py:781,816).
+
+Parameters live in a Scope (name → device array; analog of
+paddle/fluid/framework/scope.h) and are donated to the compiled step so
+updates happen in place in HBM.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .framework import (BackwardRecord, Operator, Program, Variable,
+                        default_main_program)
+
+__all__ = ["Scope", "global_scope", "scope_guard", "Executor"]
+
+
+class Scope:
+    """name → value store for persistable variables (params + opt states)."""
+
+    def __init__(self):
+        self.vars: Dict[str, jax.Array] = {}
+        self.opt_states: Dict[str, dict] = {}
+        self.step: int = 0
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def var_names(self):
+        return list(self.vars)
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = []
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+        return self.scope
+
+    def __exit__(self, *a):
+        _scope_stack.pop()
+        return False
+
+
+def _replay(ops: Sequence[Any], params: Dict[str, Any], feeds: Dict[str, Any],
+            env: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Execute recorded Operators in order. Values are jax arrays or tracers."""
+    env = {} if env is None else env
+
+    def resolve(m):
+        kind, v = m[0], m[1]
+        if kind == "var":
+            if v in env:
+                return env[v]
+            if v in feeds:
+                return feeds[v]
+            if v in params:
+                return params[v]
+            raise KeyError(f"static variable {v!r} has no value "
+                           f"(missing from feed?)")
+        if kind == "param":
+            return params[v]
+        return v  # literal
+
+    for op in ops:
+        vals = [resolve(m) for m in op.args]
+        raw = op.fn(*vals, **op.kwargs)
+        if op.multi:
+            for nm, r in zip(op.out_names, raw):
+                if nm is not None:
+                    env[nm] = r
+        else:
+            if op.out_names[0] is not None:
+                env[op.out_names[0]] = raw
+    return env
+
+
+class Executor:
+    """Analog of paddle.static.Executor (python/paddle/fluid/executor.py:1036)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, Any] = {}
+
+    # -- public API ---------------------------------------------------------
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list=None, scope: Optional[Scope] = None, return_numpy=True):
+        from .framework import CompiledProgram
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        # loaded inference programs (static.io) carry their own runner
+        if program is not None and hasattr(program, "_infer_run"):
+            outs = program._infer_run(feed or {})
+            return [np.asarray(o) for o in outs] if return_numpy else list(outs)
+
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        if not program.ops and not fetch_list:
+            # startup program: seed scope from captured eager tensors
+            self._seed_scope(program, scope)
+            return []
+
+        self._seed_scope(program, scope)
+
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list if isinstance(fetch_list, (list, tuple))
+                                 else [fetch_list])]
+        feed_arrays = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                       for k, v in feed.items()}
+        feed_key = tuple(sorted((k, tuple(a.shape), str(a.dtype))
+                                for k, a in feed_arrays.items()))
+        key = (id(program), program._version, feed_key, tuple(fetch_names),
+               id(scope))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile(program, scope, fetch_names)
+            self._cache[key] = entry
+        compiled, bw = entry
+
+        param_vals = {n: scope.vars[n] for n in program.captured}
+        if bw is not None:
+            scope.step += 1
+            opt = bw.optimizer
+            opt_state = {n: scope.opt_states[n] for n in bw.param_names}
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step = jnp.asarray(scope.step, jnp.int32)
+            fetches, new_params, new_opt = compiled(param_vals, opt_state,
+                                                    feed_arrays, lr, step)
+            scope.opt_states.update(new_opt)
+            from ..optimizer.lr import LRScheduler
+            if isinstance(opt._lr, LRScheduler):
+                opt._lr.step()
+        else:
+            fetches, new_params, _ = compiled(param_vals, {}, feed_arrays,
+                                              jnp.float32(0), jnp.int32(0))
+        scope.vars.update(new_params)
+        # keep the eager Tensors in sync so state_dict()/save see trained values
+        for n, t in program.captured.items():
+            t._value = scope.vars[n]
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def close(self):
+        self._cache.clear()
+
+    # -- internals ----------------------------------------------------------
+    def _seed_scope(self, program: Program, scope: Scope) -> None:
+        for name, t in program.captured.items():
+            if name not in scope.vars:
+                scope.vars[name] = t._value
+        for op in program.ops:
+            if isinstance(op, BackwardRecord):
+                for n in op.param_names:
+                    if n not in scope.opt_states:
+                        opt = op.optimizer
+                        scope.opt_states[n] = dict(
+                            opt._init_state(program.captured[n]))
+
+    def _compile(self, program: Program, scope: Scope, fetch_names):
+        ops = list(program.ops)
+        bw_idx = next((i for i, o in enumerate(ops)
+                       if isinstance(o, BackwardRecord)), None)
+        if bw_idx is not None and any(isinstance(o, BackwardRecord)
+                                      for o in ops[bw_idx + 1:]):
+            raise NotImplementedError("one minimize() per Program")
+        bw = ops[bw_idx] if bw_idx is not None else None
+
+        def fetch_from(env, params):
+            out = []
+            for n in fetch_names:
+                if n in env:
+                    out.append(env[n])
+                elif n in params:
+                    out.append(params[n])
+                else:
+                    raise KeyError(f"fetch target {n!r} not produced by program")
+            return out
+
+        if bw is None:
+            def compiled(param_vals, opt_state, feeds, lr, step):
+                env = _replay(ops, param_vals, feeds)
+                return fetch_from(env, param_vals), param_vals, opt_state
+        else:
+            opt = bw.optimizer
+            clip = opt._grad_clip
+            _, update_fn = opt.functional_update()
+            fwd_ops = ops[:bw_idx]
+            tail_ops = ops[bw_idx + 1:]
+            train_names = list(bw.param_names)
+
+            def compiled(param_vals, opt_state, feeds, lr, step):
+                frozen = {k: v for k, v in param_vals.items()
+                          if k not in bw.param_names}
+
+                def loss_fn(train_vals):
+                    env = _replay(fwd_ops, {**frozen, **train_vals}, feeds)
+                    return env[bw.loss_name], env
+
+                train_vals = {n: param_vals[n] for n in train_names}
+                (loss, env), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(train_vals)
+
+                if clip is not None:
+                    pairs = [(Tensor(train_vals[n]), Tensor(grads[n]))
+                             for n in train_names]
+                    pairs = clip(pairs)
+                    grads = {n: g._value for n, (_, g) in zip(train_names, pairs)}
+
+                # the optimizer's own functional update rule — shared with the
+                # eager step() and the compiled hybrid train step
+                new_train, new_opt = update_fn(train_vals, grads, opt_state,
+                                               lr, step)
+                new_params = {**frozen, **new_train}
+                if tail_ops:
+                    env = _replay(tail_ops, new_params, feeds, env=env)
+                return fetch_from(env, new_params), new_params, new_opt
+
+        jitted = jax.jit(compiled, donate_argnums=(0, 1))
+        return jitted, bw

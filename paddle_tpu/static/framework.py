@@ -1,0 +1,389 @@
+"""Static-graph world: Program / Block / Variable / Operator.
+
+TPU-native re-design of the reference's ProgramDesc machinery
+(paddle/fluid/framework/framework.proto:267 ProgramDesc, :69 OpDesc;
+python/paddle/fluid/framework.py:5478 Program, :2679 Operator, :1257 Variable).
+
+Instead of a protobuf op list dispatched by a C++ interpreter
+(paddle/fluid/framework/new_executor/program_interpreter.cc:99), a Program here
+is a linear record of jax-function applications over symbolic Variables.
+Shape/dtype inference is `jax.eval_shape` (the InferMeta analog,
+paddle/phi/infermeta/), and execution is one XLA compilation of the whole
+replayed program (see executor.py) — the role CINN + StandaloneExecutor play in
+the reference, collapsed into trace→XLA.
+
+Ops enter the program through the dispatch hook installed on
+paddle_tpu.ops.dispatch.apply: under `enable_static()`, any op touching a
+Variable is appended instead of executed, so the ENTIRE eager op library and
+nn.Layer zoo work unmodified in static mode — the reference needed a parallel
+static op world (paddle/fluid/operators/) for this.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Parameter, Tensor
+from ..ops import dispatch as _dispatch
+
+__all__ = [
+    "Variable", "Operator", "Block", "Program", "program_guard",
+    "default_main_program", "default_startup_program", "enable_static",
+    "disable_static", "in_dynamic_mode", "in_static_mode", "data",
+    "set_program_state",
+]
+
+_static_mode = False
+_name_counter = [0]
+# placeholder extents for dynamic dims during shape inference; inferring with
+# TWO distinct extents and diffing the results propagates dynamic-ness through
+# ops (the role InferMeta's -1 propagation plays in the reference,
+# paddle/phi/infermeta/)
+_DYN_PLACEHOLDER = 2
+_DYN_PLACEHOLDER_B = 3
+
+
+def _unique_name(prefix: str) -> str:
+    _name_counter[0] += 1
+    return f"{prefix}_{_name_counter[0]}"
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a static Program.
+
+    Analog of python/paddle/fluid/framework.py:1257 Variable. Subclasses the
+    eager Tensor so every patched method/operator works; `_value` holds a
+    jax.ShapeDtypeStruct (an abstract value) instead of a concrete array.
+    """
+    __slots__ = ("block", "op", "is_data", "dynamic_dims")
+
+    _is_static_var = True
+
+    def __init__(self, shape, dtype, name=None, block=None, is_data=False,
+                 stop_gradient=False, dynamic_dims=()):
+        # dynamic (None/-1) dims are tracked and reported as -1 from .shape —
+        # the reference's static-graph convention (fluid/framework.py Variable);
+        # internally a placeholder extent of 2 stands in for shape inference.
+        self.dynamic_dims = frozenset(
+            i for i, s in enumerate(shape) if s in (None, -1)) | frozenset(
+            dynamic_dims)
+        shape = tuple(_DYN_PLACEHOLDER if i in self.dynamic_dims else int(s)
+                      for i, s in enumerate(shape))
+        aval = jax.ShapeDtypeStruct(shape, np.dtype(dtypes.convert_dtype(dtype)))
+        # bypass Tensor.__init__'s asarray on the abstract value
+        self._value = aval
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.name = name or _unique_name("var")
+        self.persistable = False
+        self._grad_node = None
+        self._out_index = 0
+        self._retain_grads = False
+        self._backward_hooks = None
+        self.block = block
+        self.op = None        # Operator that produces this variable
+        self.is_data = is_data
+
+    @property
+    def shape(self):
+        return [-1 if i in self.dynamic_dims else int(s)
+                for i, s in enumerate(self._value.shape)]
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype).type
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable {self.name!r} has no value in static mode; run it "
+            "through paddle_tpu.static.Executor first")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={np.dtype(self._value.dtype).name})")
+
+
+class Operator:
+    """One recorded op: a jax function over resolved inputs.
+
+    Analog of framework.proto:69 OpDesc. `args` holds the call template with
+    Variables/captured Tensors replaced by ('var', name) / ('param', name)
+    markers; literals are kept inline.
+    """
+    __slots__ = ("fn", "args", "kwargs", "out_names", "type", "multi")
+
+    def __init__(self, fn, args, kwargs, out_names, op_type, multi=False):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.out_names = out_names
+        self.type = op_type
+        self.multi = multi
+
+    def __repr__(self):
+        return f"<op {self.type} -> {self.out_names}>"
+
+
+class BackwardRecord:
+    """minimize() marker: backward + optimizer update over the forward prefix.
+
+    The analog of append_backward + optimizer ops in the reference's static
+    Program (python/paddle/fluid/backward.py); lowered by the Executor through
+    jax.value_and_grad over the replayed forward segment.
+    """
+    __slots__ = ("loss_name", "optimizer", "param_names", "type")
+
+    def __init__(self, loss_name, optimizer, param_names):
+        self.loss_name = loss_name
+        self.optimizer = optimizer
+        self.param_names = param_names
+        self.type = "backward_and_update"
+
+    def __repr__(self):
+        return f"<backward+update loss={self.loss_name} params={len(self.param_names)}>"
+
+
+class Block:
+    """Analog of framework.py:3799 Block (single-block programs only; control
+    flow lives inside ops as lax.cond/scan, the XLA-idiomatic form)."""
+
+    def __init__(self, program: "Program", idx: int = 0):
+        self.program = program
+        self.idx = idx
+        self.ops: List[Any] = []
+        self.vars: Dict[str, Variable] = {}
+
+    def var(self, name: str) -> Variable:
+        if name not in self.vars:
+            raise ValueError(f"variable {name!r} not in block")
+        return self.vars[name]
+
+    def create_var(self, shape, dtype, name=None, **kw) -> Variable:
+        v = Variable(shape, dtype, name=name, block=self, **kw)
+        self.vars[v.name] = v
+        return v
+
+    def append_op(self, op) -> None:
+        self.ops.append(op)
+        self.program._version += 1
+
+
+class Program:
+    """Analog of framework.py:5478 Program."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.random_seed = 0
+        self._version = 0
+        # eager Tensors captured as persistable scope vars: name -> Tensor
+        self.captured: Dict[str, Tensor] = {}
+        self._capture_ids: Dict[int, str] = {}
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    @property
+    def ops(self):
+        return self.global_block().ops
+
+    def capture(self, t: Tensor) -> str:
+        """Register an eager Tensor (parameter/buffer/constant) as a named
+        persistable variable of this program; returns its scope name."""
+        key = id(t)
+        if key in self._capture_ids:
+            return self._capture_ids[key]
+        name = t.name if isinstance(t, Tensor) and t.name else None
+        if not name or name in self.captured:
+            name = _unique_name("param" if isinstance(t, Parameter) else "capt")
+        self._capture_ids[key] = name
+        self.captured[name] = t
+        return name
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        b = p.global_block()
+        src = self.global_block()
+        b.vars = dict(src.vars)
+        if for_test:
+            b.ops = [o for o in src.ops if not isinstance(o, BackwardRecord)]
+        else:
+            b.ops = list(src.ops)
+        p.captured = dict(self.captured)
+        p._capture_ids = dict(self._capture_ids)
+        p.random_seed = self.random_seed
+        p._version = self._version
+        return p
+
+    def list_vars(self):
+        return list(self.global_block().vars.values())
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.ops)}, vars={len(self.global_block().vars)}, "
+                f"captured={len(self.captured)})")
+
+
+class CompiledProgram:
+    """Shim for the reference's CompiledProgram (python/paddle/static/
+    compiler.py): XLA compiles whole programs already, so this just tags the
+    wrapped program; Executor.run unwraps it."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+
+_default_main = Program()
+_default_startup = Program()
+_guard_stack: List[tuple] = []
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    global _default_main, _default_startup
+    prev = (_default_main, _default_startup)
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = prev
+
+
+# ---------------------------------------------------------------------------
+# static mode switch + op recorder
+# ---------------------------------------------------------------------------
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+def _is_var(a) -> bool:
+    return isinstance(a, Variable)
+
+
+def _recorder(jax_fn, args, static_kwargs, name):
+    """Installed on ops.dispatch: append the op to the current Program when any
+    input is a symbolic Variable; otherwise fall through to eager."""
+    if not _static_mode or not any(_is_var(a) for a in args):
+        return NotImplemented
+    prog = _default_main
+    block = prog.global_block()
+
+    tmpl = []
+    avals_a, avals_b = [], []
+    any_dynamic = False
+    for a in args:
+        if _is_var(a):
+            tmpl.append(("var", a.name))
+            avals_a.append(a._value)
+            if a.dynamic_dims:
+                any_dynamic = True
+                shp_b = tuple(_DYN_PLACEHOLDER_B if i in a.dynamic_dims else s
+                              for i, s in enumerate(a._value.shape))
+                avals_b.append(jax.ShapeDtypeStruct(shp_b, a._value.dtype))
+            else:
+                avals_b.append(a._value)
+            if a.name not in block.vars:
+                block.vars[a.name] = a
+        elif isinstance(a, Tensor):
+            nm = prog.capture(a)
+            tmpl.append(("param", nm))
+            sd = jax.ShapeDtypeStruct(a._value.shape, a._value.dtype)
+            avals_a.append(sd)
+            avals_b.append(sd)
+        else:
+            tmpl.append(("lit", a))
+            avals_a.append(a)
+            avals_b.append(a)
+
+    out_shape = jax.eval_shape(lambda *vs: jax_fn(*vs, **static_kwargs), *avals_a)
+    out_shape_b = (jax.eval_shape(lambda *vs: jax_fn(*vs, **static_kwargs),
+                                  *avals_b) if any_dynamic else out_shape)
+
+    multi = isinstance(out_shape, (tuple, list))
+    shapes = list(out_shape) if multi else [out_shape]
+    shapes_b = list(out_shape_b) if multi else [out_shape_b]
+    out_vars = []
+    for sd, sdb in zip(shapes, shapes_b):
+        if isinstance(sd, jax.ShapeDtypeStruct):
+            dyn = tuple(i for i, (s1, s2) in enumerate(zip(sd.shape, sdb.shape))
+                        if s1 != s2)
+            out_vars.append(block.create_var(sd.shape, sd.dtype,
+                                             name=_unique_name(name),
+                                             dynamic_dims=dyn))
+        else:  # non-array output (python scalar etc.) — keep literal
+            out_vars.append(sd)
+    op = Operator(jax_fn, tmpl, static_kwargs,
+                  [v.name if _is_var(v) else None for v in out_vars], name,
+                  multi=multi)
+    block.append_op(op)
+    if multi:
+        return type(out_shape)(out_vars)
+    return out_vars[0]
+
+
+def enable_static():
+    """Switch to static-graph mode (analog of paddle.enable_static)."""
+    global _static_mode
+    _static_mode = True
+    _dispatch.set_static_recorder(_recorder)
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+    _dispatch.set_static_recorder(None)
+
+
+# ---------------------------------------------------------------------------
+# feed placeholders & minimize hook
+# ---------------------------------------------------------------------------
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """Analog of paddle.static.data: declare a feed Variable.
+
+    Dynamic (None / -1) dims are materialised at Executor.run from the fed
+    array — each distinct feed shape is its own XLA compilation (the same
+    per-shape caching to_static uses). Reading `.shape` on a dynamic dim
+    returns -1 (the reference's static-graph convention)."""
+    v = Variable(shape, dtype, name=name,
+                 block=_default_main.global_block(), is_data=True,
+                 stop_gradient=True)
+    v.block.vars[v.name] = v
+    return v
+
+
+def append_backward_and_update(loss: Variable, optimizer) -> None:
+    """Record minimize(): called by Optimizer.minimize under static mode."""
+    prog = _default_main
+    names = []
+    for p in optimizer._params:
+        if p.stop_gradient:
+            continue
+        names.append(prog.capture(p))
+    prog.global_block().append_op(BackwardRecord(loss.name, optimizer, names))
+
+
+def set_program_state(program: Program, state: Dict[str, np.ndarray]) -> None:
+    """Load numpy state into the captured parameters of a program."""
+    for name, arr in state.items():
+        if name in program.captured:
+            program.captured[name]._set_value(jnp.asarray(arr))

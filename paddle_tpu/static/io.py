@@ -1,0 +1,126 @@
+"""save/load_inference_model: serialized compiled programs.
+
+TPU-native analog of the reference's inference model format
+(python/paddle/static/io.py save_inference_model → ProgramDesc protobuf +
+params; loaded by AnalysisPredictor, paddle/fluid/inference/api/
+analysis_predictor.h:94). Here the portable artifact is **serialized
+StableHLO** via `jax.export` — the XLA-world equivalent of ProgramDesc: a
+versioned, stable bytecode of the traced program — plus an .npz of the
+captured parameters and a JSON meta file.
+
+Files written for prefix P:
+  P.shlo  — serialized StableHLO of fn(params, *feeds) -> fetches
+  P.npz   — parameter arrays (by scope name)
+  P.json  — feed names/specs, fetch names, format version
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .executor import Executor, _replay, global_scope
+from .framework import BackwardRecord, Program, Variable
+
+__all__ = ["save_inference_model", "load_inference_model", "normalize_program"]
+
+_FORMAT_VERSION = 1
+
+
+def normalize_program(program: Program, feed_vars, fetch_vars) -> Program:
+    """Prune to inference form (drop backward records)."""
+    return program.clone(for_test=True)
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor: Executor = None, program: Program = None,
+                         **kwargs) -> None:
+    from .framework import default_main_program
+    program = normalize_program(program or default_main_program(),
+                                feed_vars, fetch_vars)
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    feed_names = [v.name for v in feed_vars]
+    fetch_names = [v.name for v in fetch_vars]
+
+    scope = global_scope()
+    params = {}
+    for name, t in program.captured.items():
+        v = scope.vars.get(name)
+        params[name] = np.asarray(v if v is not None else t._value)
+
+    ops = [o for o in program.ops if not isinstance(o, BackwardRecord)]
+
+    def infer_fn(param_vals, *feed_vals):
+        feeds = dict(zip(feed_names, feed_vals))
+        env = _replay(ops, param_vals, feeds)
+        return tuple(env[n] if n in env else param_vals[n] for n in fetch_names)
+
+    # dynamic feed dims export as SYMBOLIC shapes so the saved StableHLO
+    # accepts any batch size (the ProgramDesc -1 dim analog)
+    feed_specs = []
+    n_sym = 0
+    for v in feed_vars:
+        if getattr(v, "dynamic_dims", None):
+            parts = []
+            for i, s in enumerate(v._value.shape):
+                if i in v.dynamic_dims:
+                    parts.append(f"_d{n_sym}")
+                    n_sym += 1
+                else:
+                    parts.append(str(int(s)))
+            shp = jax.export.symbolic_shape(",".join(parts))
+            feed_specs.append(jax.ShapeDtypeStruct(shp, np.dtype(v._value.dtype)))
+        else:
+            feed_specs.append(jax.ShapeDtypeStruct(
+                tuple(int(s) for s in v._value.shape), np.dtype(v._value.dtype)))
+    param_specs = {k: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                   for k, a in params.items()}
+    exported = jax.export.export(jax.jit(infer_fn))(param_specs, *feed_specs)
+
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".shlo", "wb") as f:
+        f.write(exported.serialize())
+    np.savez(path_prefix + ".npz", **params)
+    with open(path_prefix + ".json", "w") as f:
+        json.dump({
+            "version": _FORMAT_VERSION,
+            "feed_names": feed_names,
+            "feed_shapes": [[int(d) if isinstance(d, (int, np.integer)) else -1
+                             for d in s.shape] for s in feed_specs],
+            "feed_dtypes": [np.dtype(s.dtype).name for s in feed_specs],
+            "fetch_names": fetch_names,
+        }, f)
+
+
+class InferenceProgram:
+    """Loaded artifact; Executor.run() dispatches to `_infer_run`."""
+
+    def __init__(self, path_prefix: str):
+        with open(path_prefix + ".json") as f:
+            self.meta = json.load(f)
+        with open(path_prefix + ".shlo", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        loaded = np.load(path_prefix + ".npz")
+        self.params = {k: jnp.asarray(loaded[k]) for k in loaded.files}
+        self.feed_names: List[str] = self.meta["feed_names"]
+        self.fetch_names: List[str] = self.meta["fetch_names"]
+        self._call = self._exported.call
+
+    def _infer_run(self, feed: Dict[str, np.ndarray]):
+        vals = [jnp.asarray(feed[n]._value if isinstance(feed[n], Tensor)
+                            else feed[n]) for n in self.feed_names]
+        return self._call(self.params, *vals)
+
+
+def load_inference_model(path_prefix: str, executor: Executor = None):
+    """Returns [program, feed_names, fetch_names] like the reference."""
+    prog = InferenceProgram(path_prefix)
+    return [prog, prog.feed_names, prog.fetch_names]

@@ -1,0 +1,5 @@
+"""paddle_tpu.vision: datasets, transforms, models
+(analog of python/paddle/vision/)."""
+from . import datasets, models, transforms  # noqa: F401
+from .datasets import *  # noqa: F401,F403
+from .models import *  # noqa: F401,F403

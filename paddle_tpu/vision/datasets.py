@@ -1,0 +1,185 @@
+"""Vision datasets (analog of python/paddle/vision/datasets/).
+
+The reference downloads from public mirrors; this environment has zero egress,
+so each dataset loads from a user-supplied local file in the reference's
+format, and `FakeData`/`DatasetFolder` cover offline training and tests.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder"]
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST. Pass image_path/label_path to local files
+    (reference: python/paddle/vision/datasets/mnist.py)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.mode = mode
+        self.transform = transform
+        if image_path is None or label_path is None:
+            raise ValueError(
+                f"{type(self).__name__} requires local image_path/label_path "
+                "(no network in this environment); or use FakeData")
+        with gzip.open(image_path, "rb") if image_path.endswith(".gz") \
+                else open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        with gzip.open(label_path, "rb") if label_path.endswith(".gz") \
+                else open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR from the python-pickle tar (reference:
+    python/paddle/vision/datasets/cifar.py)."""
+
+    _n_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            raise ValueError(f"{type(self).__name__} requires a local "
+                             "data_file (no network); or use FakeData")
+        self.mode = mode
+        self.transform = transform
+        imgs, labels = [], []
+        key = b"labels" if self._n_classes == 10 else b"fine_labels"
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                take = (mode == "train" and ("data_batch" in base or base == "train")) \
+                    or (mode == "test" and ("test_batch" in base or base == "test"))
+                if not take or not m.isfile():
+                    continue
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                imgs.append(np.asarray(d[b"data"]))
+                labels.extend(d[key])
+        data = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.images = data.transpose(0, 2, 3, 1)  # HWC
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _n_classes = 100
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset for tests/benchmarks."""
+
+    def __init__(self, size=100, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        c, h, w = self.image_shape
+        img = rng.randint(0, 256, (h, w, c), np.uint8)
+        label = np.int64(rng.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+_IMG_EXTS = (".npy", ".png", ".jpg", ".jpeg", ".bmp")
+
+
+def _load_image(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as e:  # PIL may be absent; npy always works
+        raise RuntimeError(f"cannot load {path}: PIL unavailable") from e
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree (reference:
+    python/paddle/vision/datasets/folder.py)."""
+
+    def __init__(self, root, transform=None, extensions=_IMG_EXTS):
+        self.root = root
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fn in sorted(os.listdir(d)):
+                if fn.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(d, fn),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = _load_image(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat folder of images, no labels."""
+
+    def __init__(self, root, transform=None, extensions=_IMG_EXTS):
+        self.transform = transform
+        self.samples = [os.path.join(root, f) for f in sorted(os.listdir(root))
+                        if f.lower().endswith(tuple(extensions))]
+
+    def __getitem__(self, idx):
+        img = _load_image(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,10 @@
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, wide_resnet50_2, wide_resnet101_2)
+from .others import (LeNet, VGG, vgg11, vgg13, vgg16, vgg19, MobileNetV2,
+                     mobilenet_v2, AlexNet, alexnet)
+
+__all__ = [
+    "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "wide_resnet50_2", "wide_resnet101_2", "LeNet", "VGG", "vgg11", "vgg13",
+    "vgg16", "vgg19", "MobileNetV2", "mobilenet_v2", "AlexNet", "alexnet",
+]

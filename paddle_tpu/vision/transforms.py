@@ -1,0 +1,367 @@
+"""Image transforms (analog of python/paddle/vision/transforms/transforms.py).
+
+Operate on numpy HWC uint8/float arrays (the DataLoader-side representation);
+ToTensor produces CHW float32 — batches then move to device once, which is the
+TPU-friendly host-side pipeline (minimise h2d transfers, SURVEY.md §5).
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Resize", "RandomResizedCrop", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Normalize", "Transpose", "BrightnessTransform", "ContrastTransform",
+           "SaturationTransform", "HueTransform", "ColorJitter", "Pad",
+           "RandomRotation", "Grayscale", "to_tensor", "normalize", "resize",
+           "hflip", "vflip", "center_crop", "crop"]
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+# ---- functional ----
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, numbers.Number):
+        if h < w:
+            oh, ow = int(size), int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), int(size)
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return img
+    # vectorised nearest/bilinear resampling on the host
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    if interpolation == "nearest":
+        out = img[np.round(ys).astype(int)[:, None],
+                  np.round(xs).astype(int)[None, :]]
+        return out
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    f = img.astype(np.float32)
+    top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+    bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype) if np.issubdtype(img.dtype, np.integer) else out
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    return crop(img, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    img = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (img - mean[:, None, None]) / std[:, None, None]
+    return (img - mean) / std
+
+
+def to_tensor(img, data_format="CHW"):
+    img = _as_hwc(img)
+    arr = img.astype(np.float32)
+    if np.issubdtype(img.dtype, np.integer):
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+# ---- transform classes ----
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding:
+            p = self.padding
+            if isinstance(p, numbers.Number):
+                l = t = r = b = p
+            elif len(p) == 2:
+                l, t, r, b = p[0], p[1], p[0], p[1]
+            else:
+                l, t, r, b = p
+            img = np.pad(img, ((t, b), (l, r), (0, 0)))
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            ph, pw = max(th - h, 0), max(tw - w, 0)
+            img = np.pad(img, ((ph, ph), (pw, pw), (0, 0)))
+            h, w = img.shape[:2]
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return crop(img, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                patch = crop(img, top, left, ch, cw)
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std, self.data_format = mean, std, data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        f = 1 + random.uniform(-self.value, self.value)
+        return np.clip(_as_hwc(img).astype(np.float32) * f, 0,
+                       255 if np.issubdtype(np.asarray(img).dtype, np.integer)
+                       else 1e9).astype(np.asarray(img).dtype)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        f = 1 + random.uniform(-self.value, self.value)
+        mean = img.astype(np.float32).mean()
+        out = (img.astype(np.float32) - mean) * f + mean
+        hi = 255 if np.issubdtype(img.dtype, np.integer) else 1e9
+        return np.clip(out, 0, hi).astype(img.dtype)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        f = 1 + random.uniform(-self.value, self.value)
+        gray = img.astype(np.float32).mean(axis=2, keepdims=True)
+        out = img.astype(np.float32) * f + gray * (1 - f)
+        hi = 255 if np.issubdtype(img.dtype, np.integer) else 1e9
+        return np.clip(out, 0, hi).astype(img.dtype)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        # cheap hue shift by channel rotation mix
+        img = _as_hwc(img)
+        if img.shape[2] != 3:
+            return img
+        f = random.uniform(-self.value, self.value)
+        rolled = np.roll(img.astype(np.float32), 1, axis=2)
+        out = img.astype(np.float32) * (1 - abs(f)) + rolled * abs(f)
+        hi = 255 if np.issubdtype(img.dtype, np.integer) else 1e9
+        return np.clip(out, 0, hi).astype(img.dtype)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        ts = list(self.ts)
+        random.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding  # l, t, r, b
+        self.fill = fill
+        self.mode = padding_mode
+
+    def _apply_image(self, img):
+        l, t, r, b = self.padding
+        img = _as_hwc(img)
+        if self.mode == "constant":
+            return np.pad(img, ((t, b), (l, r), (0, 0)),
+                          constant_values=self.fill)
+        return np.pad(img, ((t, b), (l, r), (0, 0)), mode=self.mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        angle = random.uniform(*self.degrees)
+        # rotate via coordinate remap (nearest)
+        h, w = img.shape[:2]
+        cy, cx = (h - 1) / 2, (w - 1) / 2
+        rad = np.deg2rad(angle)
+        yy, xx = np.mgrid[0:h, 0:w]
+        ys = (np.cos(rad) * (yy - cy) - np.sin(rad) * (xx - cx) + cy)
+        xs = (np.sin(rad) * (yy - cy) + np.cos(rad) * (xx - cx) + cx)
+        yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+        xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+        valid = (ys >= 0) & (ys < h) & (xs >= 0) & (xs < w)
+        out = img[yi, xi] * valid[..., None]
+        return out.astype(img.dtype)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if img.shape[2] == 1:
+            g = img
+        else:
+            g = (img.astype(np.float32) @ np.array([0.299, 0.587, 0.114],
+                                                   np.float32))[..., None]
+            g = g.astype(img.dtype)
+        return np.repeat(g, self.n, axis=2) if self.n > 1 else g

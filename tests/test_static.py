@@ -1,0 +1,149 @@
+"""Static-graph world tests: Program capture, Executor, minimize,
+save/load_inference_model (StableHLO round trip)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import static
+
+
+@pytest.fixture
+def static_mode():
+    static.enable_static()
+    yield
+    static.disable_static()
+
+
+def test_program_capture_and_run(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3], "float32")
+        y = x * 2.0 + 1.0
+        z = y.sum()
+    assert len(main.ops) >= 2
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.arange(12, dtype=np.float32).reshape(4, 3)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[z])
+    np.testing.assert_allclose(out, (xv * 2 + 1).sum(), rtol=1e-6)
+
+
+def test_layer_in_static_mode(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        net = P.nn.Linear(8, 4)
+        x = static.data("x", [2, 8], "float32")
+        out = net(x)
+    assert out.shape == [2, 4]
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    (ov,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    static.disable_static()
+    ref = net(P.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(ov, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_minimize_trains(static_mode):
+    scope = static.Scope()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        net = P.nn.Linear(4, 1)
+        x = static.data("x", [16, 4], "float32")
+        yt = static.data("yt", [16, 1], "float32")
+        pred = net(x)
+        loss = ((pred - yt) ** 2).mean()
+        opt = P.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 4).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    yv = xv @ w
+    with static.scope_guard(scope):
+        exe.run(startup)
+        losses = [exe.run(main, feed={"x": xv, "yt": yv}, fetch_list=[loss])[0]
+                  for _ in range(50)]
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_clone_for_test_drops_backward(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        net = P.nn.Linear(4, 2)
+        x = static.data("x", [3, 4], "float32")
+        loss = net(x).sum()
+        opt = P.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        opt.minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert any(isinstance(o, static.BackwardRecord) for o in main.ops)
+    assert not any(isinstance(o, static.BackwardRecord) for o in test_prog.ops)
+
+
+def test_save_load_inference_model(tmp_path, static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        net = P.nn.Linear(6, 3)
+        x = static.data("x", [2, 6], "float32")
+        out = P.nn.functional.softmax(net(x))
+    exe = static.Executor()
+    exe.run(startup)
+    prefix = str(tmp_path / "model" / "m")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+
+    xv = np.random.RandomState(1).randn(2, 6).astype(np.float32)
+    (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+
+    prog, feed_names, fetch_names = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    (got,) = exe.run(prog, feed={"x": xv})
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_dynamic_batch_dim(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2, 3], "float32")
+        assert x.shape == [-1, 2, 3]
+        y = x.reshape([x.shape[0], 6])
+        z = y.sum(axis=1)
+        assert z.shape == [-1]
+    exe = static.Executor()
+    for bs in (2, 5):
+        xv = np.ones((bs, 2, 3), np.float32)
+        (out,) = exe.run(main, feed={"x": xv}, fetch_list=[z])
+        assert out.shape == (bs,)
+        np.testing.assert_allclose(out, 6.0)
+
+
+def test_save_load_dynamic_batch(tmp_path, static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        net = P.nn.Linear(6, 3)
+        x = static.data("x", [None, 6], "float32")
+        out = net(x)
+    exe = static.Executor()
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+    prog, _, _ = static.load_inference_model(prefix, exe)
+    for bs in (1, 4, 7):
+        (got,) = exe.run(prog, feed={"x": np.ones((bs, 6), np.float32)})
+        assert got.shape == (bs, 3)
+
+
+def test_compiled_program_shim(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = x * 3.0
+    exe = static.Executor()
+    cp = static.CompiledProgram(main)
+    (out,) = exe.run(cp, feed={"x": np.ones((2, 2), np.float32)},
+                     fetch_list=[y])
+    np.testing.assert_allclose(out, 3.0)
+
+
+def test_dynamic_mode_restored():
+    assert static.in_dynamic_mode()
+    t = P.to_tensor([1.0, 2.0])
+    assert float((t * 2).sum().numpy()) == 6.0

@@ -1,0 +1,102 @@
+"""vision models/transforms/datasets + metric + hapi Model tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import metric as M
+from paddle_tpu import vision
+from paddle_tpu.vision import transforms as T
+
+
+def test_resnet18_forward():
+    net = vision.resnet18(num_classes=10)
+    x = P.to_tensor(np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
+    out = net(x)
+    assert out.shape == [2, 10]
+
+
+def test_mobilenet_lenet_forward():
+    net = vision.mobilenet_v2(num_classes=7)
+    x = P.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64).astype("float32"))
+    assert net(x).shape == [1, 7]
+    le = vision.LeNet()
+    x = P.to_tensor(np.random.RandomState(0).randn(2, 1, 28, 28).astype("float32"))
+    assert le(x).shape == [2, 10]
+
+
+def test_transforms_pipeline():
+    tf = T.Compose([
+        T.Resize(40), T.CenterCrop(32), T.RandomHorizontalFlip(0.5),
+        T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3)])
+    img = np.random.RandomState(0).randint(0, 256, (50, 60, 3), np.uint8)
+    out = tf(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+    assert -1.01 <= out.min() and out.max() <= 1.01
+
+
+def test_fake_data_and_folder(tmp_path):
+    ds = vision.FakeData(size=12, image_shape=(3, 16, 16), num_classes=4,
+                         transform=T.ToTensor())
+    img, label = ds[0]
+    assert img.shape == (3, 16, 16) and 0 <= int(label) < 4
+    # DatasetFolder over .npy files
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(d / f"{i}.npy",
+                    np.zeros((8, 8, 3), np.uint8))
+    folder = vision.DatasetFolder(str(tmp_path))
+    assert len(folder) == 6
+    assert folder.classes == ["cat", "dog"]
+    img, label = folder[5]
+    assert label == 1
+
+
+def test_accuracy_metric():
+    acc = M.Accuracy(topk=(1, 2))
+    pred = P.to_tensor(np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], "float32"))
+    label = P.to_tensor(np.array([[1], [2]]))
+    correct = acc.compute(pred, label)
+    acc.update(correct)
+    top1, top2 = acc.accumulate()
+    assert abs(top1 - 0.5) < 1e-6
+    assert abs(top2 - 0.5) < 1e-6
+
+
+def test_auc_precision_recall():
+    auc = M.Auc()
+    preds = np.array([0.1, 0.2, 0.8, 0.9])
+    labels = np.array([0, 0, 1, 1])
+    auc.update(preds, labels)
+    assert auc.accumulate() > 0.9
+    p = M.Precision()
+    r = M.Recall()
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.accumulate() == 1.0
+    assert r.accumulate() == 1.0
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    ds = vision.FakeData(size=32, image_shape=(1, 8, 8), num_classes=3,
+                         transform=T.ToTensor())
+
+    net = P.nn.Sequential(P.nn.Flatten(), P.nn.Linear(64, 3))
+    model = P.Model(net)
+    opt = P.optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    model.prepare(opt, P.nn.CrossEntropyLoss(), M.Accuracy())
+    model.fit(ds, epochs=2, batch_size=8, verbose=0)
+    logs = model.evaluate(ds, batch_size=8, verbose=0)
+    assert "acc" in logs and "loss" in logs
+    preds = model.predict(ds, batch_size=8, stack_outputs=True)
+    assert preds[0].shape == (32, 3)
+    model.save(str(tmp_path / "ck"))
+    model.load(str(tmp_path / "ck"))
+
+
+def test_summary():
+    net = vision.LeNet()
+    info = P.summary(net)
+    assert info["total_params"] > 0
