@@ -149,6 +149,9 @@ class EarlyStopping(Callback):
         if self._better(cur):
             self.best = cur
             self.wait = 0
+            save_dir = self.params.get("save_dir")
+            if self.save_best_model and save_dir:
+                self.model.save(os.path.join(save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait >= self.patience:
@@ -195,5 +198,5 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     lst = CallbackList(cbks)
     lst.set_model(model)
     lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
-                    "metrics": metrics or []})
+                    "metrics": metrics or [], "save_dir": save_dir})
     return lst

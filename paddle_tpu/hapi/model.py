@@ -101,10 +101,12 @@ class Model:
         return res
 
     # -- loops --------------------------------------------------------------
-    def _as_loader(self, data, batch_size, shuffle):
+    def _as_loader(self, data, batch_size, shuffle, drop_last=False,
+                   num_workers=0):
         if data is None or isinstance(data, DataLoader):
             return data
-        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
 
     def _split_batch(self, batch):
         n_in = len(self._inputs) if self._inputs else 1
@@ -115,7 +117,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
-        loader = self._as_loader(train_data, batch_size, shuffle)
+        loader = self._as_loader(train_data, batch_size, shuffle,
+                                 drop_last=drop_last, num_workers=num_workers)
         steps = len(loader) if hasattr(loader, "__len__") else None
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 steps=steps, log_freq=log_freq, verbose=verbose,
@@ -145,7 +148,8 @@ class Model:
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, _cbks=None):
-        loader = self._as_loader(eval_data, batch_size, False)
+        loader = self._as_loader(eval_data, batch_size, False,
+                                 num_workers=num_workers)
         cbks = _cbks or config_callbacks(callbacks, model=self, epochs=1,
                                          steps=None, log_freq=log_freq,
                                          verbose=verbose,
@@ -172,7 +176,8 @@ class Model:
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
                 verbose=1, callbacks=None):
-        loader = self._as_loader(test_data, batch_size, False)
+        loader = self._as_loader(test_data, batch_size, False,
+                                 num_workers=num_workers)
         outputs = []
         for batch in loader:
             ins, _ = self._split_batch(batch)

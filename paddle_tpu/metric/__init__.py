@@ -152,9 +152,9 @@ class Auc(Metric):
         tot_neg = self._neg.sum()
         if tot_pos == 0 or tot_neg == 0:
             return 0.0
-        # integrate trapezoid over thresholds high→low
-        tp = np.cumsum(self._pos[::-1])
-        fp = np.cumsum(self._neg[::-1])
+        # integrate trapezoid over thresholds high→low, anchored at (0,0)
+        tp = np.concatenate([[0], np.cumsum(self._pos[::-1])])
+        fp = np.concatenate([[0], np.cumsum(self._neg[::-1])])
         tpr = tp / tot_pos
         fpr = fp / tot_neg
         return float(np.trapezoid(tpr, fpr))

@@ -53,6 +53,9 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
     sequence_parallel: bool = False
+    # long-context: "ring" (blockwise ppermute ring attention) or "ulysses"
+    # (all-to-all head/seq re-shard) over the mesh's 'sep' axis
+    context_parallel: Optional[str] = None
     recompute: bool = False
 
     def __post_init__(self):
@@ -120,7 +123,12 @@ class LlamaAttention(Layer):
         q = shard_constraint_t(q, None, None, "mp", None)
         k = shard_constraint_t(k, None, None, "mp", None)
         v = shard_constraint_t(v, None, None, "mp", None)
-        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        cp = self.config.context_parallel
+        if cp:
+            from ..parallel.context_parallel import sdpa_context_parallel
+            attn = sdpa_context_parallel(q, k, v, mode=cp, is_causal=True)
+        else:
+            attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         attn = manip.reshape(attn, [b, s, self.num_heads * self.head_dim])
         return self.o_proj(attn)
 
@@ -169,7 +177,9 @@ class LlamaModel(Layer):
 
     def forward(self, input_ids):
         x = self.embed_tokens(input_ids)
-        x = shard_constraint_t(x, "dp", None, None)
+        # context parallel: activations sequence-sharded over 'sep' model-wide
+        seq_axis = "sep" if self.config.context_parallel else None
+        x = shard_constraint_t(x, "dp", seq_axis, None)
         for i, layer in enumerate(self.layers):
             if self.config.recompute:
                 from ..distributed.fleet.recompute import recompute

@@ -65,8 +65,14 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
         args.append(bias)
     out = apply(f, *args, op_name="batch_norm")
 
-    if training and running_mean is not None:
-        # update running stats out-of-graph (matches reference eager semantics)
+    if training and running_mean is not None and \
+            not getattr(x, "_is_static_var", False):
+        # update running stats out-of-graph (matches reference eager
+        # semantics). Skipped under static capture: a symbolic Variable has no
+        # value, and a host-side update could never be part of the recorded
+        # Program — normalization there uses in-graph batch stats and running
+        # stats stay at their captured values (train with eager/to_static if
+        # you need running-stat momentum).
         v = x._value if isinstance(x, Tensor) else x
         axes = tuple(i for i in range(v.ndim) if i != (ch_axis % v.ndim))
         m = jnp.mean(v, axis=axes)

@@ -4,3 +4,6 @@ from .mesh import (  # noqa: F401
     init_mesh, get_mesh, set_mesh, mesh_axis_size, has_mesh, axis_index,
 )
 from .trainer import compile_train_step, TrainStep  # noqa: F401
+from .context_parallel import (  # noqa: F401
+    ring_attention, ulysses_attention, sdpa_context_parallel,
+)
