@@ -1,0 +1,540 @@
+// paddle_tpu native runtime core.
+//
+// Native-equivalent of the reference's C++ runtime services (SURVEY.md §2):
+//  - flags registry        <- paddle/phi/core/flags.h:180 (gflags-backed registry)
+//  - blocking byte queue   <- paddle/fluid/operators/reader/lod_tensor_blocking_queue.h
+//  - TCPStore              <- paddle/phi/core/distributed/store/tcp_store.h:120
+//  - host tracer           <- paddle/fluid/platform/profiler/host_tracer.h:26
+//
+// Exposed as a flat C ABI consumed from Python via ctypes (no pybind11 in the
+// image). All functions are thread-safe.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#define PT_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+double now_monotonic_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
+
+PT_API void pt_free(void* p) { free(p); }
+
+PT_API long long pt_now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// Flags registry
+// ---------------------------------------------------------------------------
+
+namespace {
+std::mutex g_flags_mu;
+std::map<std::string, std::string> g_flags;
+}  // namespace
+
+PT_API void pt_flags_set(const char* key, const char* val) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  g_flags[key] = val;
+}
+
+// Returns value length (may exceed buflen; caller retries with bigger buffer),
+// or -1 if the key is absent.
+PT_API long pt_flags_get(const char* key, char* buf, long buflen) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  auto it = g_flags.find(key);
+  if (it == g_flags.end()) return -1;
+  long n = (long)it->second.size();
+  if (buf && buflen > 0) {
+    long c = n < buflen ? n : buflen;
+    memcpy(buf, it->second.data(), (size_t)c);
+  }
+  return n;
+}
+
+PT_API long pt_flags_count() {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  return (long)g_flags.size();
+}
+
+// ---------------------------------------------------------------------------
+// Bounded blocking queue of byte blobs
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Blob {
+  std::vector<uint8_t> data;
+};
+
+struct BlockingQueue {
+  explicit BlockingQueue(int cap) : capacity(cap) {}
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<Blob> items;
+  int capacity;
+  bool closed = false;
+};
+
+}  // namespace
+
+PT_API void* pt_queue_new(int capacity) {
+  return new BlockingQueue(capacity > 0 ? capacity : 1);
+}
+
+// 0 = ok, -1 = timeout, -2 = closed.
+PT_API int pt_queue_push(void* q_, const void* data, long n, double timeout_s) {
+  auto* q = (BlockingQueue*)q_;
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto ready = [&] { return q->closed || (int)q->items.size() < q->capacity; };
+  if (timeout_s < 0) {
+    q->cv_push.wait(lk, ready);
+  } else if (!q->cv_push.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                                  ready)) {
+    return -1;
+  }
+  if (q->closed) return -2;
+  Blob b;
+  b.data.assign((const uint8_t*)data, (const uint8_t*)data + n);
+  q->items.push_back(std::move(b));
+  q->cv_pop.notify_one();
+  return 0;
+}
+
+// Returns blob size (caller frees *out with pt_free), -1 = timeout,
+// -2 = closed and drained.
+PT_API long pt_queue_pop(void* q_, void** out, double timeout_s) {
+  auto* q = (BlockingQueue*)q_;
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto ready = [&] { return q->closed || !q->items.empty(); };
+  if (timeout_s < 0) {
+    q->cv_pop.wait(lk, ready);
+  } else if (!q->cv_pop.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                                 ready)) {
+    return -1;
+  }
+  if (q->items.empty()) return -2;  // closed + drained
+  Blob b = std::move(q->items.front());
+  q->items.pop_front();
+  q->cv_push.notify_one();
+  lk.unlock();
+  long n = (long)b.data.size();
+  *out = malloc((size_t)(n > 0 ? n : 1));
+  memcpy(*out, b.data.data(), (size_t)n);
+  return n;
+}
+
+PT_API int pt_queue_size(void* q_) {
+  auto* q = (BlockingQueue*)q_;
+  std::lock_guard<std::mutex> lk(q->mu);
+  return (int)q->items.size();
+}
+
+PT_API void pt_queue_close(void* q_) {
+  auto* q = (BlockingQueue*)q_;
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->closed = true;
+  q->cv_push.notify_all();
+  q->cv_pop.notify_all();
+}
+
+PT_API void pt_queue_free(void* q_) { delete (BlockingQueue*)q_; }
+
+// ---------------------------------------------------------------------------
+// TCPStore — key/value rendezvous (master server + clients)
+// ---------------------------------------------------------------------------
+// Wire protocol (all little-endian):
+//   request : u8 cmd | u32 keylen | key | u32 vallen | val
+//   response: i64 status_or_value | u32 vallen | val
+// cmds: 1=SET 2=GET(blocking until key exists) 3=ADD(i64 delta in val)
+//       4=WAIT(blocking) 5=DELETE 6=PING
+
+namespace {
+
+constexpr uint8_t kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kDel = 5, kPing = 6;
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = (uint8_t*)buf;
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = (const uint8_t*)buf;
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+struct StoreServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  std::mutex mu;
+  std::condition_variable cv;  // signalled on any mutation
+  std::map<std::string, std::vector<uint8_t>> kv;
+
+  void handle(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t cmd;
+      uint32_t klen, vlen;
+      if (!read_full(fd, &cmd, 1) || !read_full(fd, &klen, 4)) break;
+      if (klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (klen && !read_full(fd, &key[0], klen)) break;
+      if (!read_full(fd, &vlen, 4)) break;
+      if (vlen > (1u << 30)) break;
+      std::vector<uint8_t> val(vlen);
+      if (vlen && !read_full(fd, val.data(), vlen)) break;
+
+      int64_t status = 0;
+      std::vector<uint8_t> reply;
+      switch (cmd) {
+        case kSet: {
+          std::lock_guard<std::mutex> lk(mu);
+          kv[key] = std::move(val);
+          cv.notify_all();
+          break;
+        }
+        case kGet:
+        case kWait: {
+          std::unique_lock<std::mutex> lk(mu);
+          cv.wait(lk, [&] { return stopping.load() || kv.count(key) > 0; });
+          if (stopping.load() && !kv.count(key)) {
+            status = -1;
+          } else if (cmd == kGet) {
+            reply = kv[key];
+          }
+          break;
+        }
+        case kAdd: {
+          int64_t delta = 0;
+          if (val.size() == 8) memcpy(&delta, val.data(), 8);
+          std::lock_guard<std::mutex> lk(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end()) {
+            // counters are stored as decimal strings, like the reference
+            cur = atoll(std::string(it->second.begin(), it->second.end()).c_str());
+          }
+          cur += delta;
+          std::string s = std::to_string(cur);
+          kv[key].assign(s.begin(), s.end());
+          status = cur;
+          cv.notify_all();
+          break;
+        }
+        case kDel: {
+          std::lock_guard<std::mutex> lk(mu);
+          status = (int64_t)kv.erase(key);
+          cv.notify_all();
+          break;
+        }
+        case kPing:
+          status = 42;
+          break;
+        default:
+          status = -2;
+      }
+      uint32_t rlen = (uint32_t)reply.size();
+      if (!write_full(fd, &status, 8) || !write_full(fd, &rlen, 4)) break;
+      if (rlen && !write_full(fd, reply.data(), rlen)) break;
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping.load()) return;
+        continue;
+      }
+      if (stopping.load()) {
+        ::close(fd);
+        return;
+      }
+      handlers.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+};
+
+}  // namespace
+
+PT_API void* pt_store_server_start(int port) {
+  auto* s = new StoreServer();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) < 0 ||
+      ::listen(s->listen_fd, 128) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+PT_API int pt_store_server_port(void* s_) { return ((StoreServer*)s_)->port; }
+
+PT_API void pt_store_server_stop(void* s_) {
+  auto* s = (StoreServer*)s_;
+  s->stopping.store(true);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->cv.notify_all();
+  }
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  // unblock accept() on platforms where shutdown is not enough
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd >= 0) {
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons((uint16_t)s->port);
+    ::connect(fd, (sockaddr*)&addr, sizeof(addr));
+    ::close(fd);
+  }
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->handlers)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+namespace {
+
+struct StoreClient {
+  int fd = -1;
+  std::mutex mu;  // one request/response in flight per client
+};
+
+bool client_rpc(StoreClient* c, uint8_t cmd, const std::string& key,
+                const void* val, uint32_t vlen, int64_t* status,
+                std::vector<uint8_t>* reply) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t klen = (uint32_t)key.size();
+  if (!write_full(c->fd, &cmd, 1) || !write_full(c->fd, &klen, 4) ||
+      (klen && !write_full(c->fd, key.data(), klen)) ||
+      !write_full(c->fd, &vlen, 4) || (vlen && !write_full(c->fd, val, vlen)))
+    return false;
+  uint32_t rlen;
+  if (!read_full(c->fd, status, 8) || !read_full(c->fd, &rlen, 4)) return false;
+  reply->resize(rlen);
+  if (rlen && !read_full(c->fd, reply->data(), rlen)) return false;
+  return true;
+}
+
+}  // namespace
+
+PT_API void* pt_store_client_new(const char* host, int port, double timeout_s) {
+  double deadline = now_monotonic_s() + (timeout_s > 0 ? timeout_s : 1e9);
+  do {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;  // caller resolves hostnames to IPv4 in Python
+    }
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new StoreClient();
+      c->fd = fd;
+      int64_t status = 0;
+      std::vector<uint8_t> reply;
+      if (client_rpc(c, kPing, "", nullptr, 0, &status, &reply) && status == 42)
+        return c;
+      ::close(fd);
+      delete c;
+      return nullptr;
+    }
+    ::close(fd);
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  } while (now_monotonic_s() < deadline);
+  return nullptr;
+}
+
+PT_API int pt_store_set(void* c_, const char* key, const void* val, long n) {
+  int64_t status = 0;
+  std::vector<uint8_t> reply;
+  if (!client_rpc((StoreClient*)c_, kSet, key, val, (uint32_t)n, &status, &reply))
+    return -1;
+  return 0;
+}
+
+PT_API long pt_store_get(void* c_, const char* key, void** out) {
+  int64_t status = 0;
+  std::vector<uint8_t> reply;
+  if (!client_rpc((StoreClient*)c_, kGet, key, nullptr, 0, &status, &reply))
+    return -1;
+  if (status < 0) return -1;
+  long n = (long)reply.size();
+  *out = malloc((size_t)(n > 0 ? n : 1));
+  memcpy(*out, reply.data(), (size_t)n);
+  return n;
+}
+
+PT_API long long pt_store_add(void* c_, const char* key, long long delta) {
+  int64_t status = 0;
+  std::vector<uint8_t> reply;
+  int64_t d = delta;
+  if (!client_rpc((StoreClient*)c_, kAdd, key, &d, 8, &status, &reply))
+    return INT64_MIN;
+  return status;
+}
+
+PT_API int pt_store_wait(void* c_, const char* key) {
+  int64_t status = 0;
+  std::vector<uint8_t> reply;
+  if (!client_rpc((StoreClient*)c_, kWait, key, nullptr, 0, &status, &reply))
+    return -1;
+  return status < 0 ? -1 : 0;
+}
+
+PT_API int pt_store_delete(void* c_, const char* key) {
+  int64_t status = 0;
+  std::vector<uint8_t> reply;
+  if (!client_rpc((StoreClient*)c_, kDel, key, nullptr, 0, &status, &reply))
+    return -1;
+  return (int)status;
+}
+
+PT_API void pt_store_client_free(void* c_) {
+  auto* c = (StoreClient*)c_;
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+// ---------------------------------------------------------------------------
+// Host tracer — RecordEvent spans collected per thread, dumped as chrome-trace
+// "traceEvents" JSON fragments.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct TraceEvent {
+  std::string name;
+  std::string cat;
+  int64_t ts_ns;
+  int64_t dur_ns;
+  int64_t tid;
+};
+
+std::mutex g_trace_mu;
+std::vector<TraceEvent> g_trace_events;
+std::atomic<bool> g_trace_on{false};
+
+void json_escape(const std::string& in, std::string* out) {
+  for (char ch : in) {
+    if (ch == '"' || ch == '\\') {
+      out->push_back('\\');
+      out->push_back(ch);
+    } else if ((unsigned char)ch < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", ch);
+      *out += buf;
+    } else {
+      out->push_back(ch);
+    }
+  }
+}
+
+}  // namespace
+
+PT_API void pt_trace_enable(int on) { g_trace_on.store(on != 0); }
+PT_API int pt_trace_is_enabled() { return g_trace_on.load() ? 1 : 0; }
+
+PT_API void pt_trace_record(const char* name, const char* cat, long long ts_ns,
+                            long long dur_ns, long long tid) {
+  if (!g_trace_on.load()) return;
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  g_trace_events.push_back(TraceEvent{name, cat ? cat : "op", ts_ns, dur_ns, tid});
+}
+
+PT_API void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  g_trace_events.clear();
+}
+
+PT_API long pt_trace_count() {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  return (long)g_trace_events.size();
+}
+
+// Dumps a JSON array of chrome-trace "X" (complete) events; caller pt_free()s.
+PT_API long pt_trace_dump(void** out) {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  std::string s = "[";
+  for (size_t i = 0; i < g_trace_events.size(); ++i) {
+    const auto& e = g_trace_events[i];
+    if (i) s += ",";
+    s += "{\"ph\":\"X\",\"pid\":0,\"tid\":";
+    s += std::to_string(e.tid);
+    s += ",\"ts\":";
+    s += std::to_string((double)e.ts_ns / 1000.0);
+    s += ",\"dur\":";
+    s += std::to_string((double)e.dur_ns / 1000.0);
+    s += ",\"name\":\"";
+    json_escape(e.name, &s);
+    s += "\",\"cat\":\"";
+    json_escape(e.cat, &s);
+    s += "\"}";
+  }
+  s += "]";
+  long n = (long)s.size();
+  *out = malloc((size_t)n + 1);
+  memcpy(*out, s.data(), (size_t)n + 1);
+  return n;
+}

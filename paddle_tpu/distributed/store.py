@@ -1,0 +1,255 @@
+"""TCPStore rendezvous.
+
+Analog of paddle/phi/core/distributed/store/tcp_store.h:120 (TCPStore master +
+clients used to exchange comm bootstrap info; collective.py:153 passes the
+store into ProcessGroup creation). The server and wire protocol live in the
+native runtime (paddle_tpu/csrc/runtime.cc); this wraps them with the
+reference's Python-facing API: set/get/add/wait with a master that rank 0
+hosts. A pure-Python fallback server keeps tests running if the native build
+is unavailable.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional
+
+from ..utils import native
+
+_SET, _GET, _ADD, _WAIT, _DEL, _PING = 1, 2, 3, 4, 5, 6
+
+
+class _PyStoreServer:
+    """Fallback Python implementation of the same wire protocol."""
+
+    def __init__(self, port: int):
+        self._kv = {}
+        self._cond = threading.Condition()
+        self._stopping = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._threads = []
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept.start()
+
+    def _accept_loop(self):
+        while not self._stopping:
+            try:
+                fd, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._handle, args=(fd,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    @staticmethod
+    def _read_full(fd, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = fd.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _handle(self, fd):
+        fd.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                hdr = self._read_full(fd, 5)
+                if hdr is None:
+                    return
+                cmd, klen = struct.unpack("<BI", hdr)
+                key = self._read_full(fd, klen).decode() if klen else ""
+                (vlen,) = struct.unpack("<I", self._read_full(fd, 4))
+                val = self._read_full(fd, vlen) if vlen else b""
+                status, reply = 0, b""
+                if cmd == _SET:
+                    with self._cond:
+                        self._kv[key] = val
+                        self._cond.notify_all()
+                elif cmd in (_GET, _WAIT):
+                    with self._cond:
+                        self._cond.wait_for(
+                            lambda: self._stopping or key in self._kv)
+                        if key in self._kv:
+                            if cmd == _GET:
+                                reply = self._kv[key]
+                        else:
+                            status = -1
+                elif cmd == _ADD:
+                    (delta,) = struct.unpack("<q", val)
+                    with self._cond:
+                        cur = int(self._kv.get(key, b"0") or b"0") + delta
+                        self._kv[key] = str(cur).encode()
+                        status = cur
+                        self._cond.notify_all()
+                elif cmd == _DEL:
+                    with self._cond:
+                        status = int(self._kv.pop(key, None) is not None)
+                        self._cond.notify_all()
+                elif cmd == _PING:
+                    status = 42
+                else:
+                    status = -2
+                fd.sendall(struct.pack("<qI", status, len(reply)) + reply)
+        except OSError:
+            pass
+        finally:
+            fd.close()
+
+    def stop(self):
+        self._stopping = True
+        with self._cond:
+            self._cond.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """Key/value store: master hosts the server, every rank connects a client.
+
+    API mirrors the reference store (set/get/add/wait/delete_key).
+    """
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self.host = host
+        self.is_master = is_master
+        self.world_size = world_size
+        self._server = None
+        self._py_server = None
+        lib = native.get_lib()
+        if is_master:
+            if lib is not None:
+                self._server = lib.pt_store_server_start(int(port))
+                if not self._server:
+                    raise RuntimeError(f"TCPStore: cannot bind port {port}")
+                port = lib.pt_store_server_port(self._server)
+            else:
+                self._py_server = _PyStoreServer(port)
+                port = self._py_server.port
+        self.port = port
+        addr = socket.gethostbyname(host) if host != "localhost" else "127.0.0.1"
+        self._lib = lib
+        if lib is not None:
+            self._client = lib.pt_store_client_new(addr.encode(), int(port),
+                                                   float(timeout))
+            if not self._client:
+                raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+        else:
+            self._client = _PyClient(addr, int(port), timeout)
+
+    # --- client ops ---
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        if self._lib is not None:
+            rc = self._lib.pt_store_set(self._client, key.encode(), value, len(value))
+            if rc != 0:
+                raise RuntimeError("TCPStore.set failed")
+        else:
+            self._client.rpc(_SET, key, value)
+
+    def get(self, key: str) -> bytes:
+        if self._lib is not None:
+            import ctypes
+            out = ctypes.c_void_p()
+            n = self._lib.pt_store_get(self._client, key.encode(), ctypes.byref(out))
+            if n < 0:
+                raise RuntimeError(f"TCPStore.get({key!r}) failed")
+            return native._take_bytes(self._lib, out, n)
+        status, reply = self._client.rpc(_GET, key)
+        if status < 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) failed")
+        return reply
+
+    def add(self, key: str, delta: int) -> int:
+        if self._lib is not None:
+            v = self._lib.pt_store_add(self._client, key.encode(), int(delta))
+            if v == -(2 ** 63):
+                raise RuntimeError("TCPStore.add failed")
+            return int(v)
+        status, _ = self._client.rpc(_ADD, key, struct.pack("<q", int(delta)))
+        return status
+
+    def wait(self, key: str) -> None:
+        if self._lib is not None:
+            if self._lib.pt_store_wait(self._client, key.encode()) != 0:
+                raise RuntimeError(f"TCPStore.wait({key!r}) failed")
+        else:
+            self._client.rpc(_WAIT, key)
+
+    def delete_key(self, key: str) -> bool:
+        if self._lib is not None:
+            return self._lib.pt_store_delete(self._client, key.encode()) > 0
+        status, _ = self._client.rpc(_DEL, key)
+        return status > 0
+
+    def stop(self):
+        if self._lib is not None:
+            if self._client:
+                self._lib.pt_store_client_free(self._client)
+                self._client = None
+            if self._server:
+                self._lib.pt_store_server_stop(self._server)
+                self._server = None
+        else:
+            self._client.close()
+            if self._py_server is not None:
+                self._py_server.stop()
+                self._py_server = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class _PyClient:
+    def __init__(self, addr: str, port: int, timeout: float):
+        import time
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection((addr, port), timeout=5)
+                self._sock.settimeout(None)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._lock = threading.Lock()
+                status, _ = self.rpc(_PING, "")
+                if status == 42:
+                    return
+            except OSError as e:
+                last = e
+                time.sleep(0.05)
+        raise RuntimeError(f"TCPStore: cannot connect {addr}:{port}: {last}")
+
+    def rpc(self, cmd: int, key: str, val: bytes = b""):
+        kb = key.encode()
+        msg = struct.pack("<BI", cmd, len(kb)) + kb + struct.pack("<I", len(val)) + val
+        with self._lock:
+            self._sock.sendall(msg)
+            hdr = _PyStoreServer._read_full(self._sock, 12)
+            if hdr is None:
+                raise RuntimeError("TCPStore connection closed")
+            status, rlen = struct.unpack("<qI", hdr)
+            reply = _PyStoreServer._read_full(self._sock, rlen) if rlen else b""
+        return status, reply
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def create_master_store(port: int = 0, world_size: int = 1) -> TCPStore:
+    return TCPStore("127.0.0.1", port, is_master=True, world_size=world_size)

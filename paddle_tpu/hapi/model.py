@@ -142,7 +142,8 @@ class Model:
             cbks.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose, callbacks=callbacks,
+                              log_freq=log_freq, verbose=verbose,
+                              num_workers=num_workers, callbacks=callbacks,
                               _cbks=cbks)
         cbks.on_train_end(logs)
 

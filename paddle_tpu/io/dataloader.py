@@ -68,6 +68,92 @@ def _to_tensor_tree(obj):
     return obj
 
 
+_CLOSED = object()
+
+
+class _NativeOutQueue:
+    """Bounded handoff over the native C++ ring buffer.
+
+    The ring carries 8-byte tokens (bounded blocking semantics live in C++);
+    the batch objects themselves stay in-process in a side table, so the
+    handoff is zero-copy.
+    """
+
+    def __init__(self, depth):
+        import struct
+        from ..utils.native import BlockingQueue
+        self._q = BlockingQueue(depth)
+        self._struct = struct
+        self._table = {}
+        self._lock = threading.Lock()
+        self._next = 0
+
+    def put(self, obj) -> bool:
+        with self._lock:
+            tok = self._next
+            self._next += 1
+            self._table[tok] = obj
+        try:
+            self._q.push(self._struct.pack("<q", tok))
+            return True
+        except RuntimeError:  # closed by consumer
+            with self._lock:
+                self._table.pop(tok, None)
+            return False
+
+    def get(self):
+        try:
+            blob = self._q.pop()
+        except RuntimeError:
+            return _CLOSED
+        if blob is None:
+            return _CLOSED
+        (tok,) = self._struct.unpack("<q", blob)
+        with self._lock:
+            return self._table.pop(tok)
+
+    def close(self):
+        self._q.close()
+
+
+class _PyOutQueue:
+    def __init__(self, depth):
+        self._q = pyqueue.Queue(maxsize=depth)
+        self._closed = False
+
+    def put(self, obj) -> bool:
+        while not self._closed:
+            try:
+                self._q.put(obj, timeout=0.1)
+                return True
+            except pyqueue.Full:
+                continue
+        return False
+
+    def get(self):
+        while True:
+            try:
+                return self._q.get(timeout=0.1)
+            except pyqueue.Empty:
+                if self._closed:
+                    # drain: the producer may have put+closed between our
+                    # Empty and the _closed check
+                    try:
+                        return self._q.get_nowait()
+                    except pyqueue.Empty:
+                        return _CLOSED
+
+    def close(self):
+        self._closed = True
+
+
+def _make_blocking_queue(depth):
+    from ..utils import native
+    if native.available():
+        return _NativeOutQueue(depth)
+    return _PyOutQueue(depth)
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, seed):
     np.random.seed((seed + worker_id) % (2 ** 31))
     while True:
@@ -155,37 +241,70 @@ class DataLoader:
 
         batches = list(self.batch_sampler)
         n = len(batches)
-        # prime the pipeline
-        send_idx = 0
-        buffered = {}
-        recv_idx = 0
-        inflight = 0
+        depth = max(1, self.num_workers * self.prefetch_factor)
+        # A background receiver thread drains the mp queue, restores batch
+        # order, and feeds a bounded blocking queue (native C++ ring when
+        # built — the LoDTensorBlockingQueue pattern: host decode overlaps
+        # the consumer's host->device transfer).
+        out_q = _make_blocking_queue(depth)
+        state = {"send_idx": 0, "error": None, "stop": False}
+        lock = threading.Lock()
+
+        def submit():
+            with lock:
+                if state["send_idx"] < n and not state["stop"]:
+                    i = state["send_idx"]
+                    index_queues[i % self.num_workers].put((i, batches[i]))
+                    state["send_idx"] += 1
+                    return True
+            return False
+
+        for _ in range(min(n, depth)):
+            submit()
+
+        def receiver():
+            buffered = {}
+            recv_idx = 0
+            try:
+                while recv_idx < n and not state["stop"]:
+                    while recv_idx not in buffered:
+                        try:
+                            bid, data, err = data_queue.get(timeout=0.2)
+                        except pyqueue.Empty:
+                            if state["stop"]:
+                                return
+                            continue
+                        if err is not None:
+                            raise RuntimeError(f"DataLoader worker failed:\n{err}")
+                        buffered[bid] = data
+                        submit()
+                    if not out_q.put(buffered.pop(recv_idx)):
+                        return  # consumer abandoned the iterator
+                    recv_idx += 1
+            except BaseException as e:  # surfaced to the consumer below
+                state["error"] = e
+            finally:
+                out_q.close()
+
+        rt = threading.Thread(target=receiver, daemon=True)
+        rt.start()
         try:
-            while send_idx < n and inflight < self.num_workers * self.prefetch_factor:
-                index_queues[send_idx % self.num_workers].put((send_idx, batches[send_idx]))
-                send_idx += 1
-                inflight += 1
-            while recv_idx < n:
-                while recv_idx not in buffered:
-                    bid, data, err = data_queue.get()
-                    if err is not None:
-                        raise RuntimeError(f"DataLoader worker failed:\n{err}")
-                    buffered[bid] = data
-                    inflight -= 1
-                    if send_idx < n:
-                        index_queues[send_idx % self.num_workers].put(
-                            (send_idx, batches[send_idx]))
-                        send_idx += 1
-                        inflight += 1
-                data = buffered.pop(recv_idx)
-                recv_idx += 1
+            for _ in range(n):
+                data = out_q.get()
+                if data is _CLOSED:
+                    break
                 yield _to_tensor_tree(data)
+            if state["error"] is not None:
+                raise state["error"]
         finally:
+            state["stop"] = True
+            out_q.close()
             for iq in index_queues:
                 try:
                     iq.put(None)
                 except Exception:
                     pass
+            rt.join(timeout=2.0)
             for w in workers:
                 w.join(timeout=1.0)
                 if w.is_alive():

@@ -1,49 +1,83 @@
-"""Global flag registry.
+"""Global flag registry, backed by the native C++ registry when built.
 
-Analog of the reference's exported-flag system (paddle/phi/core/flags.h:180,
-python paddle.set_flags/get_flags, python/paddle/fluid/framework.py:7754):
-a process-global registry seeded from FLAGS_* environment variables.
+Analog of the reference's exported-flag system (paddle/phi/core/flags.h:180
+PHI_DEFINE_EXPORTED_*, python paddle.set_flags/get_flags,
+python/paddle/fluid/framework.py:7754): a process-global registry seeded from
+FLAGS_* environment variables. Values are stored in the C++ registry
+(paddle_tpu/csrc/runtime.cc) so native runtime services observe the same
+flags; the Python side keeps the type of each flag's default for parsing.
 """
 from __future__ import annotations
 
 import os
 from typing import Any, Dict
 
-_REGISTRY: Dict[str, Any] = {}
+from . import native
+
+_TYPES: Dict[str, type] = {}
+_PY_FALLBACK: Dict[str, str] = {}
+
+
+def _store(name: str, val: str):
+    lib = native.get_lib()
+    if lib is not None:
+        lib.pt_flags_set(name.encode(), val.encode())
+    else:
+        _PY_FALLBACK[name] = val
+
+
+def _load(name: str):
+    lib = native.get_lib()
+    if lib is not None:
+        import ctypes
+        size = 4096
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            n = lib.pt_flags_get(name.encode(), buf, size)
+            if n < 0:
+                return None
+            if n <= size:
+                return buf.raw[:n].decode()
+            size = n  # value longer than the buffer: retry at the true length
+    return _PY_FALLBACK.get(name)
+
+
+def _parse(name: str, raw: str):
+    ty = _TYPES.get(name, str)
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return ty(raw)
 
 
 def define_flag(name: str, default, help_: str = ""):
+    _TYPES[name] = type(default)
     env = os.environ.get(name)
-    if env is not None:
-        if isinstance(default, bool):
-            val = env.lower() in ("1", "true", "yes")
-        elif isinstance(default, int):
-            val = int(env)
-        elif isinstance(default, float):
-            val = float(env)
-        else:
-            val = env
-    else:
-        val = default
-    _REGISTRY[name] = val
-    return val
+    raw = env if env is not None else str(default)
+    _store(name, raw)
+    return _parse(name, raw)
 
 
 def set_flags(flags: Dict[str, Any]):
     for k, v in flags.items():
-        if k not in _REGISTRY:
+        if k not in _TYPES:
             raise KeyError(f"unknown flag {k!r}")
-        _REGISTRY[k] = v
+        _store(k, str(v))
 
 
 def get_flags(names):
     if isinstance(names, str):
         names = [names]
-    return {n: _REGISTRY[n] for n in names}
+    out = {}
+    for n in names:
+        if n not in _TYPES:
+            raise KeyError(f"unknown flag {n!r}")
+        out[n] = _parse(n, _load(n))
+    return out
 
 
 def flag(name: str):
-    return _REGISTRY.get(name)
+    raw = _load(name)
+    return None if raw is None else _parse(name, raw)
 
 
 # core flags (subset of paddle/phi/core/flags.cc that is meaningful on TPU)
@@ -51,3 +85,5 @@ define_flag("FLAGS_check_nan_inf", False, "scan outputs for nan/inf after each o
 define_flag("FLAGS_use_bf16_matmul", True, "prefer bf16 matmul accumulation under AMP")
 define_flag("FLAGS_allocator_strategy", "xla", "memory handled by XLA/PJRT arena")
 define_flag("FLAGS_log_level", "info", "framework log level")
+define_flag("FLAGS_host_trace_level", 1, "host tracer verbosity (profiler)")
+define_flag("FLAGS_benchmark", False, "per-iteration timing logs")

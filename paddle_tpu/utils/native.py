@@ -1,0 +1,160 @@
+"""Loader for the native C++ runtime core (paddle_tpu/csrc/runtime.cc).
+
+The reference ships these services as C++ (flags registry
+paddle/phi/core/flags.h:180, LoDTensorBlockingQueue, TCPStore
+paddle/phi/core/distributed/store/tcp_store.h:120, host tracer
+paddle/fluid/platform/profiler/host_tracer.h:26). We compile the single-TU
+runtime with g++ on first import (pybind11 is unavailable — flat C ABI via
+ctypes) and cache the .so next to the source.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
+_SRC = os.path.join(_CSRC, "runtime.cc")
+_SO = os.path.join(_CSRC, "libpaddle_tpu_rt.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_error: str | None = None
+
+
+def _build() -> str | None:
+    """(Re)build the shared library if missing or stale. Returns error or None."""
+    try:
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return None
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+               "-fvisibility=hidden", _SRC, "-o", _SO + ".tmp"]
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            return proc.stderr[-2000:]
+        os.replace(_SO + ".tmp", _SO)
+        return None
+    except Exception as e:  # toolchain missing etc. — callers fall back to Python
+        return str(e)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    sigs = {
+        "pt_free": (None, [c.c_void_p]),
+        "pt_now_ns": (c.c_longlong, []),
+        "pt_flags_set": (None, [c.c_char_p, c.c_char_p]),
+        "pt_flags_get": (c.c_long, [c.c_char_p, c.c_char_p, c.c_long]),
+        "pt_flags_count": (c.c_long, []),
+        "pt_queue_new": (c.c_void_p, [c.c_int]),
+        "pt_queue_push": (c.c_int, [c.c_void_p, c.c_char_p, c.c_long, c.c_double]),
+        "pt_queue_pop": (c.c_long, [c.c_void_p, c.POINTER(c.c_void_p), c.c_double]),
+        "pt_queue_size": (c.c_int, [c.c_void_p]),
+        "pt_queue_close": (None, [c.c_void_p]),
+        "pt_queue_free": (None, [c.c_void_p]),
+        "pt_store_server_start": (c.c_void_p, [c.c_int]),
+        "pt_store_server_port": (c.c_int, [c.c_void_p]),
+        "pt_store_server_stop": (None, [c.c_void_p]),
+        "pt_store_client_new": (c.c_void_p, [c.c_char_p, c.c_int, c.c_double]),
+        "pt_store_set": (c.c_int, [c.c_void_p, c.c_char_p, c.c_char_p, c.c_long]),
+        "pt_store_get": (c.c_long, [c.c_void_p, c.c_char_p, c.POINTER(c.c_void_p)]),
+        "pt_store_add": (c.c_longlong, [c.c_void_p, c.c_char_p, c.c_longlong]),
+        "pt_store_wait": (c.c_int, [c.c_void_p, c.c_char_p]),
+        "pt_store_delete": (c.c_int, [c.c_void_p, c.c_char_p]),
+        "pt_store_client_free": (None, [c.c_void_p]),
+        "pt_trace_enable": (None, [c.c_int]),
+        "pt_trace_is_enabled": (c.c_int, []),
+        "pt_trace_record": (None, [c.c_char_p, c.c_char_p, c.c_longlong,
+                                   c.c_longlong, c.c_longlong]),
+        "pt_trace_clear": (None, []),
+        "pt_trace_count": (c.c_long, []),
+        "pt_trace_dump": (c.c_long, [c.POINTER(c.c_void_p)]),
+    }
+    for name, (res, args) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+    return lib
+
+
+def get_lib():
+    """Compile-on-demand and return the ctypes library, or None if unavailable."""
+    global _lib, _load_error
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            return None
+        err = _build()
+        if err is not None:
+            _load_error = err
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError as e:
+            _load_error = str(e)
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def load_error() -> str | None:
+    get_lib()
+    return _load_error
+
+
+def _take_bytes(lib, ptr: ctypes.c_void_p, n: int) -> bytes:
+    try:
+        return ctypes.string_at(ptr, n)
+    finally:
+        lib.pt_free(ptr)
+
+
+class BlockingQueue:
+    """Bounded blocking queue of byte blobs backed by the native ring buffer.
+
+    Analog of the reference's LoDTensorBlockingQueue feeding the device from a
+    background thread. Falls back to queue.Queue semantics via the wrapper in
+    io/dataloader.py when the native library is unavailable.
+    """
+
+    def __init__(self, capacity: int):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_load_error}")
+        self._q = self._lib.pt_queue_new(int(capacity))
+
+    def push(self, data: bytes, timeout: float = -1.0) -> bool:
+        rc = self._lib.pt_queue_push(self._q, data, len(data), float(timeout))
+        if rc == -2:
+            raise RuntimeError("queue closed")
+        return rc == 0
+
+    def pop(self, timeout: float = -1.0):
+        out = ctypes.c_void_p()
+        n = self._lib.pt_queue_pop(self._q, ctypes.byref(out), float(timeout))
+        if n == -1:
+            return None  # timeout
+        if n == -2:
+            raise RuntimeError("queue closed")
+        return _take_bytes(self._lib, out, n)
+
+    def size(self) -> int:
+        return self._lib.pt_queue_size(self._q)
+
+    def close(self):
+        self._lib.pt_queue_close(self._q)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_q", None):
+                self._lib.pt_queue_free(self._q)
+                self._q = None
+        except Exception:
+            pass
