@@ -12,6 +12,13 @@ from .env import (  # noqa: F401
 )
 from .parallel import DataParallel, shard_batch  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
+    reshard, shard_layer, shard_op, Strategy, to_static,
+)
+from .utils import global_scatter, global_gather  # noqa: F401
+from .store import TCPStore  # noqa: F401
 
 from ..parallel.mesh import init_mesh, get_mesh  # noqa: F401
 

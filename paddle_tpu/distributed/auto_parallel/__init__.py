@@ -1,0 +1,36 @@
+"""Semi-automatic SPMD auto-parallel.
+
+Analog of python/paddle/distributed/auto_parallel/: ProcessMesh
+(process_mesh.py), shard_tensor/shard_op (interface.py), placements
+(Shard/Replicate/Partial), reshard, and the static Engine
+(auto_parallel/static/engine.py:55).
+
+TPU-native mapping: a ProcessMesh IS a jax.sharding.Mesh; placements map to a
+PartitionSpec; shard_tensor = device_put under a NamedSharding; the
+Completer/Partitioner/Resharder pipeline (completion.py:937,
+parallelizer_v2.py:57) is XLA GSPMD sharding propagation — annotate inputs +
+params, jit, and the compiler inserts the collectives the Resharder would.
+"""
+from .process_mesh import ProcessMesh, get_current_mesh  # noqa: F401
+from .placement import Shard, Replicate, Partial, Placement  # noqa: F401
+from .api import (  # noqa: F401
+    shard_tensor, dtensor_from_fn, reshard, shard_layer, shard_op,
+    placements_to_spec, get_placements,
+)
+from .engine import Engine  # noqa: F401
+from .strategy import Strategy  # noqa: F401
+
+# paddle exposes these at paddle.distributed.* too
+__all__ = [
+    "ProcessMesh", "Shard", "Replicate", "Partial", "Placement",
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer", "shard_op",
+    "Engine", "Strategy", "to_static",
+]
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Analog of paddle.distributed.to_static: wrap a (sharded) dygraph layer
+    + loader + loss + optimizer into an Engine-backed DistModel."""
+    e = Engine(layer, loss=loss, optimizer=optimizer, strategy=strategy)
+    e.prepare_from_loader(loader)
+    return e

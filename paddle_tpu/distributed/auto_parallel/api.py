@@ -1,0 +1,164 @@
+"""shard_tensor / reshard / shard_layer / shard_op.
+
+Analog of python/paddle/distributed/auto_parallel/interface.py (shard_tensor,
+shard_op) and the dygraph DistTensor path (phi/core/distributed/auto_parallel/
+— reshard functions r_to_s/s_to_r). On TPU: placements -> PartitionSpec ->
+NamedSharding; reshard is jax.device_put (XLA emits the collective the
+reference implements per-case in *_reshard_function.cc).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Parameter, Tensor
+from .placement import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+
+
+def placements_to_spec(mesh: ProcessMesh, placements: Sequence[Placement],
+                       ndim: int) -> PartitionSpec:
+    """One placement per mesh dim -> PartitionSpec over tensor dims."""
+    entries: list = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if pl is None or pl.is_replicated() or pl.is_partial():
+            continue
+        if isinstance(pl, Shard):
+            d = pl.dim if pl.dim >= 0 else pl.dim + ndim
+            if not (0 <= d < ndim):
+                raise ValueError(f"Shard(dim={pl.dim}) out of range for ndim={ndim}")
+            name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+    return PartitionSpec(*entries)
+
+
+def _sharding_for(mesh: ProcessMesh, placements, ndim) -> NamedSharding:
+    if placements is None:
+        placements = [Replicate()] * mesh.ndim
+    return NamedSharding(mesh.jax_mesh(), placements_to_spec(mesh, placements, ndim))
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements=None, dtype=None,
+                 place=None, stop_gradient=None):
+    """Distribute `data` over `mesh` per `placements`; returns a Tensor whose
+    jax.Array carries the NamedSharding (the DistTensor analog). Parameters
+    additionally record the spec so compiled train steps keep it."""
+    t = data if isinstance(data, Tensor) else Tensor(data)
+    sharding = _sharding_for(mesh, placements, t.ndim)
+    val = t._value
+    if any(isinstance(p, Partial) for p in (placements or [])):
+        raise ValueError("shard_tensor cannot create Partial placements; "
+                         "Partial only arises from computation")
+    if not isinstance(val, jax.core.Tracer):
+        val = jax.device_put(val, sharding)
+    if isinstance(t, Parameter):
+        t._value = val
+        t._sharding = tuple(sharding.spec) + (None,) * (t.ndim - len(sharding.spec))
+        out = t
+    else:
+        out = Tensor(val, stop_gradient=t.stop_gradient if stop_gradient is None
+                     else stop_gradient, name=t.name)
+        if isinstance(val, jax.core.Tracer):
+            out._value = jax.lax.with_sharding_constraint(val, sharding)
+    return out
+
+
+def get_placements(t: Tensor, mesh: ProcessMesh):
+    """Recover a placements list from the tensor's current sharding."""
+    val = t._value
+    sh = getattr(val, "sharding", None)
+    out = [Replicate() for _ in range(mesh.ndim)]
+    spec = getattr(sh, "spec", None)
+    if spec is None:
+        return out
+    for tdim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for n in names:
+            if n in mesh.dim_names:
+                out[mesh.dim_names.index(n)] = Shard(tdim)
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements, *args, **kwargs):
+    """Analog of paddle.distributed.dtensor_from_fn: build then distribute."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x, mesh: ProcessMesh, placements):
+    """Re-distribute to new placements. XLA chooses the collective
+    (all-gather / all-to-all / slice) — the Resharder analog (reshard.py)."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    sharding = _sharding_for(mesh, placements, t.ndim)
+    if isinstance(t._value, jax.core.Tracer):
+        out = Tensor(jax.lax.with_sharding_constraint(t._value, sharding),
+                     stop_gradient=t.stop_gradient)
+    else:
+        out = Tensor(jax.device_put(t._value, sharding),
+                     stop_gradient=t.stop_gradient)
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """Analog of paddle.distributed.shard_layer: distribute a layer's params.
+
+    shard_fn(name, layer, process_mesh) mutates sublayer params via
+    shard_tensor; default replicates every parameter onto the mesh.
+    """
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in list(sublayer.named_parameters(include_sublayers=False)):
+                shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+
+    if input_fn is not None or output_fn is not None:
+        orig_forward = layer.forward
+
+        def wrapped(*a, **k):
+            if input_fn is not None:
+                a = input_fn(a, process_mesh)
+            out = orig_forward(*a, **k)
+            if output_fn is not None:
+                out = output_fn(out, process_mesh)
+            return out
+        layer.forward = wrapped
+    return layer
+
+
+def shard_op(op: Callable, mesh: ProcessMesh, in_placements=None,
+             out_placements=None):
+    """Annotate an op call with input/output placements (interface.py shard_op):
+    constrains the op's operands/results; GSPMD propagates the rest."""
+    def call(*args, **kwargs):
+        if in_placements is not None:
+            new_args = []
+            for a, pl in zip(args, in_placements):
+                if pl is not None and isinstance(a, Tensor):
+                    a = reshard(a, mesh, pl)
+                new_args.append(a)
+            args = tuple(new_args) + args[len(in_placements):]
+        out = op(*args, **kwargs)
+        if out_placements is not None:
+            if isinstance(out, (tuple, list)):
+                pls = list(out_placements) + [None] * (len(out) - len(out_placements))
+                out = type(out)(
+                    reshard(o, mesh, pl) if pl is not None and isinstance(o, Tensor)
+                    else o for o, pl in zip(out, pls))
+            elif isinstance(out, Tensor):
+                out = reshard(out, mesh, out_placements[0]
+                              if isinstance(out_placements[0], (list, tuple))
+                              else out_placements)
+        return out
+    return call

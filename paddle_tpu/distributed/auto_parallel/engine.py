@@ -1,0 +1,194 @@
+"""Auto-parallel Engine — analog of
+python/paddle/distributed/auto_parallel/static/engine.py:55 (fit/evaluate/
+predict/prepare over a serial model + dist annotations).
+
+The reference pipeline — trace to a serial Program, Completer propagates
+dist attrs (completion.py:937), Partitioner splits per rank, Resharder inserts
+comm ops (parallelizer_v2.py:57) — is on TPU: read the placements already on
+params/inputs, jit the whole step, and let GSPMD partition + insert
+collectives. The Engine therefore compiles one SPMD program per mode.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ...core.tensor import Tensor
+from ...parallel import mesh as mesh_mod
+from ...parallel.trainer import compile_train_step
+from .process_mesh import ProcessMesh, get_current_mesh
+from .strategy import Strategy
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy: Optional[Strategy] = None, cluster=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = list(metrics) if metrics is not None else []
+        self._strategy = strategy or Strategy()
+        self._train_step = None
+        self._eval_fn = None
+        self._pred_fn = None
+        self.history = None
+        mesh = get_current_mesh()
+        if mesh is not None:
+            mesh.install()
+        elif mesh_mod.get_mesh() is None:
+            mesh_mod.init_mesh({"dp": len(jax.devices())})
+
+    # ------------------------------------------------------------------
+    def _build_train_step(self):
+        if self._train_step is not None:
+            return
+        remat = bool(self._strategy.recompute.enable)
+        loss_mod = self._loss
+
+        def loss_fn(model, batch):
+            ins, labels = batch
+            out = model(*ins) if isinstance(ins, (list, tuple)) else model(ins)
+            return loss_mod(out, *labels) if labels else loss_mod(out)
+
+        self._train_step = compile_train_step(
+            self._model, loss_fn, self._optimizer, remat=remat)
+
+    @staticmethod
+    def _split(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) == 2:
+            ins, labels = batch
+        else:
+            ins, labels = batch, []
+        if not isinstance(ins, (list, tuple)):
+            ins = [ins]
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        return list(ins), list(labels)
+
+    def _as_loader(self, data, batch_size):
+        from ...io import DataLoader
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=False)
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, valid_data=None,
+            verbose=1, callbacks=None, nvprof_range=None):
+        self._build_train_step()
+        loader = self._as_loader(train_data, batch_size)
+        history = {"loss": []}
+        if valid_data is not None:
+            history["eval_loss"] = []
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                ins, labels = self._split(batch)
+                loss = self._train_step((ins, labels))
+                history["loss"].append(float(loss.numpy()))
+                if verbose and log_freq and step % log_freq == 0:
+                    print(f"[auto_parallel.Engine] epoch {epoch} step {step} "
+                          f"loss {history['loss'][-1]:.6f}")
+            if valid_data is not None:
+                history["eval_loss"].append(
+                    self.evaluate(valid_data, batch_size=batch_size,
+                                  verbose=0)["loss"])
+        self.history = history
+        return history
+
+    def _state_tensors(self):
+        """Live params+buffers — passed as jit ARGUMENTS so compiled eval/
+        predict programs always see current weights (TrainStep mutates
+        p._value in place between calls)."""
+        ps = [p for _, p in self._model.named_parameters()]
+        bs = [b for _, b in self._model.named_buffers()]
+        return ps + bs
+
+    def _forward_fn(self, with_loss: bool):
+        model, loss_mod = self._model, self._loss
+        state = self._state_tensors()
+
+        def fn(state_vals, ins_vals, label_vals):
+            from ...autograd.grad_mode import no_grad
+            saved = [s._value for s in state]
+            try:
+                for s, v in zip(state, state_vals):
+                    s._value = v
+                with no_grad():
+                    out = model(*[Tensor(v) for v in ins_vals])
+                    if with_loss:
+                        out = loss_mod(out, *[Tensor(v) for v in label_vals])
+            finally:
+                for s, v in zip(state, saved):
+                    s._value = v
+            return out._value if isinstance(out, Tensor) else \
+                [o._value for o in out]
+        return jax.jit(fn)
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, verbose=1, callbacks=None):
+        if self._eval_fn is None:
+            self._eval_fn = self._forward_fn(with_loss=True)
+        total, count = 0.0, 0
+        for step, batch in enumerate(self._as_loader(valid_data, batch_size)):
+            if steps is not None and step >= steps:
+                break
+            ins, labels = self._split(batch)
+            val = self._eval_fn([s._value for s in self._state_tensors()],
+                                [t._value for t in ins],
+                                [t._value for t in labels])
+            total += float(val)
+            count += 1
+        logs = {"loss": total / max(count, 1)}
+        if verbose:
+            print(f"[auto_parallel.Engine] eval loss {logs['loss']:.6f}")
+        return logs
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1,
+                steps=None, verbose=1, callbacks=None):
+        if self._pred_fn is None:
+            self._pred_fn = self._forward_fn(with_loss=False)
+        outs = []
+        for step, batch in enumerate(self._as_loader(test_data, batch_size)):
+            if steps is not None and step >= steps:
+                break
+            ins, _ = self._split(batch)
+            res = self._pred_fn([s._value for s in self._state_tensors()],
+                                [t._value for t in ins], [])
+            outs.append(Tensor(res) if not isinstance(res, list)
+                        else [Tensor(r) for r in res])
+        return outs
+
+    def prepare_from_loader(self, loader):
+        """Used by dist.to_static: bind a loader for __call__-style stepping."""
+        self._loader = loader
+        self._build_train_step()
+        return self
+
+    def dist_main_program(self, mode="train"):  # parity shim: XLA owns programs
+        return None
+
+    def __call__(self, *batch):
+        """DistModel-style: one train step on an explicit batch."""
+        self._build_train_step()
+        ins, labels = self._split(batch if len(batch) > 1 else batch[0])
+        return self._train_step((ins, labels))
+
+    # checkpoint parity (engine.save/load)
+    def save(self, path, training=True):
+        import os
+        from ...framework_io import save as save_fn
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        save_fn(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save_fn(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ...framework_io import load as load_fn
+        self._model.set_state_dict(load_fn(path + ".pdparams"))
+        if load_optimizer and self._optimizer is not None:
+            import os
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(load_fn(path + ".pdopt"))

@@ -1,0 +1,136 @@
+"""ProcessMesh — analog of python/paddle/distributed/auto_parallel/process_mesh.py.
+
+A ProcessMesh is an n-D array of device ids with named dims. On TPU it wraps
+(and can install as global) a jax.sharding.Mesh; groups/axes carry XLA
+collectives over ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ...parallel import mesh as mesh_mod
+
+_current: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        if shape is not None and process_ids is not None:  # reference alt-ctor
+            arr = np.asarray(process_ids, dtype=np.int64).reshape(shape)
+        else:
+            arr = np.asarray(mesh, dtype=np.int64)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(f"dim_names {dim_names} rank != mesh rank {arr.ndim}")
+        self._ids = arr
+        self._dim_names = tuple(str(d) for d in dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    # --- reference API surface ---
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    @property
+    def process_ids(self):
+        return [int(i) for i in self._ids.flatten()]
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._ids.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Sub-mesh: move `dim_name` first; optionally index into it."""
+        axis = self._dim_names.index(dim_name)
+        moved = np.moveaxis(self._ids, axis, 0)
+        names = (self._dim_names[axis],) + tuple(
+            n for i, n in enumerate(self._dim_names) if i != axis)
+        if index is None:
+            return ProcessMesh(moved, names)
+        return ProcessMesh(moved[index], names[1:])
+
+    def __getitem__(self, item):
+        sub = self._ids[item]
+        if np.isscalar(sub) or sub.ndim == 0:
+            return int(sub)
+        # dims indexed away lose their names
+        kept = []
+        idx = item if isinstance(item, tuple) else (item,)
+        di = 0
+        for it in idx:
+            if isinstance(it, slice):
+                kept.append(self._dim_names[di])
+            di += 1
+        kept += list(self._dim_names[di:])
+        return ProcessMesh(sub, kept[-sub.ndim:] if sub.ndim else [])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._ids.tobytes(), self._dim_names))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+    # --- TPU binding ---
+    def jax_mesh(self) -> Mesh:
+        """The jax Mesh over the devices whose ids this ProcessMesh names."""
+        if self._jax_mesh is None:
+            by_id = {d.id: d for d in jax.devices()}
+            try:
+                devs = np.vectorize(lambda i: by_id[int(i)])(self._ids)
+            except KeyError as e:
+                raise RuntimeError(
+                    f"ProcessMesh names device id {e} not present "
+                    f"(have {sorted(by_id)})") from None
+            self._jax_mesh = Mesh(devs, self._dim_names)
+        return self._jax_mesh
+
+    def install(self) -> Mesh:
+        """Make this the global mesh (parallel/mesh.py)."""
+        m = self.jax_mesh()
+        mesh_mod.set_mesh(m)
+        return m
+
+    def __enter__(self):
+        global _current
+        self._prev = _current
+        _current = self
+        return self
+
+    def __exit__(self, *exc):
+        global _current
+        _current = self._prev
+        return False
+
+
+def get_current_mesh() -> Optional[ProcessMesh]:
+    return _current
+
+
+def auto_mesh(dim_names: Sequence[str] = ("dp",), shape=None) -> ProcessMesh:
+    """Convenience: mesh over all local devices."""
+    n = len(jax.devices())
+    if shape is None:
+        shape = [n] + [1] * (len(dim_names) - 1)
+    return ProcessMesh(np.arange(int(np.prod(shape))).reshape(shape), dim_names)

@@ -1,0 +1,66 @@
+"""Auto-parallel Strategy — analog of
+python/paddle/distributed/auto_parallel/strategy.py (config groups for amp,
+sharding, recompute, pipeline, gradient_merge, fused_passes)."""
+from __future__ import annotations
+
+
+class _Config:
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class AmpConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, dtype="bfloat16", level="O1",
+                         init_loss_scaling=2.0 ** 15, use_master_weights=True)
+
+
+class ShardingConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, stage=1, degree=1)
+
+
+class RecomputeConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, checkpoints=None)
+
+
+class PipelineConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, schedule_mode="1F1B", micro_batch_size=1,
+                         accumulate_steps=1)
+
+
+class GradientMergeConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, k_steps=1, avg=True)
+
+
+class FusedPassesConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, fused_passes_list=[])
+
+
+class Strategy(_Config):
+    def __init__(self, config=None):
+        super().__init__()
+        self.auto_mode = "semi"
+        self.amp = AmpConfig()
+        self.sharding = ShardingConfig()
+        self.recompute = RecomputeConfig()
+        self.pipeline = PipelineConfig()
+        self.gradient_merge = GradientMergeConfig()
+        self.fused_passes = FusedPassesConfig()
+        if config:
+            for k, v in config.items():
+                tgt = getattr(self, k, None)
+                if isinstance(tgt, _Config) and isinstance(v, dict):
+                    tgt.__dict__.update(v)
+                else:
+                    setattr(self, k, v)

@@ -1,0 +1,48 @@
+"""MoE dispatch collectives — analog of paddle.distributed.utils.global_scatter
+/ global_gather (C++ ops paddle/fluid/operators/collective/global_scatter_op.cc,
+used by moe_layer.py:119,140).
+
+The reference exchanges RAGGED per-expert token lists (local_count/global_count
+sizes negotiated by an allreduce first). Ragged exchanges don't map to XLA's
+static-shape world, so the TPU-native formulation is dense capacity buckets:
+tokens are packed [n_local_expert * world, capacity, d] and exchanged with ONE
+all_to_all along the expert-parallel mesh axis — the same traffic pattern,
+compiler-scheduled on ICI. MoELayer produces exactly this layout.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply
+from .collective import _axis_of, _in_shard_map
+
+
+def _exchange(x: Tensor, axis: str) -> Tensor:
+    """all_to_all on dim 0: [world * n_per, ...] -> [world * n_per, ...] where
+    block i of the output is block `rank` gathered from peer i."""
+    if axis is None or not _in_shard_map(axis):
+        return x
+
+    def f(v):
+        n = jax.lax.axis_size(axis)
+        parts = v.reshape((n, v.shape[0] // n) + v.shape[1:])
+        out = jax.lax.all_to_all(parts, axis, split_axis=0, concat_axis=0,
+                                 tiled=False)
+        return out.reshape(v.shape)
+    return apply(f, x, op_name="global_scatter")
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None):
+    """Send expert-major token buckets to the ranks owning those experts.
+
+    x: [world_size * n_local_experts * capacity, d] (dense buckets, expert-major)
+    or any tensor whose dim 0 is divisible by the group world size.
+    """
+    return _exchange(x, _axis_of(group))
+
+
+def global_gather(x, local_count=None, global_count=None, group=None):
+    """Inverse of global_scatter: return expert outputs to token owners.
+    With dense equal-size buckets the exchange is symmetric."""
+    return _exchange(x, _axis_of(group))
