@@ -18,6 +18,8 @@ from .auto_parallel import (  # noqa: F401
     reshard, shard_layer, shard_op, Strategy, to_static,
 )
 from .utils import global_scatter, global_gather  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .store import TCPStore  # noqa: F401
 
 from ..parallel.mesh import init_mesh, get_mesh  # noqa: F401

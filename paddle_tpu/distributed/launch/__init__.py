@@ -1,0 +1,14 @@
+"""paddle_tpu.distributed.launch — multi-process/multi-host launcher.
+
+Analog of python/paddle/distributed/launch (main.py:18): a Context parsed from
+argv/env, a collective controller that builds the pod (one worker process per
+device/host with PADDLE_* env), a TCPStore-backed master KV for multi-node
+rendezvous (the reference's HTTP/ETCD master), and a watcher that restarts
+failed workers (ElasticManager, fleet/elastic/manager.py:126).
+
+On TPU pods the normal deployment is ONE process per host (all local chips in
+one process, jax.distributed handles cross-host); --nproc_per_node exists for
+CPU simulation and tests.
+"""
+from .context import Context  # noqa: F401
+from .controller import CollectiveController, launch  # noqa: F401

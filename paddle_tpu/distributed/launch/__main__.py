@@ -1,0 +1,8 @@
+"""`python -m paddle_tpu.distributed.launch` — analog of
+`python -m paddle.distributed.launch` (launch/main.py:18)."""
+import sys
+
+from .controller import launch
+
+if __name__ == "__main__":
+    sys.exit(launch())

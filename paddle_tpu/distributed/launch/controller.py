@@ -1,0 +1,152 @@
+"""Collective controller + watcher.
+
+Analog of launch/controllers/collective.py:37 (CollectiveController.build_pod:
+spawn one proc per rank with PADDLE_TRAINER_* env) and controllers/watcher.py
++ fleet/elastic/manager.py:126 (membership + restart). The master KV is our
+TCPStore (csrc/runtime.cc) instead of HTTP/ETCD: node 0 hosts it; every node
+registers, a barrier forms the peer list, and heartbeat keys detect loss.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from ..store import TCPStore
+
+
+class _Proc:
+    def __init__(self, rank: int, popen: subprocess.Popen, log_path: str):
+        self.rank = rank
+        self.popen = popen
+        self.log_path = log_path
+        self.restarts = 0
+
+
+class CollectiveController:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.procs: List[_Proc] = []
+        self.store: Optional[TCPStore] = None
+
+    # ---- rendezvous ----
+    def _connect_store(self) -> TCPStore:
+        host, port = self.ctx.master.rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=self.ctx.is_master_node(),
+                         world_size=self.ctx.nnodes)
+        # node membership: announce, then wait for the full roster
+        store.set(f"node/{self.ctx.node_rank}", os.uname().nodename)
+        arrived = store.add("nodes_arrived", 1)
+        if arrived == self.ctx.nnodes:
+            store.set("roster_ready", b"1")
+        store.wait("roster_ready")
+        return store
+
+    # ---- pod ----
+    def build_pod(self):
+        self.store = self._connect_store()
+        os.makedirs(self.ctx.log_dir, exist_ok=True)
+        for local_rank in range(self.ctx.nproc_per_node):
+            self._spawn(local_rank)
+
+    def _rank(self, local_rank: int) -> int:
+        return self.ctx.node_rank * self.ctx.nproc_per_node + local_rank
+
+    def _spawn(self, local_rank: int, restarts: int = 0):
+        rank = self._rank(local_rank)
+        env = dict(os.environ)
+        host, port = self.ctx.master.rsplit(":", 1)
+        env.update(self.ctx.envs)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_TRAINERS_NUM": str(self.ctx.world_size),
+            "PADDLE_MASTER": host,
+            "MASTER_ADDR": host,
+            "MASTER_PORT": port,
+            "PADDLE_JOB_ID": self.ctx.job_id,
+            "RANK": str(rank),
+            "WORLD_SIZE": str(self.ctx.world_size),
+            "LOCAL_RANK": str(local_rank),
+        })
+        if self.ctx.devices is not None:
+            env["PADDLE_DEVICES"] = self.ctx.devices
+        log_path = os.path.join(self.ctx.log_dir,
+                                f"workerlog.{rank}" if self.ctx.world_size > 1
+                                else "workerlog.0")
+        logf = open(log_path, "ab")
+        popen = subprocess.Popen(
+            [sys.executable, self.ctx.script, *self.ctx.script_args],
+            env=env, stdout=logf, stderr=subprocess.STDOUT)
+        logf.close()
+        p = _Proc(rank, popen, log_path)
+        p.restarts = restarts
+        # replace or append
+        for i, old in enumerate(self.procs):
+            if old.rank == rank:
+                self.procs[i] = p
+                return
+        self.procs.append(p)
+
+    # ---- watcher / elastic restart ----
+    def watch(self, poll: float = 0.2) -> int:
+        """Monitor the pod; restart failed workers up to max_restart.
+        Returns the final exit code (0 iff all workers exited 0)."""
+        while True:
+            running = False
+            for p in list(self.procs):
+                code = p.popen.poll()
+                if code is None:
+                    running = True
+                    continue
+                if code == 0:
+                    continue
+                if p.restarts < self.ctx.max_restart:
+                    local_rank = p.rank - self.ctx.node_rank * self.ctx.nproc_per_node
+                    sys.stderr.write(
+                        f"[launch] worker rank={p.rank} exited {code}; "
+                        f"restart {p.restarts + 1}/{self.ctx.max_restart} "
+                        f"(log: {p.log_path})\n")
+                    self._spawn(local_rank, restarts=p.restarts + 1)
+                    running = True
+                else:
+                    sys.stderr.write(
+                        f"[launch] worker rank={p.rank} failed permanently "
+                        f"(exit {code}); stopping pod\n")
+                    self.stop(signal.SIGTERM)
+                    return code
+            if not running:
+                return 0
+            time.sleep(poll)
+
+    def stop(self, sig=signal.SIGTERM):
+        for p in self.procs:
+            if p.popen.poll() is None:
+                try:
+                    p.popen.send_signal(sig)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 5
+        for p in self.procs:
+            try:
+                p.popen.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.popen.kill()
+
+
+def launch(argv=None) -> int:
+    from .context import Context
+    ctx = Context.from_args(argv)
+    ctrl = CollectiveController(ctx)
+    ctrl.build_pod()
+    try:
+        return ctrl.watch()
+    except KeyboardInterrupt:
+        ctrl.stop(signal.SIGINT)
+        return 130
+    finally:
+        if ctrl.store is not None:
+            ctrl.store.stop()
