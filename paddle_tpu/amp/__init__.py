@@ -5,5 +5,11 @@ but fp16 + dynamic GradScaler is kept for API/behavior parity.
 """
 from .auto_cast import auto_cast, amp_guard, amp_state, white_list, black_list  # noqa: F401
 from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+from . import debugging  # noqa: F401
+
+# seed the fast-path nan/inf guard from FLAGS_check_nan_inf (env or default)
+from ..utils import flags as _flags  # noqa: E402
+from ..ops import dispatch as _dispatch  # noqa: E402
+_dispatch.set_nan_check(bool(_flags.flag("FLAGS_check_nan_inf")))
 
 auto_cast = auto_cast

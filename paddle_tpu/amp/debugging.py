@@ -1,0 +1,72 @@
+"""Numeric debugging — analog of python/paddle/amp/debugging.py (tensor
+checker, enable/disable via FLAGS_check_nan_inf, debugging.py:299).
+
+check_numerics(tensor) scans one tensor; enable_tensor_checker()/
+disable_tensor_checker() toggle the per-op output scan in ops.dispatch
+(every eager op raises FloatingPointError on the first nan/inf it emits).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import dispatch
+from ..utils import flags
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+def enable_tensor_checker(checker_config=None):
+    flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type: str = "tensor", var_name: str = "",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Count (num_nan, num_inf, num_zero); raise on nan/inf when aborting."""
+    val = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if not np.issubdtype(np.dtype(val.dtype), np.floating):
+        z = jnp.asarray(0)
+        return Tensor(z), Tensor(z), Tensor(jnp.sum(val == 0))
+    num_nan = jnp.sum(jnp.isnan(val))
+    num_inf = jnp.sum(jnp.isinf(val))
+    num_zero = jnp.sum(val == 0)
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT and \
+            int(num_nan) + int(num_inf) > 0:
+        raise FloatingPointError(
+            f"{op_type} {var_name or ''}: found {int(num_nan)} nan, "
+            f"{int(num_inf)} inf in tensor of shape {list(val.shape)}")
+    return Tensor(num_nan), Tensor(num_inf), Tensor(num_zero)
+
+
+def collect_operator_stats():
+    """Context manager collecting per-op dtype call counts
+    (enable/disable_operator_stats_collection analog)."""
+    return _OpStats()
+
+
+class _OpStats:
+    def __init__(self):
+        self.stats = {}
+
+    def __enter__(self):
+        self._prev = dispatch._profile_cb
+
+        def cb(name, t0, t1):
+            self.stats[name] = self.stats.get(name, 0) + 1
+            if self._prev is not None:
+                self._prev(name, t0, t1)
+        dispatch.set_profile_cb(cb)
+        return self
+
+    def __exit__(self, *exc):
+        dispatch.set_profile_cb(self._prev)
+        return False

@@ -15,16 +15,26 @@ _lock = threading.Lock()
 
 
 class Generator:
+    """Key creation is LAZY: touching jax.random at construction would
+    initialize the XLA backend, which must not happen before
+    jax.distributed.initialize() in multi-process jobs (env.py)."""
+
     def __init__(self, seed: int = 0, name: str = "default"):
         self.name = name
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key_cache = None
         self._offset = 0
+
+    @property
+    def _key(self):
+        if self._key_cache is None:
+            self._key_cache = jax.random.key(self._seed)
+        return self._key_cache
 
     def manual_seed(self, seed: int):
         with _lock:
             self._seed = int(seed)
-            self._key = jax.random.key(self._seed)
+            self._key_cache = None
             self._offset = 0
         return self
 
@@ -43,7 +53,7 @@ class Generator:
     def set_state(self, state):
         with _lock:
             self._seed = int(state["seed"])
-            self._key = jax.random.key(self._seed)
+            self._key_cache = None
             self._offset = int(state["offset"])
 
 

@@ -26,7 +26,6 @@ import jax
 import numpy as np
 
 from ..core.tensor import Tensor
-from ..parallel import mesh as mesh_mod
 
 _pending: Optional[threading.Thread] = None
 _pending_error: Optional[BaseException] = None
@@ -100,11 +99,18 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     wait()
     os.makedirs(path, exist_ok=True)
     proc = jax.process_index()
+    nproc = jax.process_count()
     if proc == coordinator_rank:
-        # clear stale shards from a previous save with a different world size
+        # clear stale shards left by a previous save under a LARGER world;
+        # indices < nproc are about to be rewritten by their owners, so only
+        # higher indices can be stale — deleting just those can't race a
+        # current writer
         import glob as _glob
+        import re as _re
         for old in _glob.glob(os.path.join(path, "shard-*.npz")):
-            os.remove(old)
+            m = _re.search(r"shard-(\d+)\.npz$", old)
+            if m and int(m.group(1)) >= nproc:
+                os.remove(old)
 
     meta = {"format": "paddle_tpu.dist_ckpt.v1", "params": {}}
     shards = {}
@@ -135,6 +141,11 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
     def _write():
         np.savez(os.path.join(path, f"shard-{proc}.npz"), **shards)
+        if nproc > 1:
+            # all hosts' shards must be durable before metadata announces the
+            # checkpoint (readers key on metadata.json presence)
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"ckpt_save:{path}")
         if proc == coordinator_rank:
             with open(os.path.join(path, "metadata.json"), "w") as f:
                 json.dump(meta, f)
@@ -225,11 +236,16 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
             full = index.assemble(name, meta["params"][name])
             if isinstance(t, Tensor):
                 cur_sharding = getattr(t._value, "sharding", None)
-                val = jax.numpy.asarray(full)
-                if cur_sharding is not None and isinstance(
-                        cur_sharding, jax.sharding.NamedSharding):
-                    val = jax.device_put(val, cur_sharding)
-                t._value = val.astype(t._value.dtype)
+                full = full.astype(np.dtype(t._value.dtype))
+                if isinstance(cur_sharding, jax.sharding.NamedSharding):
+                    # per-device shard placement straight from host memory —
+                    # no full-array device materialization, and correct on
+                    # multi-host meshes (each host feeds only its addressable
+                    # devices)
+                    t._value = jax.make_array_from_callback(
+                        full.shape, cur_sharding, lambda idx: full[idx])
+                else:
+                    t._value = jax.numpy.asarray(full)
             else:
                 # plain array / scalar leaf: write back into the container
                 sc = meta["params"][name].get("scalar")

@@ -56,7 +56,13 @@ def init_parallel_env(mesh_shape: Optional[dict] = None):
       devices (pure data parallel, matching init_parallel_env semantics).
     """
     global _initialized
-    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    # coordinator = MASTER_ADDR:MASTER_PORT (set by the launcher to a port
+    # distinct from the rendezvous store); PADDLE_MASTER may be host:port of
+    # the store — use only its host as a fallback address
+    coord = os.environ.get("MASTER_ADDR")
+    if coord is None:
+        pm = os.environ.get("PADDLE_MASTER")
+        coord = pm.rsplit(":", 1)[0] if pm else None
     nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
     if coord and nproc > 1 and not _initialized:

@@ -48,6 +48,15 @@ class CollectiveController:
     # ---- pod ----
     def build_pod(self):
         self.store = self._connect_store()
+        # the jax.distributed coordination service needs its OWN port (the
+        # rendezvous store keeps serving on ctx.master's port); node 0 picks
+        # it and publishes it through the store
+        if self.ctx.is_master_node():
+            from .context import _free_port
+            self.coord_port = _free_port()
+            self.store.set("coord_port", str(self.coord_port))
+        else:
+            self.coord_port = int(self.store.get("coord_port"))
         os.makedirs(self.ctx.log_dir, exist_ok=True)
         for local_rank in range(self.ctx.nproc_per_node):
             self._spawn(local_rank)
@@ -64,9 +73,11 @@ class CollectiveController:
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_LOCAL_RANK": str(local_rank),
             "PADDLE_TRAINERS_NUM": str(self.ctx.world_size),
-            "PADDLE_MASTER": host,
+            # rendezvous store endpoint (Context.from_args format: host:port)
+            "PADDLE_MASTER": f"{host}:{port}",
+            # jax.distributed coordination service endpoint (distinct port)
             "MASTER_ADDR": host,
-            "MASTER_PORT": port,
+            "MASTER_PORT": str(self.coord_port),
             "PADDLE_JOB_ID": self.ctx.job_id,
             "RANK": str(rank),
             "WORLD_SIZE": str(self.ctx.world_size),

@@ -85,6 +85,42 @@ def _get_amp_hook():
     return _amp_dtype_for
 
 
+# Observability hooks (host tracer + nan/inf guard). Kept as plain module
+# globals so the disabled fast path costs two `is None`/falsy checks per op.
+# - _profile_cb(name, t0_ns, t1_ns): installed by paddle_tpu.profiler while a
+#   Profiler is in a RECORD state (HostTracer analog, host_tracer.h:26).
+# - _nan_check: set from FLAGS_check_nan_inf (amp/debugging.py) — scans float
+#   outputs of every eager op and raises on nan/inf.
+_profile_cb = None
+_nan_check = False
+
+
+def set_profile_cb(cb):
+    global _profile_cb
+    _profile_cb = cb
+
+
+def set_nan_check(on: bool):
+    global _nan_check
+    _nan_check = bool(on)
+
+
+def _scan_nan_inf(out, multi, name):
+    import numpy as _np
+    outs = out if multi else (out,)
+    for o in outs:
+        if not isinstance(o, Tensor) or isinstance(o._value, jax.core.Tracer):
+            continue
+        if not _np.issubdtype(_np.dtype(o._value.dtype), _np.floating):
+            continue
+        bad = int(jnp.size(o._value)) - int(jnp.sum(jnp.isfinite(o._value)))
+        if bad:
+            raise FloatingPointError(
+                f"Operator {name!r} produced {bad} nan/inf element(s) "
+                f"in output of shape {list(o._value.shape)} "
+                f"(FLAGS_check_nan_inf is enabled)")
+
+
 def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
     """Execute `jax_fn(*arrays, **static_kwargs)` over Tensor args with tape recording.
 
@@ -111,9 +147,18 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
                 vals[i] = v.astype(amp_dt)
     diff_idx = [i for i, a in enumerate(args) if _is_diff_tensor(a)]
 
+    prof = _profile_cb
+    if prof is not None:
+        import time as _time
+        _t0 = _time.perf_counter_ns()
+
     if not diff_idx or not is_grad_enabled():
         raw = jax_fn(*vals, **static_kwargs)
         out, multi = _wrap_outputs(raw, name)
+        if prof is not None:
+            prof(name, _t0, _time.perf_counter_ns())
+        if _nan_check:
+            _scan_nan_inf(out, multi, name)
         return out
 
     diff_vals = [vals[i] for i in diff_idx]
@@ -138,6 +183,10 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
             o._grad_node = node
             o._out_index = i
             o.stop_gradient = False
+    if prof is not None:
+        prof(name, _t0, _time.perf_counter_ns())
+    if _nan_check:
+        _scan_nan_inf(out, multi, name)
     return out
 
 

@@ -1,0 +1,14 @@
+"""paddle_tpu.profiler — analog of python/paddle/profiler/.
+
+Profiler with a state-machine scheduler (profiler.py:349, make_scheduler:117),
+chrome-trace export (:215 export_chrome_tracing), RecordEvent spans, op-level
+host tracing (hooked into ops.dispatch), summary statistics
+(profiler_statistic.py) and the benchmark timer (timer.py). Host events are
+collected by the native C++ tracer (csrc/runtime.cc); device-side profiling
+rides jax.profiler (XPlane) when a trace dir is given.
+"""
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, make_scheduler,
+    export_chrome_tracing, load_profiler_result,
+)
+from .timer import benchmark  # noqa: F401

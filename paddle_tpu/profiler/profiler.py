@@ -1,0 +1,276 @@
+"""Profiler core.
+
+Analog of python/paddle/profiler/profiler.py: Profiler (:349) driving a
+per-step state machine from make_scheduler (:117); states CLOSED/READY/RECORD/
+RECORD_AND_RETURN. While recording, (a) every eager op dispatch is timed into
+the native host tracer (host_tracer.h:26 analog) and (b) user RecordEvent
+spans land in the same buffer; on_trace_ready callbacks (export_chrome_tracing
+:215) receive the profiler when a record window closes.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from ..utils import native
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-indexed schedule: skip_first CLOSED steps, then cycles of
+    [closed CLOSED, ready READY, record RECORD (last = RECORD_AND_RETURN)],
+    repeated `repeat` times (0 = forever)."""
+    if closed < 0 or ready < 0 or record <= 0:
+        raise ValueError("make_scheduler: closed/ready must be >=0, record >=1")
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_schedule(_: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callback writing chrome://tracing JSON files."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof: "Profiler"):
+        name = worker_name or f"host_{os.uname().nodename}_pid{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time()*1000)}"
+                            ".paddle_trace.json")
+        prof.export(path)
+
+    return handler
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+class _PyTraceBuffer:
+    """Fallback event buffer when the native tracer is unavailable."""
+
+    def __init__(self):
+        self.events = []
+        self.enabled = False
+        self._lock = threading.Lock()
+
+    def record(self, name, cat, ts_ns, dur_ns, tid):
+        if self.enabled:
+            with self._lock:
+                self.events.append(
+                    {"ph": "X", "pid": 0, "tid": tid, "ts": ts_ns / 1000.0,
+                     "dur": dur_ns / 1000.0, "name": name, "cat": cat})
+
+    def dump(self):
+        with self._lock:
+            return list(self.events)
+
+    def clear(self):
+        with self._lock:
+            self.events.clear()
+
+
+_py_buffer = _PyTraceBuffer()
+
+
+def _tracer_record(name: str, cat: str, ts_ns: int, dur_ns: int):
+    lib = native.get_lib()
+    tid = threading.get_ident() % 2 ** 31
+    if lib is not None:
+        lib.pt_trace_record(name.encode(), cat.encode(), ts_ns, dur_ns, tid)
+    else:
+        _py_buffer.record(name, cat, ts_ns, dur_ns, tid)
+
+
+def _tracer_enable(on: bool):
+    lib = native.get_lib()
+    if lib is not None:
+        lib.pt_trace_enable(1 if on else 0)
+    _py_buffer.enabled = on
+
+
+def _tracer_dump():
+    lib = native.get_lib()
+    events = []
+    if lib is not None:
+        import ctypes
+        out = ctypes.c_void_p()
+        n = lib.pt_trace_dump(ctypes.byref(out))
+        events = json.loads(native._take_bytes(lib, out, n))
+    events += _py_buffer.dump()
+    return events
+
+
+def _tracer_clear():
+    lib = native.get_lib()
+    if lib is not None:
+        lib.pt_trace_clear()
+    _py_buffer.clear()
+
+
+class RecordEvent:
+    """User span — analog of paddle.profiler.RecordEvent (RecordEvent spans
+    merged into the host event tree, event_node.cc)."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        if self._t0 is not None:
+            _tracer_record(self.name, self.event_type, self._t0,
+                           time.perf_counter_ns() - self._t0)
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, with_flops: bool = False):
+        if scheduler is None:
+            self._schedule = _default_schedule
+        elif callable(scheduler):
+            self._schedule = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            start, end = scheduler
+            self._schedule = make_scheduler(closed=max(start, 0), ready=0,
+                                            record=end - start, repeat=1)
+        else:
+            raise TypeError(f"bad scheduler {scheduler!r}")
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._events = []
+        self._recording = False
+
+    # -- lifecycle --
+    def start(self):
+        from .timer import benchmark
+        benchmark().begin()
+        if self._timer_only:
+            return
+        _tracer_clear()
+        self._transition(self._schedule(self.step_num))
+
+    def step(self, num_samples: Optional[int] = None):
+        from .timer import benchmark
+        benchmark().step(num_samples)
+        self.step_num += 1
+        if self._timer_only:
+            return
+        self._transition(self._schedule(self.step_num))
+
+    def stop(self):
+        from .timer import benchmark
+        benchmark().end()
+        if self._timer_only:
+            return
+        if self._recording:
+            self._collect()
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+        self._set_recording(False)
+        self._state = ProfilerState.CLOSED
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- state machine --
+    def _transition(self, new: ProfilerState):
+        was = self._recording
+        want = new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if want and not was:
+            self._set_recording(True)
+        window_closed = (was and not want) or \
+            (self._state == ProfilerState.RECORD_AND_RETURN and
+             new != ProfilerState.RECORD)
+        if window_closed:
+            self._collect()
+            self._set_recording(want)
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+        self._state = new
+
+    def _set_recording(self, on: bool):
+        from ..ops import dispatch
+        self._recording = on
+        _tracer_enable(on)
+        if on:
+            def cb(name, t0, t1):
+                _tracer_record(name, "op", t0, t1 - t0)
+            dispatch.set_profile_cb(cb)
+        else:
+            dispatch.set_profile_cb(None)
+
+    def _collect(self):
+        self._events.extend(_tracer_dump())
+        _tracer_clear()
+
+    # -- results --
+    def events(self):
+        return list(self._events)
+
+    def export(self, path: str, format: str = "json"):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def summary(self, sorted_by: str = "total", op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        from .statistic import summary as _summary
+        return _summary(self._events, sorted_by=sorted_by, time_unit=time_unit)

@@ -1,0 +1,46 @@
+"""Summary statistics over collected events — analog of
+python/paddle/profiler/profiler_statistic.py (per-op totals/avg/max/min and
+share of window)."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def aggregate(events: List[dict]) -> Dict[str, dict]:
+    stats: Dict[str, dict] = {}
+    for e in events:
+        name = e.get("name", "?")
+        dur = float(e.get("dur", 0.0))  # microseconds
+        s = stats.setdefault(name, {"calls": 0, "total_us": 0.0,
+                                    "max_us": 0.0, "min_us": float("inf"),
+                                    "cat": e.get("cat", "")})
+        s["calls"] += 1
+        s["total_us"] += dur
+        s["max_us"] = max(s["max_us"], dur)
+        s["min_us"] = min(s["min_us"], dur)
+    for s in stats.values():
+        s["avg_us"] = s["total_us"] / max(s["calls"], 1)
+        if s["min_us"] == float("inf"):
+            s["min_us"] = 0.0
+    return stats
+
+
+def summary(events: List[dict], sorted_by: str = "total",
+            time_unit: str = "ms") -> str:
+    stats = aggregate(events)
+    key = {"total": "total_us", "avg": "avg_us", "max": "max_us",
+           "calls": "calls"}.get(sorted_by, "total_us")
+    div = {"s": 1e6, "ms": 1e3, "us": 1.0}.get(time_unit, 1e3)
+    rows = sorted(stats.items(), key=lambda kv: -kv[1][key])
+    grand = sum(s["total_us"] for _, s in rows) or 1.0
+    lines = [
+        f"{'Name':<40} {'Calls':>7} {'Total(' + time_unit + ')':>12} "
+        f"{'Avg(' + time_unit + ')':>12} {'Max(' + time_unit + ')':>12} {'Ratio':>7}"
+    ]
+    lines.append("-" * len(lines[0]))
+    for name, s in rows[:64]:
+        lines.append(
+            f"{name[:40]:<40} {s['calls']:>7} {s['total_us']/div:>12.3f} "
+            f"{s['avg_us']/div:>12.3f} {s['max_us']/div:>12.3f} "
+            f"{100.0 * s['total_us']/grand:>6.1f}%")
+    return "\n".join(lines)

@@ -62,6 +62,14 @@ def set_flags(flags: Dict[str, Any]):
         if k not in _TYPES:
             raise KeyError(f"unknown flag {k!r}")
         _store(k, str(v))
+        _notify(k)
+
+
+def _notify(name: str):
+    """Push side-effectful flags into their fast-path globals."""
+    if name == "FLAGS_check_nan_inf":
+        from ..ops import dispatch
+        dispatch.set_nan_check(flag(name))
 
 
 def get_flags(names):

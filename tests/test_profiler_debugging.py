@@ -1,0 +1,116 @@
+"""Profiler + numeric-debugging tests (SURVEY.md §5: tracing/profiling and
+nan/inf scanning parity)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 export_chrome_tracing, make_scheduler)
+
+
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2, skip_first=1)
+    states = [sched(i) for i in range(10)]
+    S = ProfilerState
+    assert states == [S.CLOSED,               # skip_first
+                      S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,
+                      S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,
+                      S.CLOSED]               # repeat exhausted
+
+
+def test_profiler_records_ops_and_spans(tmp_path):
+    model = nn.Linear(8, 8)
+    x = P.randn([4, 8])
+    prof = Profiler()
+    prof.start()
+    with RecordEvent("my_span"):
+        y = model(x)
+        y.sum().backward()
+    for _ in range(3):
+        prof.step()
+    prof.stop()
+    events = prof.events()
+    names = {e["name"] for e in events}
+    assert "my_span" in names
+    assert any(n for n in names if n != "my_span"), names  # op events recorded
+    # export + summary
+    out = tmp_path / "trace.json"
+    prof.export(str(out))
+    data = json.load(open(out))
+    assert data["traceEvents"]
+    s = prof.summary()
+    assert "Calls" in s and "my_span" in s
+
+
+def test_profiler_scheduler_windows(tmp_path):
+    collected = []
+    prof = Profiler(scheduler=make_scheduler(closed=1, ready=0, record=1),
+                    on_trace_ready=lambda p: collected.append(len(p.events())))
+    prof.start()  # step 0: CLOSED
+    x = P.randn([4, 4])
+    for i in range(4):
+        (x * 2.0).sum()
+        prof.step()
+    prof.stop()
+    assert collected, "on_trace_ready never fired"
+
+
+def test_export_chrome_tracing_handler(tmp_path):
+    prof = Profiler(on_trace_ready=export_chrome_tracing(str(tmp_path)))
+    prof.start()
+    (P.randn([4, 4]) + 1.0).sum()
+    prof.stop()
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert files
+
+
+def test_benchmark_timer():
+    from paddle_tpu.profiler.timer import Benchmark
+    b = Benchmark()
+    b.begin()
+    import time
+    for _ in range(3):
+        time.sleep(0.01)
+        b.step(num_samples=32)
+    b.end()
+    assert b.step_cost.count == 2  # first step() only sets t0
+    assert b.ips() > 0
+    assert "ips" in b.step_info()
+
+
+def test_nan_inf_checker():
+    from paddle_tpu.amp import debugging
+    x = P.to_tensor(np.array([1.0, 0.0], np.float32))
+    debugging.enable_tensor_checker()
+    try:
+        with pytest.raises(FloatingPointError):
+            _ = x / P.to_tensor(np.array([0.0, 0.0], np.float32))
+    finally:
+        debugging.disable_tensor_checker()
+    # disabled again: no raise
+    _ = x / P.to_tensor(np.array([0.0, 0.0], np.float32))
+
+
+def test_check_numerics():
+    from paddle_tpu.amp import debugging
+    t = P.to_tensor(np.array([1.0, np.nan, np.inf, 0.0], np.float32))
+    with pytest.raises(FloatingPointError):
+        debugging.check_numerics(t, "op", "t")
+    n_nan, n_inf, n_zero = debugging.check_numerics(
+        t, "op", "t", debug_mode=debugging.DebugMode.CHECK_NAN_INF)
+    assert int(n_nan) == 1 and int(n_inf) == 1 and int(n_zero) == 1
+
+
+def test_collect_operator_stats():
+    from paddle_tpu.amp import debugging
+    x = P.randn([4, 4])
+    with debugging.collect_operator_stats() as st:
+        _ = x + x
+        _ = x * x
+        _ = x * x
+    assert sum(st.stats.values()) >= 3
