@@ -73,7 +73,8 @@ def __getattr__(name):
     if name == "CompiledProgram":
         from .static import CompiledProgram
         return CompiledProgram
-    if name == "profiler":
+    if name in ("profiler", "distribution", "sparse", "quantization", "audio",
+                "geometric", "text", "incubate"):
         import importlib
-        return importlib.import_module(".profiler", __name__)
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

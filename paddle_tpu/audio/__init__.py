@@ -1,0 +1,5 @@
+"""paddle_tpu.audio — analog of python/paddle/audio/ (functional feature
+extraction + feature layers + wav backend)."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from . import backends  # noqa: F401

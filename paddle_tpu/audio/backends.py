@@ -1,0 +1,71 @@
+"""WAV IO backend — analog of python/paddle/audio/backends/ (wave_backend:
+load/save/info for 16-bit PCM wav without external deps)."""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def info(filepath: str):
+    with wave.open(filepath, "rb") as w:
+        class AudioInfo:
+            sample_rate = w.getframerate()
+            num_frames = w.getnframes()
+            num_channels = w.getnchannels()
+            bits_per_sample = w.getsampwidth() * 8
+        return AudioInfo()
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+    if width == 1:  # 8-bit WAV PCM is unsigned, centered at 128
+        data = np.frombuffer(raw, dtype=np.uint8).reshape(-1, nch)
+        data = data.astype(np.int16) - 128
+    else:
+        dtype = {2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         bits_per_sample: int = 16):
+    data = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+    if channels_first:
+        data = data.T
+    if data.dtype.kind == "f":
+        scaled = np.clip(data, -1.0, 1.0) * (2 ** (bits_per_sample - 1) - 1)
+        if bits_per_sample == 8:  # unsigned on disk
+            data = (scaled + 128).astype(np.uint8)
+        else:
+            data = scaled.astype({16: np.int16, 32: np.int32}[bits_per_sample])
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(data.shape[1] if data.ndim > 1 else 1)
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(sample_rate)
+        w.writeframes(data.tobytes())
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise ValueError("only wave_backend is available (no soundfile in image)")

@@ -1,0 +1,106 @@
+"""Feature layers — analog of python/paddle/audio/features/layers.py
+(Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC). STFT is framed
+matmul against a DFT basis — MXU-friendly and jit-traceable."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+from . import functional as F
+
+
+def _frame(x, frame_length, hop_length, center=True, pad_mode="reflect"):
+    if center:
+        pad = frame_length // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode=pad_mode)
+    n = 1 + (x.shape[-1] - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(n)[:, None])
+    return x[..., idx]  # [..., n_frames, frame_length]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length=None, win_length=None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = F.get_window(window, self.win_length)._value
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - self.win_length - lp))
+        self._window = w
+
+    def forward(self, x):
+        win, n_fft, hop = self._window, self.n_fft, self.hop_length
+
+        def f(v):
+            frames = _frame(v, n_fft, hop, self.center, self.pad_mode)
+            spec = jnp.fft.rfft(frames * win, n=n_fft, axis=-1)
+            mag = jnp.abs(spec)
+            out = mag ** self.power if self.power != 1.0 else mag
+            return jnp.swapaxes(out, -1, -2)  # [..., freq, time]
+        return apply(f, x, op_name="spectrogram")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, norm: str = "slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        self._fbank = F.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm)._value
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        fb = self._fbank
+        return apply(lambda s: jnp.einsum("mf,...ft->...mt", fb, s), spec,
+                     op_name="mel_spectrogram")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db=None, **kw):
+        super().__init__()
+        self.mel = MelSpectrogram(sr=sr, **kw)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+
+        def f(v):
+            log_spec = 10.0 * jnp.log10(jnp.maximum(self.amin, v))
+            log_spec -= 10.0 * math.log10(max(self.amin, self.ref_value))
+            if self.top_db is not None:
+                log_spec = jnp.maximum(log_spec, log_spec.max() - self.top_db)
+            return log_spec
+        return apply(f, m, op_name="log_mel_spectrogram")
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_mels: int = 64,
+                 **kw):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **kw)
+        self._dct = F.create_dct(n_mfcc, n_mels)._value  # [n_mels, n_mfcc]
+
+    def forward(self, x):
+        lm = self.log_mel(x)
+        dct = self._dct
+        return apply(lambda v: jnp.einsum("...mt,mk->...kt", v, dct), lm,
+                     op_name="mfcc")

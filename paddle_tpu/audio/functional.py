@@ -1,0 +1,113 @@
+"""Audio functional ops — analog of python/paddle/audio/functional/
+(hz_to_mel, mel_to_hz, mel_frequencies, compute_fbank_matrix, create_dct,
+power_to_db, get_window)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = _v(freq).astype(jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10) / min_log_hz) / logstep,
+                        mels)
+    return Tensor(out)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = _v(mel).astype(jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)), freqs)
+    return Tensor(out)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0,
+                    htk: bool = False):
+    m_min = hz_to_mel(f_min, htk)._value
+    m_max = hz_to_mel(f_max, htk)._value
+    mels = jnp.linspace(m_min, m_max, n_mels)
+    return mel_to_hz(Tensor(mels), htk)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm: str = "slaney"):
+    f_max = f_max if f_max is not None else sr / 2
+    fftfreqs = fft_frequencies(sr, n_fft)._value
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._value
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: str = "ortho"):
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[:, None]
+    dct = jnp.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct = dct.at[0].multiply(1.0 / math.sqrt(2))
+        dct = dct * math.sqrt(2.0 / n_mels)
+    return Tensor(dct.T)  # [n_mels, n_mfcc] (paddle layout)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: float = 80.0):
+    s = _v(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    n = win_length
+    k = jnp.arange(n, dtype=jnp.float32)
+    denom = n if fftbins else n - 1
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * k / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * k / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * k / denom)
+             + 0.08 * jnp.cos(4 * math.pi * k / denom))
+    elif window in ("rect", "boxcar", "ones"):
+        w = jnp.ones(n, jnp.float32)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w)

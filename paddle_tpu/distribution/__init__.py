@@ -1,0 +1,31 @@
+"""paddle_tpu.distribution — analog of python/paddle/distribution/ (20+
+distributions, transforms, kl_divergence registry).
+
+Sampling uses the framework PRNG (core.generator keys); log_prob/entropy are
+built from jax.numpy/jax.scipy so they differentiate and trace under jit like
+every other op.
+"""
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .normal import Normal, LogNormal  # noqa: F401
+from .uniform import Uniform  # noqa: F401
+from .bernoulli import Bernoulli, ContinuousBernoulli  # noqa: F401
+from .categorical import Categorical, Multinomial  # noqa: F401
+from .beta import Beta  # noqa: F401
+from .dirichlet import Dirichlet  # noqa: F401
+from .gamma import Gamma, Chi2, Exponential  # noqa: F401
+from .laplace import Laplace  # noqa: F401
+from .gumbel import Gumbel  # noqa: F401
+from .cauchy import Cauchy  # noqa: F401
+from .geometric import Geometric  # noqa: F401
+from .binomial import Binomial  # noqa: F401
+from .poisson import Poisson  # noqa: F401
+from .student_t import StudentT  # noqa: F401
+from .multivariate_normal import MultivariateNormal  # noqa: F401
+from .independent import Independent  # noqa: F401
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+from .transform import (  # noqa: F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+)
+from .kl import kl_divergence, register_kl  # noqa: F401

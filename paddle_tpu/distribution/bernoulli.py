@@ -1,0 +1,110 @@
+"""Bernoulli / ContinuousBernoulli — analog of
+python/paddle/distribution/bernoulli.py, continuous_bernoulli.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, Distribution, _t, _wrap
+
+_EPS = 1e-7
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(batch_shape=self.probs._value.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return _wrap(lambda p: p * (1 - p), self.probs, op_name="bernoulli_var")
+
+    def sample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        return _wrap(
+            lambda p: jax.random.bernoulli(key, p, out_shape).astype(jnp.float32),
+            self.probs.detach(), op_name="bernoulli_sample")
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (reparameterized)."""
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, out_shape, minval=_EPS, maxval=1 - _EPS)
+            logit = jnp.log(p / (1 - p))
+            g = jnp.log(u) - jnp.log(1 - u)
+            return jax.nn.sigmoid((logit + g) / temperature)
+        return _wrap(f, self.probs, op_name="bernoulli_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, p: v * jnp.log(jnp.clip(p, _EPS, 1.0))
+            + (1 - v) * jnp.log(jnp.clip(1 - p, _EPS, 1.0)),
+            value, self.probs, op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        return _wrap(
+            lambda p: -(p * jnp.log(jnp.clip(p, _EPS, 1)) +
+                        (1 - p) * jnp.log(jnp.clip(1 - p, _EPS, 1))),
+            self.probs, op_name="bernoulli_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, p: jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - p, 1.0)),
+            value, self.probs, op_name="bernoulli_cdf")
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(batch_shape=self.probs._value.shape)
+
+    def _log_norm(self, p):
+        # C(p) = 2*atanh(1-2p)/(1-2p) for p != 0.5, else 2
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        p_safe = jnp.where(near, 0.25, p)
+        c = 2.0 * jnp.arctanh(1 - 2 * p_safe) / (1 - 2 * p_safe)
+        # taylor around 0.5: C ~ 2 + (1-2p)^2*2/3
+        t = 2.0 + (1 - 2 * p) ** 2 * (2.0 / 3.0)
+        return jnp.log(jnp.where(near, t, c))
+
+    @property
+    def mean(self):
+        def f(p):
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            p_safe = jnp.where(near, 0.25, p)
+            m = p_safe / (2 * p_safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * p_safe))
+            return jnp.where(near, 0.5, m)
+        return _wrap(f, self.probs, op_name="cb_mean")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, out_shape, minval=_EPS, maxval=1 - _EPS)
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            p_safe = jnp.where(near, 0.25, p)
+            x = (jnp.log1p(u * (2 * p_safe - 1) / (1 - p_safe))
+                 / (jnp.log(p_safe) - jnp.log1p(-p_safe)))
+            return jnp.where(near, u, x)
+        return _wrap(f, self.probs, op_name="cb_rsample")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, p: v * jnp.log(jnp.clip(p, _EPS, 1))
+            + (1 - v) * jnp.log(jnp.clip(1 - p, _EPS, 1)) + self._log_norm(p),
+            value, self.probs, op_name="cb_log_prob")

@@ -1,0 +1,52 @@
+"""Beta — analog of python/paddle/distribution/beta.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, _t, _wrap
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        shape = jnp.broadcast_shapes(self.alpha._value.shape,
+                                     self.beta._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _wrap(lambda a, b: a / (a + b), self.alpha, self.beta,
+                     op_name="beta_mean")
+
+    @property
+    def variance(self):
+        return _wrap(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                     self.alpha, self.beta, op_name="beta_var")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        k1, k2 = jax.random.split(key)
+        out_shape = self._extend_shape(shape)
+
+        def f(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, out_shape))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, out_shape))
+            return ga / (ga + gb)
+        return _wrap(f, self.alpha, self.beta, op_name="beta_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, a, b: (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+            - jax.scipy.special.betaln(a, b),
+            value, self.alpha, self.beta, op_name="beta_log_prob")
+
+    def entropy(self):
+        def f(a, b):
+            dg = jax.scipy.special.digamma
+            return (jax.scipy.special.betaln(a, b)
+                    - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return _wrap(f, self.alpha, self.beta, op_name="beta_entropy")

@@ -1,0 +1,61 @@
+"""Binomial — analog of python/paddle/distribution/binomial.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+
+_EPS = 1e-7
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(batch_shape=self.probs._value.shape)
+
+    @property
+    def mean(self):
+        return _wrap(lambda p: self.total_count * p, self.probs,
+                     op_name="binomial_mean")
+
+    @property
+    def variance(self):
+        return _wrap(lambda p: self.total_count * p * (1 - p), self.probs,
+                     op_name="binomial_var")
+
+    def sample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            draws = jax.random.bernoulli(
+                key, p, (self.total_count,) + out_shape)
+            return jnp.sum(draws.astype(jnp.float32), axis=0)
+        return _wrap(f, self.probs.detach(), op_name="binomial_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, p):
+            n = self.total_count
+            pc = jnp.clip(p, _EPS, 1 - _EPS)
+            comb = (jax.scipy.special.gammaln(n + 1.0)
+                    - jax.scipy.special.gammaln(v + 1.0)
+                    - jax.scipy.special.gammaln(n - v + 1.0))
+            return comb + v * jnp.log(pc) + (n - v) * jnp.log1p(-pc)
+        return _wrap(f, value, self.probs, op_name="binomial_log_prob")
+
+    def entropy(self):
+        """Exact by summing over support (total_count is a python int)."""
+        def f(p):
+            k = jnp.arange(self.total_count + 1, dtype=jnp.float32)
+            pc = jnp.clip(p, _EPS, 1 - _EPS)[..., None]
+            n = self.total_count
+            comb = (jax.scipy.special.gammaln(n + 1.0)
+                    - jax.scipy.special.gammaln(k + 1.0)
+                    - jax.scipy.special.gammaln(n - k + 1.0))
+            logp = comb + k * jnp.log(pc) + (n - k) * jnp.log1p(-pc)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return _wrap(f, self.probs, op_name="binomial_entropy")

@@ -1,0 +1,101 @@
+"""Categorical / Multinomial — analog of python/paddle/distribution/
+categorical.py, multinomial.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+
+_EPS = 1e-9
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        """paddle semantics: `logits` are unnormalized probabilities (not
+        log-space) — normalized by their sum."""
+        self.logits = _t(logits)
+        shape = self.logits._value.shape
+        super().__init__(batch_shape=shape[:-1])
+        self._n = shape[-1]
+
+    def _probs_fn(self, lg):
+        p = lg / jnp.sum(lg, axis=-1, keepdims=True)
+        return jnp.clip(p, _EPS, 1.0)
+
+    @property
+    def probs(self):
+        return _wrap(self._probs_fn, self.logits, op_name="categorical_probs")
+
+    def sample(self, shape=()):
+        key = self._key()
+        out_shape = tuple(shape) + self._batch_shape
+
+        def f(lg):
+            logp = jnp.log(self._probs_fn(lg))
+            return jax.random.categorical(key, logp, shape=out_shape)
+        return _wrap(f, self.logits.detach(), op_name="categorical_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, lg: jnp.log(jnp.take_along_axis(
+                self._probs_fn(lg), v.astype(jnp.int32)[..., None], -1))[..., 0],
+            value, self.logits, op_name="categorical_log_prob")
+
+    def probs_of(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        return _wrap(
+            lambda lg: -jnp.sum(self._probs_fn(lg) * jnp.log(self._probs_fn(lg)), -1),
+            self.logits, op_name="categorical_entropy")
+
+    def kl_divergence(self, other):
+        return _wrap(
+            lambda a, b: jnp.sum(self._probs_fn(a) * (
+                jnp.log(self._probs_fn(a)) - jnp.log(other._probs_fn(b))), -1),
+            self.logits, other.logits, op_name="categorical_kl")
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shape = self.probs._value.shape
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(lambda p: self.total_count * p / jnp.sum(p, -1, keepdims=True),
+                     self.probs, op_name="multinomial_mean")
+
+    @property
+    def variance(self):
+        def f(p):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            return self.total_count * pn * (1 - pn)
+        return _wrap(f, self.probs, op_name="multinomial_var")
+
+    def sample(self, shape=()):
+        key = self._key()
+        out_shape = tuple(shape) + self._batch_shape
+
+        def f(p):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            logp = jnp.log(jnp.clip(pn, _EPS, 1.0))
+            draws = jax.random.categorical(
+                key, logp, shape=(self.total_count,) + out_shape)
+            onehot = jax.nn.one_hot(draws, p.shape[-1], dtype=jnp.float32)
+            return jnp.sum(onehot, axis=0)
+        return _wrap(f, self.probs.detach(), op_name="multinomial_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, p):
+            pn = jnp.clip(p / jnp.sum(p, -1, keepdims=True), _EPS, 1.0)
+            return (jax.scipy.special.gammaln(self.total_count + 1.0)
+                    - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1)
+                    + jnp.sum(v * jnp.log(pn), -1))
+        return _wrap(f, value, self.probs, op_name="multinomial_log_prob")

@@ -1,0 +1,47 @@
+"""Cauchy — analog of python/paddle/distribution/cauchy.py."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape, self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        return _wrap(
+            lambda l, s: l + s * jax.random.cauchy(key, out_shape),
+            self.loc, self.scale, op_name="cauchy_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, l, s: -math.log(math.pi) - jnp.log(s)
+            - jnp.log1p(((v - l) / s) ** 2),
+            value, self.loc, self.scale, op_name="cauchy_log_prob")
+
+    def entropy(self):
+        return _wrap(lambda s: jnp.log(4 * math.pi * s), self.scale,
+                     op_name="cauchy_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, l, s: jnp.arctan((v - l) / s) / math.pi + 0.5,
+            value, self.loc, self.scale, op_name="cauchy_cdf")
+
+    def icdf(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda p, l, s: l + s * jnp.tan(math.pi * (p - 0.5)),
+            value, self.loc, self.scale, op_name="cauchy_icdf")

@@ -1,0 +1,56 @@
+"""Dirichlet — analog of python/paddle/distribution/dirichlet.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, _t, _wrap
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        shape = self.concentration._value.shape
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                     self.concentration, op_name="dirichlet_mean")
+
+    @property
+    def variance(self):
+        def f(c):
+            a0 = jnp.sum(c, -1, keepdims=True)
+            m = c / a0
+            return m * (1 - m) / (a0 + 1)
+        return _wrap(f, self.concentration, op_name="dirichlet_var")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = tuple(shape) + self.concentration._value.shape
+
+        def f(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, out_shape))
+            return g / jnp.sum(g, -1, keepdims=True)
+        return _wrap(f, self.concentration, op_name="dirichlet_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, c):
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + jax.scipy.special.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jax.scipy.special.gammaln(c), -1))
+        return _wrap(f, value, self.concentration, op_name="dirichlet_log_prob")
+
+    def entropy(self):
+        def f(c):
+            k = c.shape[-1]
+            a0 = jnp.sum(c, -1)
+            lnB = jnp.sum(jax.scipy.special.gammaln(c), -1) \
+                - jax.scipy.special.gammaln(a0)
+            dg = jax.scipy.special.digamma
+            return (lnB + (a0 - k) * dg(a0)
+                    - jnp.sum((c - 1) * dg(c), -1))
+        return _wrap(f, self.concentration, op_name="dirichlet_entropy")

@@ -1,0 +1,94 @@
+"""Distribution base — analog of python/paddle/distribution/distribution.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import generator as gen
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply
+
+
+def _t(x):
+    """Coerce ctor args to Tensor (accepts scalars/np/Tensor)."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, jnp.float32) if not isinstance(x, jnp.ndarray)
+                  else x)
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(fn, *tensors, **kw):
+    """Run a jnp computation over tensor args with tape recording."""
+    return apply(fn, *tensors, **kw)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        """Non-reparameterized draw (no gradient path)."""
+        s = self.rsample(shape)
+        return s.detach() if isinstance(s, Tensor) else s
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return _wrap(jnp.exp, lp, op_name="dist_prob")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution"):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    # -- helpers --
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    @staticmethod
+    def _key():
+        return gen.next_key()
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self._batch_shape}, " \
+               f"event_shape={self._event_shape})"
+
+
+class ExponentialFamily(Distribution):
+    """Marker base for exponential-family distributions (Bregman-divergence
+    entropy trick not needed — entropies are closed-form here)."""
+    pass

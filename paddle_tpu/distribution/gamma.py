@@ -1,0 +1,89 @@
+"""Gamma / Chi2 / Exponential — analog of python/paddle/distribution/gamma.py,
+chi2.py, exponential.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, _t, _wrap
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        shape = jnp.broadcast_shapes(self.concentration._value.shape,
+                                     self.rate._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _wrap(lambda a, b: a / b, self.concentration, self.rate,
+                     op_name="gamma_mean")
+
+    @property
+    def variance(self):
+        return _wrap(lambda a, b: a / (b * b), self.concentration, self.rate,
+                     op_name="gamma_var")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        return _wrap(
+            lambda a, b: jax.random.gamma(key, jnp.broadcast_to(a, out_shape)) / b,
+            self.concentration, self.rate, op_name="gamma_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, a, b: a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+            - jax.scipy.special.gammaln(a),
+            value, self.concentration, self.rate, op_name="gamma_log_prob")
+
+    def entropy(self):
+        return _wrap(
+            lambda a, b: a - jnp.log(b) + jax.scipy.special.gammaln(a)
+            + (1 - a) * jax.scipy.special.digamma(a),
+            self.concentration, self.rate, op_name="gamma_entropy")
+
+
+class Chi2(Gamma):
+    def __init__(self, df):
+        df_t = _t(df)
+        self.df = df_t
+        super().__init__(
+            _wrap(lambda d: d / 2, df_t, op_name="chi2_conc"), 0.5)
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(batch_shape=self.rate._value.shape)
+
+    @property
+    def mean(self):
+        return _wrap(lambda r: 1.0 / r, self.rate, op_name="exponential_mean")
+
+    @property
+    def variance(self):
+        return _wrap(lambda r: 1.0 / (r * r), self.rate, op_name="exponential_var")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        return _wrap(lambda r: jax.random.exponential(key, out_shape) / r,
+                     self.rate, op_name="exponential_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(lambda v, r: jnp.log(r) - r * v, value, self.rate,
+                     op_name="exponential_log_prob")
+
+    def entropy(self):
+        return _wrap(lambda r: 1.0 - jnp.log(r), self.rate,
+                     op_name="exponential_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return _wrap(lambda v, r: 1 - jnp.exp(-r * v), value, self.rate,
+                     op_name="exponential_cdf")

@@ -1,0 +1,60 @@
+"""Geometric — analog of python/paddle/distribution/geometric.py
+(number of failures before the first success, support {0,1,2,...})."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+
+_EPS = 1e-7
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        super().__init__(batch_shape=self.probs._value.shape)
+
+    @property
+    def mean(self):
+        return _wrap(lambda p: (1 - p) / p, self.probs, op_name="geometric_mean")
+
+    @property
+    def variance(self):
+        return _wrap(lambda p: (1 - p) / (p * p), self.probs,
+                     op_name="geometric_var")
+
+    @property
+    def stddev(self):
+        return _wrap(lambda p: jnp.sqrt(1 - p) / p, self.probs,
+                     op_name="geometric_std")
+
+    def sample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, out_shape, minval=_EPS, maxval=1 - _EPS)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+        return _wrap(f, self.probs.detach(), op_name="geometric_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, p: v * jnp.log1p(-jnp.clip(p, _EPS, 1 - _EPS))
+            + jnp.log(jnp.clip(p, _EPS, 1)),
+            value, self.probs, op_name="geometric_log_prob")
+
+    def entropy(self):
+        return _wrap(
+            lambda p: (-(1 - p) * jnp.log(jnp.clip(1 - p, _EPS, 1))
+                       - p * jnp.log(jnp.clip(p, _EPS, 1))) / p,
+            self.probs, op_name="geometric_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, p: 1 - jnp.power(jnp.clip(1 - p, 0, 1), jnp.floor(v) + 1),
+            value, self.probs, op_name="geometric_cdf")

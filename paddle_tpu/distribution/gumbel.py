@@ -1,0 +1,59 @@
+"""Gumbel — analog of python/paddle/distribution/gumbel.py."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+
+_EULER = 0.5772156649015329
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape, self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _wrap(lambda l, s: l + s * _EULER, self.loc, self.scale,
+                     op_name="gumbel_mean")
+
+    @property
+    def variance(self):
+        return _wrap(lambda s: (math.pi ** 2 / 6) * s * s, self.scale,
+                     op_name="gumbel_var")
+
+    @property
+    def stddev(self):
+        return _wrap(lambda s: (math.pi / math.sqrt(6)) * s, self.scale,
+                     op_name="gumbel_std")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        return _wrap(
+            lambda l, s: l + s * jax.random.gumbel(key, out_shape),
+            self.loc, self.scale, op_name="gumbel_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _wrap(f, value, self.loc, self.scale, op_name="gumbel_log_prob")
+
+    def entropy(self):
+        return _wrap(lambda s: jnp.log(s) + 1 + _EULER, self.scale,
+                     op_name="gumbel_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, l, s: jnp.exp(-jnp.exp(-(v - l) / s)),
+            value, self.loc, self.scale, op_name="gumbel_cdf")

@@ -1,0 +1,46 @@
+"""Independent — analog of python/paddle/distribution/independent.py
+(reinterpret trailing batch dims as event dims)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _wrap
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank: int):
+        self.base = base
+        self._r = int(reinterpreted_batch_rank)
+        if self._r > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds base batch rank")
+        cut = len(base.batch_shape) - self._r
+        super().__init__(batch_shape=base.batch_shape[:cut],
+                         event_shape=base.batch_shape[cut:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        if self._r == 0:
+            return lp
+        return _wrap(lambda x: jnp.sum(x, axis=tuple(range(-self._r, 0))),
+                     lp, op_name="independent_log_prob")
+
+    def entropy(self):
+        ent = self.base.entropy()
+        if self._r == 0:
+            return ent
+        return _wrap(lambda x: jnp.sum(x, axis=tuple(range(-self._r, 0))),
+                     ent, op_name="independent_entropy")

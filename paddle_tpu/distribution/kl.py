@@ -1,0 +1,145 @@
+"""KL divergence registry — analog of python/paddle/distribution/kl.py
+(register_kl dispatch on (type_p, type_q) with MRO resolution)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _wrap
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def deco(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def _dispatch(type_p, type_q):
+    matches = []
+    for (p, q), fn in _REGISTRY.items():
+        if issubclass(type_p, p) and issubclass(type_q, q):
+            matches.append((p, q, fn))
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({type_p.__name__}, {type_q.__name__})")
+    # most-specific match by MRO depth
+    def depth(c, base):
+        return c.mro().index(base)
+    matches.sort(key=lambda m: depth(type_p, m[0]) + depth(type_q, m[1]))
+    return matches[0][2]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return _dispatch(type(p), type(q))(p, q)
+
+
+# ---- closed forms ----
+from .normal import Normal  # noqa: E402
+from .uniform import Uniform  # noqa: E402
+from .bernoulli import Bernoulli  # noqa: E402
+from .categorical import Categorical  # noqa: E402
+from .beta import Beta  # noqa: E402
+from .dirichlet import Dirichlet  # noqa: E402
+from .gamma import Gamma, Exponential  # noqa: E402
+from .laplace import Laplace  # noqa: E402
+from .geometric import Geometric  # noqa: E402
+from .poisson import Poisson  # noqa: E402
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    return _wrap(
+        lambda lp, sp, lq, sq: jnp.log(sq / sp)
+        + (sp ** 2 + (lp - lq) ** 2) / (2 * sq ** 2) - 0.5,
+        p.loc, p.scale, q.loc, q.scale, op_name="kl_normal")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return _wrap(
+        lambda pa, pb, qa, qb: jnp.where(
+            (qa <= pa) & (pb <= qb),
+            jnp.log((qb - qa) / (pb - pa)), jnp.inf),
+        p.low, p.high, q.low, q.high, op_name="kl_uniform")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    eps = 1e-7
+    return _wrap(
+        lambda a, b: a * (jnp.log(jnp.clip(a, eps, 1)) - jnp.log(jnp.clip(b, eps, 1)))
+        + (1 - a) * (jnp.log(jnp.clip(1 - a, eps, 1)) - jnp.log(jnp.clip(1 - b, eps, 1))),
+        p.probs, q.probs, op_name="kl_bernoulli")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    import jax
+    def f(pa, pb, qa, qb):
+        dg = jax.scipy.special.digamma
+        return (jax.scipy.special.betaln(qa, qb) - jax.scipy.special.betaln(pa, pb)
+                + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+                + (qa - pa + qb - pb) * dg(pa + pb))
+    return _wrap(f, p.alpha, p.beta, q.alpha, q.beta, op_name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    import jax
+    def f(c1, c2):
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        s1 = jnp.sum(c1, -1)
+        return (gl(s1) - jnp.sum(gl(c1), -1)
+                - gl(jnp.sum(c2, -1)) + jnp.sum(gl(c2), -1)
+                + jnp.sum((c1 - c2) * (dg(c1) - dg(s1)[..., None]), -1))
+    return _wrap(f, p.concentration, q.concentration, op_name="kl_dirichlet")
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    import jax
+    def f(pa, pb, qa, qb):
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        return ((pa - qa) * dg(pa) - gl(pa) + gl(qa)
+                + qa * (jnp.log(pb) - jnp.log(qb)) + pa * (qb - pb) / pb)
+    return _wrap(f, p.concentration, p.rate, q.concentration, q.rate,
+                 op_name="kl_gamma")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    return _wrap(lambda rp, rq: jnp.log(rp) - jnp.log(rq) + rq / rp - 1,
+                 p.rate, q.rate, op_name="kl_exponential")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    return _wrap(
+        lambda lp, sp, lq, sq: jnp.log(sq / sp)
+        + (sp * jnp.exp(-jnp.abs(lp - lq) / sp) + jnp.abs(lp - lq)) / sq - 1,
+        p.loc, p.scale, q.loc, q.scale, op_name="kl_laplace")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    eps = 1e-7
+    return _wrap(
+        lambda a, b: (1 - a) / a * (jnp.log1p(-jnp.clip(a, eps, 1 - eps))
+                                    - jnp.log1p(-jnp.clip(b, eps, 1 - eps)))
+        + jnp.log(jnp.clip(a, eps, 1)) - jnp.log(jnp.clip(b, eps, 1)),
+        p.probs, q.probs, op_name="kl_geometric")
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return _wrap(lambda a, b: a * (jnp.log(a) - jnp.log(b)) - a + b,
+                 p.rate, q.rate, op_name="kl_poisson")

@@ -1,0 +1,61 @@
+"""Laplace — analog of python/paddle/distribution/laplace.py."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape, self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _wrap(lambda s: 2 * s * s, self.scale, op_name="laplace_var")
+
+    @property
+    def stddev(self):
+        return _wrap(lambda s: math.sqrt(2) * s, self.scale, op_name="laplace_std")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, s):
+            u = jax.random.uniform(key, out_shape, minval=-0.5 + 1e-7,
+                                   maxval=0.5 - 1e-7)
+            return l - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+        return _wrap(f, self.loc, self.scale, op_name="laplace_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            value, self.loc, self.scale, op_name="laplace_log_prob")
+
+    def entropy(self):
+        return _wrap(lambda s: 1 + jnp.log(2 * s), self.scale,
+                     op_name="laplace_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, l, s: 0.5 - 0.5 * jnp.sign(v - l) * jnp.expm1(-jnp.abs(v - l) / s),
+            value, self.loc, self.scale, op_name="laplace_cdf")
+
+    def icdf(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda p, l, s: l - s * jnp.sign(p - 0.5) * jnp.log1p(-2 * jnp.abs(p - 0.5)),
+            value, self.loc, self.scale, op_name="laplace_icdf")

@@ -1,0 +1,78 @@
+"""MultivariateNormal — analog of
+python/paddle/distribution/multivariate_normal.py."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = _t(loc)
+        if sum(x is not None for x in
+               (covariance_matrix, precision_matrix, scale_tril)) != 1:
+            raise ValueError("give exactly one of covariance_matrix/"
+                             "precision_matrix/scale_tril")
+        if covariance_matrix is not None:
+            self.covariance_matrix = _t(covariance_matrix)
+            self.scale_tril = _wrap(jnp.linalg.cholesky, self.covariance_matrix,
+                                    op_name="mvn_chol")
+        elif scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+            self.covariance_matrix = _wrap(
+                lambda L: L @ jnp.swapaxes(L, -1, -2), self.scale_tril,
+                op_name="mvn_cov")
+        else:
+            prec = _t(precision_matrix)
+            self.covariance_matrix = _wrap(jnp.linalg.inv, prec,
+                                           op_name="mvn_cov_from_prec")
+            self.scale_tril = _wrap(jnp.linalg.cholesky, self.covariance_matrix,
+                                    op_name="mvn_chol")
+        d = self.loc._value.shape[-1]
+        batch = jnp.broadcast_shapes(self.loc._value.shape[:-1],
+                                     self.scale_tril._value.shape[:-2])
+        super().__init__(batch_shape=batch, event_shape=(d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _wrap(lambda c: jnp.diagonal(c, axis1=-2, axis2=-1),
+                     self.covariance_matrix, op_name="mvn_var")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = tuple(shape) + self._batch_shape + self._event_shape
+        return _wrap(
+            lambda l, L: l + jnp.einsum(
+                "...ij,...j->...i", L,
+                jax.random.normal(key, out_shape, jnp.float32)),
+            self.loc, self.scale_tril, op_name="mvn_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, l, L):
+            d = v.shape[-1]
+            diff = v - l
+            sol = jax.scipy.linalg.solve_triangular(
+                jnp.broadcast_to(L, diff.shape[:-1] + L.shape[-2:]),
+                diff[..., None], lower=True)[..., 0]
+            maha = jnp.sum(sol * sol, -1)
+            logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return -0.5 * (d * math.log(2 * math.pi) + logdet + maha)
+        return _wrap(f, value, self.loc, self.scale_tril, op_name="mvn_log_prob")
+
+    def entropy(self):
+        def f(L):
+            d = L.shape[-1]
+            logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return 0.5 * (d * (1 + math.log(2 * math.pi)) + logdet)
+        return _wrap(f, self.scale_tril, op_name="mvn_entropy")

@@ -1,0 +1,102 @@
+"""Normal / LogNormal — analog of python/paddle/distribution/normal.py,
+lognormal.py."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, ExponentialFamily, _t, _wrap
+
+
+class Normal(ExponentialFamily):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape, self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _wrap(lambda s: jnp.broadcast_to(s * s, self._batch_shape),
+                     self.scale, op_name="normal_var")
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        return _wrap(
+            lambda l, s: l + s * jax.random.normal(key, out_shape, jnp.float32),
+            self.loc, self.scale, op_name="normal_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, l, s: -((v - l) ** 2) / (2 * s ** 2)
+            - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+            value, self.loc, self.scale, op_name="normal_log_prob")
+
+    def entropy(self):
+        return _wrap(
+            lambda s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), self._batch_shape),
+            self.scale, op_name="normal_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, l, s: 0.5 * (1 + jax.scipy.special.erf((v - l) / (s * math.sqrt(2)))),
+            value, self.loc, self.scale, op_name="normal_cdf")
+
+    def icdf(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, l, s: l + s * math.sqrt(2) * jax.scipy.special.erfinv(2 * v - 1),
+            value, self.loc, self.scale, op_name="normal_icdf")
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(batch_shape=self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return _wrap(lambda l, s: jnp.exp(l + s * s / 2), self.loc, self.scale,
+                     op_name="lognormal_mean")
+
+    @property
+    def variance(self):
+        return _wrap(lambda l, s: (jnp.exp(s * s) - 1) * jnp.exp(2 * l + s * s),
+                     self.loc, self.scale, op_name="lognormal_var")
+
+    def rsample(self, shape=()):
+        base = self._base.rsample(shape)
+        return _wrap(jnp.exp, base, op_name="lognormal_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, l, s: -((jnp.log(v) - l) ** 2) / (2 * s ** 2)
+            - jnp.log(v * s) - 0.5 * math.log(2 * math.pi),
+            value, self.loc, self.scale, op_name="lognormal_log_prob")
+
+    def entropy(self):
+        return _wrap(
+            lambda l, s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) + l,
+                self._batch_shape),
+            self.loc, self.scale, op_name="lognormal_entropy")

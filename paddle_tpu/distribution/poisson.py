@@ -1,0 +1,43 @@
+"""Poisson — analog of python/paddle/distribution/poisson.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, _t, _wrap
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(batch_shape=self.rate._value.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        return _wrap(
+            lambda r: jax.random.poisson(key, r, out_shape).astype(jnp.float32),
+            self.rate.detach(), op_name="poisson_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, r: v * jnp.log(r) - r - jax.scipy.special.gammaln(v + 1.0),
+            value, self.rate, op_name="poisson_log_prob")
+
+    def entropy(self, terms: int = 64):
+        """Series approximation over a truncated support."""
+        def f(r):
+            k = jnp.arange(terms, dtype=jnp.float32)
+            rr = r[..., None]
+            logp = k * jnp.log(rr) - rr - jax.scipy.special.gammaln(k + 1.0)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return _wrap(f, self.rate, op_name="poisson_entropy")

@@ -1,0 +1,59 @@
+"""StudentT — analog of python/paddle/distribution/student_t.py."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc, scale):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.df._value.shape,
+                                     self.loc._value.shape,
+                                     self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _wrap(lambda d, l: jnp.where(d > 1, l, jnp.nan), self.df,
+                     self.loc, op_name="studentt_mean")
+
+    @property
+    def variance(self):
+        return _wrap(
+            lambda d, s: jnp.where(d > 2, s * s * d / (d - 2),
+                                   jnp.where(d > 1, jnp.inf, jnp.nan)),
+            self.df, self.scale, op_name="studentt_var")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        return _wrap(
+            lambda d, l, s: l + s * jax.random.t(key, jnp.broadcast_to(d, out_shape)),
+            self.df, self.loc, self.scale, op_name="studentt_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def f(v, d, l, s):
+            z = (v - l) / s
+            return (jax.scipy.special.gammaln((d + 1) / 2)
+                    - jax.scipy.special.gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
+                    - (d + 1) / 2 * jnp.log1p(z * z / d))
+        return _wrap(f, value, self.df, self.loc, self.scale,
+                     op_name="studentt_log_prob")
+
+    def entropy(self):
+        def f(d, s):
+            dg = jax.scipy.special.digamma
+            return ((d + 1) / 2 * (dg((d + 1) / 2) - dg(d / 2))
+                    + 0.5 * jnp.log(d)
+                    + jax.scipy.special.betaln(d / 2, 0.5) + jnp.log(s))
+        return _wrap(f, self.df, self.scale, op_name="studentt_entropy")

@@ -1,0 +1,262 @@
+"""Bijective transforms — analog of python/paddle/distribution/transform.py
+(AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import _t, _wrap
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.INJECTION
+    # how many trailing dims the transform consumes as an event
+    event_rank = 0
+
+    def forward(self, x):
+        return _wrap(self._forward, _t(x), op_name=f"{type(self).__name__}_fwd")
+
+    def inverse(self, y):
+        return _wrap(self._inverse, _t(y), op_name=f"{type(self).__name__}_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._fldj, _t(x), op_name=f"{type(self).__name__}_fldj")
+
+    def inverse_log_det_jacobian(self, y):
+        return _wrap(lambda v: -self._fldj(self._inverse(v)), _t(y),
+                     op_name=f"{type(self).__name__}_ildj")
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclass hooks (pure jnp)
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _forward(self, x):
+        return self.loc._value + self.scale._value * x
+
+    def _inverse(self, y):
+        return (y - self.loc._value) / self.scale._value
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale._value)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power._value)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power._value)
+
+    def _fldj(self, x):
+        p = self.power._value
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        import math
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+    event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    _type = Type.BIJECTION
+    event_rank = 1
+
+    def _forward(self, x):
+        # x: [..., K-1] -> simplex [..., K]
+        offset = jnp.arange(x.shape[-1], 0, -1, dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.concatenate([z, jnp.ones(z.shape[:-1] + (1,), z.dtype)], -1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(z.shape[:-1] + (1,), z.dtype),
+             jnp.cumprod(1 - z, -1)], -1)
+        return zpad * one_minus
+
+    def _inverse(self, y):
+        cum = jnp.cumsum(y[..., :-1], -1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), cum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        offset = jnp.arange(y.shape[-1] - 1, 0, -1, dtype=y.dtype)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _fldj(self, x):
+        offset = jnp.arange(x.shape[-1], 0, -1, dtype=x.dtype)
+        xo = x - jnp.log(offset)
+        z = jax.nn.sigmoid(xo)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z)
+                       + jnp.cumsum(jnp.log1p(-z), -1) - jnp.log1p(-z), -1)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self.event_rank = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self._r = int(reinterpreted_batch_rank)
+        self.event_rank = base.event_rank + self._r
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ld = self.base._fldj(x)
+        return jnp.sum(ld, axis=tuple(range(-self._r, 0))) if self._r else ld
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self.event_rank = max((t.event_rank for t in self.transforms), default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t._fldj(x)
+            # reduce sub-transform ldj over dims this chain treats as event
+            extra = self.event_rank - t.event_rank
+            if extra and ld.ndim >= extra:
+                ld = jnp.sum(ld, axis=tuple(range(-extra, 0)))
+            total = ld if total is None else total + ld
+            x = t._forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _split(self, x):
+        return [jnp.take(x, i, axis=self.axis)
+                for i in range(len(self.transforms))]
+
+    def _forward(self, x):
+        return jnp.stack([t._forward(p) for t, p in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+    def _inverse(self, y):
+        return jnp.stack([t._inverse(p) for t, p in
+                          zip(self.transforms, self._split(y))], self.axis)
+
+    def _fldj(self, x):
+        return jnp.stack([t._fldj(p) for t, p in
+                          zip(self.transforms, self._split(x))], self.axis)

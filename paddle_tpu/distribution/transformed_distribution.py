@@ -1,0 +1,47 @@
+"""TransformedDistribution — analog of
+python/paddle/distribution/transformed_distribution.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+from .transform import ChainTransform, Transform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        super().__init__(batch_shape=base.batch_shape,
+                         event_shape=base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        value = _t(value)
+        # walk backwards accumulating inverse log-det-jacobians
+        lp = None
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            term = _wrap(lambda l: -l, ld, op_name="tdist_neg_ldj")
+            lp = term if lp is None else _wrap(jnp.add, lp, term,
+                                               op_name="tdist_acc")
+            y = x
+        blp = self.base.log_prob(y)
+        return _wrap(jnp.add, blp, lp, op_name="tdist_log_prob") \
+            if lp is not None else blp

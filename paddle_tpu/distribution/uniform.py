@@ -1,0 +1,54 @@
+"""Uniform — analog of python/paddle/distribution/uniform.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        shape = jnp.broadcast_shapes(self.low._value.shape, self.high._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _wrap(lambda a, b: (a + b) / 2, self.low, self.high,
+                     op_name="uniform_mean")
+
+    @property
+    def variance(self):
+        return _wrap(lambda a, b: (b - a) ** 2 / 12, self.low, self.high,
+                     op_name="uniform_var")
+
+    def rsample(self, shape=()):
+        key = self._key()
+        out_shape = self._extend_shape(shape)
+        return _wrap(
+            lambda a, b: a + (b - a) * jax.random.uniform(key, out_shape),
+            self.low, self.high, op_name="uniform_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, a, b: jnp.where((v >= a) & (v < b), -jnp.log(b - a),
+                                      -jnp.inf),
+            value, self.low, self.high, op_name="uniform_log_prob")
+
+    def entropy(self):
+        return _wrap(lambda a, b: jnp.log(b - a), self.low, self.high,
+                     op_name="uniform_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, a, b: jnp.clip((v - a) / (b - a), 0.0, 1.0),
+            value, self.low, self.high, op_name="uniform_cdf")
+
+    def icdf(self, value):
+        value = _t(value)
+        return _wrap(lambda v, a, b: a + v * (b - a), value, self.low,
+                     self.high, op_name="uniform_icdf")

@@ -1,0 +1,123 @@
+"""paddle_tpu.geometric — graph learning ops.
+
+Analog of python/paddle/geometric/ (segment_sum/mean/max/min, send_u_recv /
+send_ue_recv / send_uv message passing, reindex/sampling helpers). On TPU
+these are jnp segment ops (scatter-adds XLA schedules well); message passing
+composes gather (u on edges) + segment reduce (recv)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv",
+]
+
+
+def _n_segments(segment_ids, num_segments):
+    """Segment count must be STATIC for XLA. Resolve it eagerly from concrete
+    ids; under tracing the caller must pass num_segments explicitly."""
+    if num_segments is not None:
+        return int(num_segments)
+    ids = segment_ids._value if isinstance(segment_ids, Tensor) else segment_ids
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            "segment ops need an explicit num_segments under jit/to_static "
+            "(the output shape must be static)")
+    return int(jnp.max(ids)) + 1
+
+
+def _seg(reduce_fn, x, segment_ids, num_segments=None):
+    n = _n_segments(segment_ids, num_segments)
+
+    def f(v, ids):
+        return reduce_fn(v, ids.astype(jnp.int32), num_segments=n)
+    return apply(f, x, segment_ids, op_name=f"segment_{reduce_fn.__name__}")
+
+
+def segment_sum(data, segment_ids, num_segments=None):
+    return _seg(jax.ops.segment_sum, data, segment_ids, num_segments)
+
+
+def segment_mean(data, segment_ids, num_segments=None):
+    n = _n_segments(segment_ids, num_segments)
+
+    def f(v, ids):
+        ids = ids.astype(jnp.int32)
+        s = jax.ops.segment_sum(v, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(v[..., :1]) if v.ndim > 1
+                                  else jnp.ones_like(v), ids, num_segments=n)
+        return s / jnp.maximum(cnt, 1)
+    return apply(f, data, segment_ids, op_name="segment_mean")
+
+
+def segment_max(data, segment_ids, num_segments=None):
+    return _seg(jax.ops.segment_max, data, segment_ids, num_segments)
+
+
+def segment_min(data, segment_ids, num_segments=None):
+    return _seg(jax.ops.segment_min, data, segment_ids, num_segments)
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "add": jax.ops.segment_sum,
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum", out_size=None):
+    """Gather x[src] along edges, segment-reduce onto dst."""
+    def f(v, src, dst):
+        msgs = jnp.take(v, src.astype(jnp.int32), axis=0)
+        n = out_size if out_size is not None else v.shape[0]
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, dst.astype(jnp.int32), num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],) + (1,) * (msgs.ndim - 1)),
+                                      dst.astype(jnp.int32), num_segments=n)
+            return s / jnp.maximum(cnt, 1)
+        red = _REDUCERS[reduce_op]
+        out = red(msgs, dst.astype(jnp.int32), num_segments=n)
+        if reduce_op in ("max", "min"):
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return out
+    return apply(f, x, src_index, dst_index, op_name="send_u_recv")
+
+
+_MSG_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None):
+    """Message = x[src] (message_op) edge_feature y; reduce onto dst."""
+    def f(v, e, src, dst):
+        msgs = _MSG_OPS[message_op](jnp.take(v, src.astype(jnp.int32), axis=0), e)
+        n = out_size if out_size is not None else v.shape[0]
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, dst.astype(jnp.int32), num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],) + (1,) * (msgs.ndim - 1)),
+                                      dst.astype(jnp.int32), num_segments=n)
+            return s / jnp.maximum(cnt, 1)
+        red = _REDUCERS[reduce_op]
+        out = red(msgs, dst.astype(jnp.int32), num_segments=n)
+        if reduce_op in ("max", "min"):
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return out
+    return apply(f, x, y, src_index, dst_index, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add"):
+    """Per-edge message from x[src] and y[dst] (no reduction)."""
+    def f(u, v, src, dst):
+        return _MSG_OPS[message_op](
+            jnp.take(u, src.astype(jnp.int32), axis=0),
+            jnp.take(v, dst.astype(jnp.int32), axis=0))
+    return apply(f, x, y, src_index, dst_index, op_name="send_uv")

@@ -1,0 +1,3 @@
+"""paddle_tpu.incubate — incubating APIs (asp 2:4 sparsity, nn fused ops
+re-exports)."""
+from . import asp  # noqa: F401

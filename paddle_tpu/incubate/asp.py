@@ -1,0 +1,102 @@
+"""ASP (automatic structured pruning, 2:4 sparsity) — analog of
+python/paddle/incubate/asp/ (calculate_density, create_mask 1D/2D best,
+prune_model, decorate, reset_excluded_layers).
+
+On TPU there is no sparse tensor core; the win is model-size + the masks keep
+the dense matmul shape (MXU-friendly). prune_model computes 2:4 masks and
+zeroes weights; `decorate` re-applies masks after each optimizer step so
+training stays inside the sparse support.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_EXCLUDED: set = set()
+_MASKS: dict = {}
+
+
+def calculate_density(x) -> float:
+    a = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def _best_2in4_mask_1d(w: np.ndarray) -> np.ndarray:
+    """For each group of 4, keep the 2 largest |w|."""
+    pad = (-w.size) % 4
+    flat = np.concatenate([w.ravel(), np.zeros(pad, w.dtype)])
+    groups = flat.reshape(-1, 4)
+    order = np.argsort(-np.abs(groups), axis=1)
+    mask = np.zeros_like(groups, dtype=bool)
+    rows = np.arange(groups.shape[0])[:, None]
+    mask[rows, order[:, :2]] = True
+    return mask.ravel()[:w.size].reshape(w.shape)
+
+
+def create_mask(tensor, func_name: str = "get_mask_2d_best", n: int = 2,
+                m: int = 4):
+    w = tensor.numpy() if isinstance(tensor, Tensor) else np.asarray(tensor)
+    if func_name in ("get_mask_1d", "get_mask_2d_best", "get_mask_2d_greedy"):
+        mask = _best_2in4_mask_1d(w)
+    else:
+        raise ValueError(f"unknown mask func {func_name!r}")
+    return Tensor(jnp.asarray(mask.astype(w.dtype)))
+
+
+def check_sparsity(tensor, n: int = 2, m: int = 4, func_name="check_mask_1d"):
+    w = tensor.numpy() if isinstance(tensor, Tensor) else np.asarray(tensor)
+    pad = (-w.size) % m
+    flat = np.concatenate([w.ravel(), np.zeros(pad, w.dtype)])
+    groups = flat.reshape(-1, m)
+    return bool(np.all(np.count_nonzero(groups, axis=1) <= n))
+
+
+def _prunable(name: str, p) -> bool:
+    return (p.ndim == 2 and name.endswith("weight")
+            and id(p) not in _EXCLUDED and p.shape[0] % 4 == 0)
+
+
+def set_excluded_layers(model, layer_names):
+    for name, sub in model.named_sublayers(include_self=True):
+        if name in layer_names:
+            for _, p in sub.named_parameters(include_sublayers=False):
+                _EXCLUDED.add(id(p))
+
+
+def reset_excluded_layers(model=None):
+    _EXCLUDED.clear()
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Compute 2:4 masks for prunable weights and zero them."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = create_mask(p, n=n, m=m)
+        p._value = p._value * mask._value
+        masks[name] = mask
+        _MASKS[id(p)] = mask
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after each update (the ASP
+    OptimizerWithSparsityGuarantee analog)."""
+    inner_step = optimizer.step
+
+    def step(*a, **k):
+        out = inner_step(*a, **k)
+        params = getattr(optimizer, "_params", None) or \
+            getattr(optimizer, "_parameter_list", [])
+        for p in params:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask._value
+        return out
+
+    optimizer.step = step
+    return optimizer

@@ -1,0 +1,13 @@
+"""paddle_tpu.quantization — QAT/PTQ.
+
+Analog of python/paddle/quantization/ (QuantConfig, QAT, PTQ) and
+paddle.nn.quant fake-quant observers. TPU-native: fake-quantization is a pure
+elementwise graph (quantize->dequantize with straight-through gradients) that
+XLA fuses into adjacent ops; int8 deployment is a compiler concern.
+"""
+from .config import QuantConfig  # noqa: F401
+from .quanters import (  # noqa: F401
+    FakeQuanterWithAbsMaxObserver, AbsmaxObserver, fake_quant_abs_max,
+)
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401

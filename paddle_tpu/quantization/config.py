@@ -1,0 +1,45 @@
+"""QuantConfig — analog of python/paddle/quantization/config.py (map layers /
+layer types / prefixes to quanters)."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_configs = {}
+        self._layer_configs = {}
+        self._prefix_configs = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type_configs[t] = (activation, weight)
+        return self
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs[id(l)] = (activation, weight)
+        return self
+
+    def add_name_config(self, prefix, activation=None, weight=None):
+        names = prefix if isinstance(prefix, (list, tuple)) else [prefix]
+        for n in names:
+            self._prefix_configs[n] = (activation, weight)
+        return self
+
+    def config_for(self, name: str, layer) -> Optional[tuple]:
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for prefix, cfg in self._prefix_configs.items():
+            if name.startswith(prefix):
+                return cfg
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        if self.activation is not None or self.weight is not None:
+            return (self.activation, self.weight)
+        return None

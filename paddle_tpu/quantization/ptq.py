@@ -1,0 +1,64 @@
+"""PTQ — analog of python/paddle/quantization/ptq.py: insert observers, run
+calibration batches, then freeze scales into fake-quant."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .quanters import AbsmaxObserver, fake_quant_abs_max
+
+
+class _ObservedWrapper(Layer):
+    def __init__(self, inner, observer):
+        super().__init__()
+        self.inner = inner
+        self.observer = observer() if callable(observer) else observer
+        self._frozen = False
+
+    def forward(self, x):
+        if self._frozen:
+            from ..core.tensor import Tensor
+            x = fake_quant_abs_max(x, self.observer.scales(),
+                                   getattr(self.observer, "quant_bits", 8))
+        else:
+            x = self.observer(x)
+        return self.inner(x)
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        from .qat import _name_configs
+        name_cfgs = _name_configs(self.config, model)
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.eval()
+        self._convert(model, prefix="", name_cfgs=name_cfgs)
+        return model
+
+    def _convert(self, layer: Layer, prefix: str, name_cfgs=None):
+        from .qat import _quantizable
+        name_cfgs = name_cfgs or {}
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}{name}"
+            if _quantizable(sub):
+                cfg = name_cfgs.get(full) or self.config.config_for(full, sub)
+                if cfg is not None:
+                    act_q, _ = cfg
+                    obs = act_q or AbsmaxObserver
+                    layer._sub_layers[name] = _ObservedWrapper(sub, obs)
+                    setattr(layer, name, layer._sub_layers[name])
+                    continue
+            self._convert(sub, prefix=f"{full}.", name_cfgs=name_cfgs)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Freeze observed scales -> fake-quant inference graph."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        for sub in model.sublayers(include_self=True):
+            if isinstance(sub, _ObservedWrapper):
+                sub._frozen = True
+        return model

@@ -1,0 +1,100 @@
+"""QAT — analog of python/paddle/quantization/qat.py: wrap quantizable layers
+(Linear/Conv2D) with fake-quant on activations + weights."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .quanters import FakeQuanterWithAbsMaxObserver, fake_quant_abs_max
+
+
+class QuantedWrapper(Layer):
+    """Quantized stand-in: fake-quant input activations and weight, then run
+    the original layer's forward with the quantized weight."""
+
+    def __init__(self, inner, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.act_quanter = act_quanter() if callable(act_quanter) else act_quanter
+        self.weight_quanter = weight_quanter() if callable(weight_quanter) \
+            else weight_quanter
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = getattr(self.inner, "weight", None)
+        if w is not None and self.weight_quanter is not None:
+            orig = w._value
+            try:
+                wq = self.weight_quanter(w)
+                w._value = wq._value
+                return self.inner(x)
+            finally:
+                w._value = orig
+        return self.inner(x)
+
+
+def _name_configs(config: QuantConfig, model: Layer) -> dict:
+    """Resolve id-keyed layer configs to qualified names on the given model."""
+    out = {}
+    if getattr(config, "_layer_configs", None):
+        for name, sub in model.named_sublayers(include_self=True):
+            if id(sub) in config._layer_configs:
+                out[name] = config._layer_configs[id(sub)]
+    return out
+
+
+def _quantizable(layer) -> bool:
+    from ..nn.layer.common import Linear
+    try:
+        from ..nn.layer.conv import Conv2D
+        conv_types = (Conv2D,)
+    except Exception:
+        conv_types = ()
+    return isinstance(layer, (Linear,) + conv_types)
+
+
+class QAT:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        # per-layer configs are keyed by object identity; a deepcopy would
+        # orphan them, so re-key by qualified name against the ORIGINAL model
+        name_cfgs = _name_configs(self.config, model)
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._convert(model, prefix="", name_cfgs=name_cfgs)
+        return model
+
+    def _convert(self, layer: Layer, prefix: str, name_cfgs=None):
+        name_cfgs = name_cfgs or {}
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}{name}"
+            if _quantizable(sub):
+                cfg = name_cfgs.get(full) or self.config.config_for(full, sub)
+                if cfg is not None:
+                    act_q, w_q = cfg
+                    act_q = act_q or FakeQuanterWithAbsMaxObserver
+                    w_q = w_q or (lambda: _WeightQuanter())
+                    layer._sub_layers[name] = QuantedWrapper(sub, act_q, w_q)
+                    setattr(layer, name, layer._sub_layers[name])
+                    continue
+            self._convert(sub, prefix=f"{full}.", name_cfgs=name_cfgs)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Finalize for deployment (fake-quant stays inline; XLA folds it)."""
+        return model if inplace else copy.deepcopy(model)
+
+
+class _WeightQuanter(Layer):
+    def __init__(self, bit_length: int = 8):
+        super().__init__()
+        self.bit_length = bit_length
+
+    def forward(self, w):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        scale = Tensor(jnp.max(jnp.abs(w._value))[None])
+        return fake_quant_abs_max(w, scale, self.bit_length)

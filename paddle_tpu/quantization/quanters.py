@@ -1,0 +1,76 @@
+"""Fake quantizers/observers — analog of paddle/nn/quant/ +
+python/paddle/quantization/observers & quanters.
+
+fake_quant_abs_max uses the straight-through estimator: rounding happens in
+the forward, gradients pass through unchanged (the reference's
+FakeQuantAbsMax op pair).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+
+
+def fake_quant_abs_max(x, scale, bit_length: int = 8):
+    """Quantize-dequantize with STE gradients."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def f(v, s):
+        s = jnp.maximum(s, 1e-9)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+        dq = q * s / qmax
+        # straight-through: forward dq, backward identity wrt v
+        return v + jax.lax.stop_gradient(dq - v)
+    return apply(f, x, scale, op_name="fake_quant_abs_max")
+
+
+class AbsmaxObserver(Layer):
+    """Tracks running abs-max for PTQ calibration."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor as _T
+        self.register_buffer("scale", _T(jnp.full([1], 1e-9, jnp.float32)))
+
+    def forward(self, x):
+        cur = float(jnp.max(jnp.abs(x._value)))
+        prev = float(self.scale._value[0])
+        new = max(cur, 1e-9) if prev <= 1e-9 else \
+            self.moving_rate * prev + (1 - self.moving_rate) * cur
+        self.scale._value = jnp.asarray([new], jnp.float32)
+        return x
+
+    def scales(self):
+        return Tensor(self.scale._value)
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT quanter: observes abs-max (EMA) and fake-quantizes activations."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 dtype="float32", name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor as _T
+        self.register_buffer("scale", _T(jnp.full([1], 1e-9, jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            import jax.numpy as _jnp
+            cur = jnp.max(jnp.abs(x._value))
+            prev = self.scale._value[0]
+            new = jnp.where(prev <= 1e-9, jnp.maximum(cur, 1e-9),
+                            self.moving_rate * prev + (1 - self.moving_rate) * cur)
+            if not isinstance(x._value, jax.core.Tracer):
+                self.scale._value = new[None].astype(jnp.float32)
+        return fake_quant_abs_max(x, Tensor(self.scale._value),
+                                  self.bit_length)

@@ -1,0 +1,220 @@
+"""paddle_tpu.sparse — COO/CSR sparse API.
+
+Analog of python/paddle/sparse/ (sparse_coo_tensor, sparse_csr_tensor,
+to_dense/to_sparse_*, elementwise + matmul ops, sparse nn functional).
+Backed by jax.experimental.sparse.BCOO — on TPU, XLA lowers BCOO matmuls to
+gather/scatter+MXU; for heavily-structured sparsity prefer dense masking
+(see incubate.asp's 2:4 masks).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply
+
+
+class SparseCooTensor(Tensor):
+    """Sparse tensor: holds a BCOO for layout/accessors plus the dense
+    _value the rest of the framework (autograd tape, ops) operates on. On
+    TPU the dense materialization is deliberate — XLA has no sparse memory
+    format, so sparsity is a storage/compute-pattern concern (BCOO matmuls,
+    2:4 masks), not a residency one."""
+    __slots__ = ("_bcoo",)
+
+    def __init__(self, bcoo, stop_gradient=True):
+        self._bcoo = bcoo
+        super().__init__(bcoo.todense(), stop_gradient=stop_gradient)
+
+    # -- paddle sparse API --
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle layout: [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        t = Tensor(self._value, stop_gradient=self.stop_gradient)
+        t._grad_node = self._grad_node  # keep the tape pointer (differentiable)
+        t._out_index = self._out_index
+        return t
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    @property
+    def is_sparse_coo_val(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+
+class SparseCsrTensor(Tensor):
+    __slots__ = ("_crows", "_cols", "_vals", "_dense_shape")
+
+    def __init__(self, crows, cols, values, shape, stop_gradient=True):
+        self._crows = jnp.asarray(crows, jnp.int32)
+        self._cols = jnp.asarray(cols, jnp.int32)
+        self._vals = jnp.asarray(values)
+        self._dense_shape = tuple(shape)
+        dense = _csr_to_dense(self._crows, self._cols, self._vals, self._dense_shape)
+        super().__init__(dense, stop_gradient=stop_gradient)
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._vals)
+
+    def to_dense(self):
+        return Tensor(self._value, stop_gradient=self.stop_gradient)
+
+    def nnz(self):
+        return int(self._vals.shape[0])
+
+    def is_sparse_csr(self):
+        return True
+
+
+def _csr_to_dense(crows, cols, vals, shape):
+    n_rows = shape[0]
+    counts = crows[1:] - crows[:-1]
+    rows = jnp.repeat(jnp.arange(n_rows, dtype=jnp.int32), counts,
+                      total_repeat_length=vals.shape[0])
+    dense = jnp.zeros(shape, vals.dtype)
+    return dense.at[rows, cols].add(vals)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """indices: [ndim, nnz] (paddle layout)."""
+    idx = jnp.asarray(indices._value if isinstance(indices, Tensor) else indices)
+    val = jnp.asarray(values._value if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        val = val.astype(dtype)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    bcoo = jsparse.BCOO((val, idx.T.astype(jnp.int32)), shape=tuple(shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    val = values._value if isinstance(values, Tensor) else values
+    if dtype is not None:
+        val = jnp.asarray(val).astype(dtype)
+    return SparseCsrTensor(
+        crows._value if isinstance(crows, Tensor) else crows,
+        cols._value if isinstance(cols, Tensor) else cols,
+        val, shape, stop_gradient=stop_gradient)
+
+
+def to_sparse_coo(x: Tensor, sparse_dim=None):
+    bcoo = jsparse.BCOO.fromdense(x._value)
+    t = SparseCooTensor(bcoo, stop_gradient=x.stop_gradient)
+    return t
+
+
+def to_dense(x):
+    return x.to_dense() if hasattr(x, "to_dense") else x
+
+
+def _dense_of(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _rewrap(out: Tensor, like):
+    """Re-wrap an op result (a tape-recorded Tensor) as sparse when the lhs
+    was sparse, TRANSPLANTING the grad metadata so backward still works."""
+    if not isinstance(like, SparseCooTensor):
+        return out
+    sp_t = SparseCooTensor.__new__(SparseCooTensor)
+    Tensor.__init__(sp_t, out._value, stop_gradient=out.stop_gradient)
+    sp_t._grad_node = out._grad_node
+    sp_t._out_index = out._out_index
+    if isinstance(out._value, jax.core.Tracer):
+        sp_t._bcoo = like._bcoo  # layout only; values are traced
+    else:
+        sp_t._bcoo = jsparse.BCOO.fromdense(out._value)
+    return sp_t
+
+
+# ---- ops (paddle.sparse.add/multiply/matmul/masked_matmul, relu...) ----
+# All go through ops.dispatch.apply so gradients record on the tape like the
+# reference's differentiable sparse kernels (paddle/phi/kernels/sparse/).
+
+def _elementwise(fn, name, x, y):
+    out = apply(fn, _as_tensor(x), _as_tensor(y), op_name=name)
+    return _rewrap(out, x)
+
+
+def add(x, y):
+    return _elementwise(jnp.add, "sparse_add", x, y)
+
+
+def subtract(x, y):
+    return _elementwise(jnp.subtract, "sparse_subtract", x, y)
+
+
+def multiply(x, y):
+    return _elementwise(jnp.multiply, "sparse_multiply", x, y)
+
+
+def divide(x, y):
+    return _elementwise(jnp.divide, "sparse_divide", x, y)
+
+
+def matmul(x, y):
+    """Sparse @ dense. Uses BCOO dot_general (sparsity in the compute) with
+    the sparsity pattern fixed at the current nse; differentiable."""
+    if isinstance(x, SparseCooTensor) and x._bcoo is not None:
+        nse = int(x._bcoo.nse)
+
+        def f(xd, yd):
+            m = jsparse.bcoo_fromdense(xd, nse=nse)
+            return jsparse.bcoo_dot_general(
+                m, yd, dimension_numbers=(((xd.ndim - 1,), (0,)), ((), ())))
+        return apply(f, _as_tensor(x), _as_tensor(y), op_name="sparse_matmul")
+    return apply(jnp.matmul, _as_tensor(x), _as_tensor(y), op_name="matmul")
+
+
+def masked_matmul(x, y, mask):
+    m = mask if isinstance(mask, SparseCooTensor) else to_sparse_coo(mask)
+    pattern = m.to_dense()._value != 0
+    out = apply(lambda a, b: jnp.where(pattern, a @ b, 0),
+                _as_tensor(x), _as_tensor(y), op_name="sparse_masked_matmul")
+    return _rewrap(out, m)
+
+
+class nn:
+    """paddle.sparse.nn functional subset."""
+
+    @staticmethod
+    def relu(x):
+        out = apply(lambda v: jnp.maximum(v, 0), _as_tensor(x),
+                    op_name="sparse_relu")
+        return _rewrap(out, x)
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        def f(d):
+            mask = d != 0
+            z = jnp.where(mask, d, -jnp.inf)
+            s = jax.nn.softmax(z, axis)
+            return jnp.where(mask, s, 0)
+        out = apply(f, _as_tensor(x), op_name="sparse_softmax")
+        return _rewrap(out, x)

@@ -1,0 +1,9 @@
+"""paddle_tpu.text — analog of python/paddle/text/ (datasets) plus the
+ViterbiDecoder op (paddle.text.viterbi_decode / ViterbiDecoder).
+
+The reference's datasets download corpora at construction; this environment
+has no egress, so dataset classes accept a local `data_file` and raise a
+clear error otherwise (same class/API shape).
+"""
+from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
+from .datasets import Imdb, Conll05st, Movielens, UCIHousing, WMT14, WMT16  # noqa: F401

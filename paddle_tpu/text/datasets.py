@@ -1,0 +1,101 @@
+"""Text datasets — analog of python/paddle/text/datasets/ (Imdb, Conll05st,
+Movielens, UCIHousing, WMT14, WMT16). The reference downloads corpora; this
+environment has no egress, so these accept a pre-downloaded `data_file` and
+otherwise raise with instructions (API/class shape preserved)."""
+from __future__ import annotations
+
+import os
+
+from ..io.dataset import Dataset
+
+
+class _LocalOnlyDataset(Dataset):
+    """Base: requires data_file pointing at a local copy of the corpus."""
+
+    _NAME = "dataset"
+
+    def __init__(self, data_file=None, mode="train", **kw):
+        self.mode = mode
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                f"{type(self).__name__}: the reference implementation downloads "
+                f"the {self._NAME} corpus at construction; this environment has "
+                f"no network egress. Pass data_file=<local path> instead.")
+        self.data_file = data_file
+        self._records = self._load()
+
+    def _load(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self._records)
+
+    def __getitem__(self, idx):
+        return self._records[idx]
+
+
+class Imdb(_LocalOnlyDataset):
+    """IMDB sentiment (aclImdb). data_file: directory with pos/ and neg/."""
+
+    _NAME = "IMDB"
+
+    def _load(self):
+        recs = []
+        base = os.path.join(self.data_file, self.mode)
+        for label, sub in ((1, "pos"), (0, "neg")):
+            d = os.path.join(base, sub)
+            if not os.path.isdir(d):
+                continue
+            for fn in sorted(os.listdir(d)):
+                with open(os.path.join(d, fn), errors="ignore") as f:
+                    recs.append((f.read(), label))
+        if not recs:
+            raise RuntimeError(f"no records under {base}")
+        return recs
+
+
+class UCIHousing(_LocalOnlyDataset):
+    """UCI housing regression. data_file: whitespace-separated table."""
+
+    _NAME = "UCI housing"
+
+    def _load(self):
+        import numpy as np
+        rows = np.loadtxt(self.data_file, dtype=np.float32)
+        split = int(len(rows) * 0.8)
+        rows = rows[:split] if self.mode == "train" else rows[split:]
+        return [(r[:-1], r[-1:]) for r in rows]
+
+
+class Conll05st(_LocalOnlyDataset):
+    _NAME = "CoNLL-2005 SRL"
+
+    def _load(self):
+        with open(self.data_file, errors="ignore") as f:
+            return [line.rstrip("\n").split("\t") for line in f if line.strip()]
+
+
+class Movielens(_LocalOnlyDataset):
+    _NAME = "MovieLens"
+
+    def _load(self):
+        recs = []
+        with open(self.data_file, errors="ignore") as f:
+            for line in f:
+                parts = line.strip().split("::" if "::" in line else ",")
+                if len(parts) >= 3:
+                    recs.append((int(parts[0]), int(parts[1]), float(parts[2])))
+        return recs
+
+
+class WMT14(_LocalOnlyDataset):
+    _NAME = "WMT14 en-fr"
+
+    def _load(self):
+        with open(self.data_file, errors="ignore") as f:
+            return [tuple(line.rstrip("\n").split("\t")[:2]) for line in f
+                    if "\t" in line]
+
+
+class WMT16(WMT14):
+    _NAME = "WMT16 en-de"

@@ -1,0 +1,85 @@
+"""Viterbi decoding — analog of paddle.text.viterbi_decode
+(python/paddle/text/viterbi_decode.py; CRF decode path). lax.scan over time —
+compiled control flow, no host loop."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True):
+    """potentials: [B, T, N] emissions; transition: [N, N]. With
+    include_bos_eos_tag=True (paddle semantics), the LAST ROW of `transition`
+    is the start (BOS->tag) score and the LAST COLUMN the stop (tag->EOS)
+    score. Returns (scores [B], paths [B, T])."""
+
+    def f(emis, trans, lens):
+        B, T, N = emis.shape
+        if include_bos_eos_tag:
+            start = trans[-1, :]
+            stop = trans[:, -1]
+            tr = trans
+        else:
+            start = jnp.zeros(N, emis.dtype)
+            stop = jnp.zeros(N, emis.dtype)
+            tr = trans
+        alpha0 = emis[:, 0] + start[None, :]
+
+        def step(carry, t):
+            alpha, _ = carry
+            # alpha: [B, N]; scores[b, i, j] = alpha[b, i] + tr[i, j]
+            scores = alpha[:, :, None] + tr[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)          # [B, N]
+            alpha_new = jnp.max(scores, axis=1) + emis[:, t]
+            # masked for finished sequences
+            active = (t < lens)[:, None]
+            alpha_new = jnp.where(active, alpha_new, alpha)
+            return (alpha_new, t), best_prev
+
+        (alpha_T, _), backptrs = jax.lax.scan(
+            step, (alpha0, jnp.asarray(0)), jnp.arange(1, T))
+        final = alpha_T + stop[None, :]
+        last_tag = jnp.argmax(final, axis=-1)               # [B]
+        scores = jnp.max(final, axis=-1)
+
+        # backtrack (reverse scan)
+        def back(carry, bp_t):
+            tag, t = carry
+            prev = jnp.take_along_axis(bp_t, tag[:, None], 1)[:, 0]
+            keep = (t < lens - 1)  # only move inside the sequence
+            tag = jnp.where(keep, prev, tag)
+            return (tag, t - 1), tag
+
+        (_, _), tags_rev = jax.lax.scan(
+            back, (last_tag, jnp.asarray(T - 2)), backptrs[::-1])
+        path = jnp.concatenate([tags_rev[::-1], last_tag[None, :]], 0).T
+        return scores, path.astype(jnp.int64)
+
+    pots = potentials if isinstance(potentials, Tensor) else Tensor(potentials)
+    trans = transition_params if isinstance(transition_params, Tensor) \
+        else Tensor(transition_params)
+    B, T, _ = pots.shape
+    if lengths is None:
+        lengths = Tensor(jnp.full((B,), T, jnp.int32))
+    elif not isinstance(lengths, Tensor):
+        lengths = Tensor(jnp.asarray(lengths, jnp.int32))
+    out = apply(f, pots, trans, lengths, op_name="viterbi_decode")
+    return out[0], out[1]
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,365 @@
+"""Domain library tests: distribution, sparse, quantization, audio,
+geometric, text (viterbi), incubate.asp — the SURVEY.md §2.7 domain-lib row."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+
+
+# ---------------- distribution ----------------
+
+def test_distribution_normal_moments_and_grad():
+    from paddle_tpu import distribution as D
+    from scipy import stats
+
+    P.seed(0)
+    n = D.Normal(1.0, 2.0)
+    s = n.sample((4000,))
+    assert abs(float(s.numpy().mean()) - 1.0) < 0.2
+    assert abs(float(s.numpy().std()) - 2.0) < 0.2
+    np.testing.assert_allclose(float(n.log_prob(P.to_tensor(0.3)).numpy()),
+                               stats.norm.logpdf(0.3, 1.0, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(n.entropy().numpy()),
+                               stats.norm.entropy(1.0, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(n.cdf(P.to_tensor(1.5)).numpy()),
+                               stats.norm.cdf(1.5, 1.0, 2.0), rtol=1e-5)
+    # pathwise gradient through rsample
+    mu = P.to_tensor(np.float32(0.5), stop_gradient=False)
+    z = D.Normal(mu, 1.0).rsample((16,))
+    z.sum().backward()
+    np.testing.assert_allclose(float(mu.grad.numpy()), 16.0, rtol=1e-5)
+
+
+@pytest.mark.parametrize("make,logpdf", [
+    (lambda D: (D.Beta(2.0, 3.0), 0.4), lambda s: s.beta.logpdf(0.4, 2, 3)),
+    (lambda D: (D.Gamma(2.0, 3.0), 0.7), lambda s: s.gamma.logpdf(0.7, 2, scale=1 / 3)),
+    (lambda D: (D.Laplace(0.0, 2.0), 1.0), lambda s: s.laplace.logpdf(1.0, 0, 2)),
+    (lambda D: (D.Gumbel(0.0, 1.0), 0.3), lambda s: s.gumbel_r.logpdf(0.3)),
+    (lambda D: (D.Cauchy(0.0, 1.0), 0.3), lambda s: s.cauchy.logpdf(0.3)),
+    (lambda D: (D.StudentT(5.0, 0.0, 1.0), 0.3), lambda s: s.t.logpdf(0.3, 5)),
+    (lambda D: (D.Poisson(3.0), 2.0), lambda s: s.poisson.logpmf(2, 3)),
+    (lambda D: (D.Binomial(10, 0.3), 4.0), lambda s: s.binom.logpmf(4, 10, 0.3)),
+    (lambda D: (D.Exponential(2.0), 0.5), lambda s: s.expon.logpdf(0.5, scale=0.5)),
+    (lambda D: (D.Uniform(0.0, 2.0), 0.5), lambda s: s.uniform.logpdf(0.5, 0, 2)),
+])
+def test_distribution_log_prob_vs_scipy(make, logpdf):
+    from paddle_tpu import distribution as D
+    from scipy import stats
+    dist, at = make(D)
+    np.testing.assert_allclose(float(dist.log_prob(P.to_tensor(at)).numpy()),
+                               logpdf(stats), rtol=1e-4)
+
+
+def test_distribution_kl_and_transform():
+    from paddle_tpu import distribution as D
+    from scipy import stats
+    kl = D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0))
+    np.testing.assert_allclose(float(kl.numpy()),
+                               np.log(2) + 2 / 8 - 0.5, rtol=1e-5)
+    td = D.TransformedDistribution(D.Normal(0.0, 1.0), [D.ExpTransform()])
+    np.testing.assert_allclose(float(td.log_prob(P.to_tensor(1.5)).numpy()),
+                               stats.lognorm.logpdf(1.5, 1.0), rtol=1e-5)
+    # chain: affine(exp(x)) still invertible
+    ch = D.ChainTransform([D.ExpTransform(), D.AffineTransform(1.0, 2.0)])
+    x = P.to_tensor(np.float32(0.3))
+    y = ch.forward(x)
+    np.testing.assert_allclose(float(ch.inverse(y).numpy()), 0.3, rtol=1e-5)
+
+
+def test_distribution_categorical_dirichlet_mvn():
+    from paddle_tpu import distribution as D
+    from scipy import stats
+    c = D.Categorical(P.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+    np.testing.assert_allclose(float(c.log_prob(P.to_tensor(2)).numpy()),
+                               np.log(0.5), rtol=1e-5)
+    assert c.sample((100,)).shape == [100]
+    d = D.Dirichlet(P.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+    np.testing.assert_allclose(
+        float(d.log_prob(P.to_tensor(np.array([0.2, 0.3, 0.5], np.float32))).numpy()),
+        stats.dirichlet.logpdf([0.2, 0.3, 0.5], [1, 2, 3]), rtol=1e-5)
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    mvn = D.MultivariateNormal(P.to_tensor(np.zeros(2, np.float32)),
+                               covariance_matrix=P.to_tensor(cov))
+    np.testing.assert_allclose(
+        float(mvn.log_prob(P.to_tensor(np.array([0.3, -0.2], np.float32))).numpy()),
+        stats.multivariate_normal.logpdf([0.3, -0.2], np.zeros(2), cov),
+        rtol=1e-5)
+
+
+# ---------------- sparse ----------------
+
+def test_sparse_coo_roundtrip_and_matmul():
+    import paddle_tpu.sparse as sp
+    dense = np.array([[0, 1.0, 0], [2.0, 0, 3.0]], np.float32)
+    indices = np.array([[0, 1, 1], [1, 0, 2]])
+    values = np.array([1.0, 2.0, 3.0], np.float32)
+    t = sp.sparse_coo_tensor(indices, values, [2, 3])
+    np.testing.assert_array_equal(t.to_dense().numpy(), dense)
+    assert t.nnz() == 3
+    np.testing.assert_array_equal(t.indices().numpy(), indices)
+
+    y = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out = sp.matmul(t, P.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5)
+
+    t2 = sp.to_sparse_coo(P.to_tensor(dense))
+    np.testing.assert_array_equal(t2.to_dense().numpy(), dense)
+    r = sp.nn.relu(sp.add(t, t))
+    np.testing.assert_array_equal(r.to_dense().numpy(), np.maximum(dense * 2, 0))
+
+
+def test_sparse_csr():
+    import paddle_tpu.sparse as sp
+    crows = [0, 1, 3]
+    cols = [1, 0, 2]
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    t = sp.sparse_csr_tensor(crows, cols, vals, [2, 3])
+    dense = np.array([[0, 1.0, 0], [2.0, 0, 3.0]], np.float32)
+    np.testing.assert_array_equal(t.to_dense().numpy(), dense)
+    assert t.nnz() == 3
+
+
+# ---------------- quantization ----------------
+
+def test_qat_fake_quant_close_and_trainable():
+    from paddle_tpu.quantization import (FakeQuanterWithAbsMaxObserver, QAT,
+                                         QuantConfig)
+
+    P.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    q = QAT(QuantConfig(activation=FakeQuanterWithAbsMaxObserver, weight=None))
+    qmodel = q.quantize(model)
+    x = P.randn([4, 8])
+    y_fp = model(x)
+    y_q = qmodel(x)
+    assert np.allclose(y_fp.numpy(), y_q.numpy(), atol=0.35), \
+        np.abs(y_fp.numpy() - y_q.numpy()).max()
+    # gradients flow through STE
+    loss = (y_q ** 2).sum()
+    loss.backward()
+    inner = qmodel[0].inner
+    assert inner.weight.grad is not None
+
+
+def test_ptq_calibrate_freeze():
+    from paddle_tpu.quantization import AbsmaxObserver, PTQ, QuantConfig
+
+    model = nn.Sequential(nn.Linear(8, 8))
+    p = PTQ(QuantConfig(activation=AbsmaxObserver, weight=None))
+    qm = p.quantize(model)
+    for _ in range(4):
+        qm(P.randn([16, 8]))
+    final = p.convert(qm)
+    x = P.randn([4, 8])
+    out = final(x)
+    assert out.shape == [4, 8]
+    scale = float(final[0].observer.scale._value[0])
+    assert scale > 0.5  # calibrated from randn abs-max
+
+
+# ---------------- audio ----------------
+
+def test_audio_features_shapes_and_mel():
+    from paddle_tpu.audio import features, functional as AF
+
+    sr, n_fft = 16000, 256
+    t = np.linspace(0, 1, sr, dtype=np.float32)
+    sig = P.to_tensor(np.sin(2 * np.pi * 440 * t)[None, :])
+    spec = features.Spectrogram(n_fft=n_fft)(sig)
+    assert spec.shape[1] == 1 + n_fft // 2
+    # peak bin at 440Hz
+    peak = int(np.argmax(spec.numpy()[0].mean(-1)))
+    assert abs(peak - round(440 * n_fft / sr)) <= 1
+    mel = features.MelSpectrogram(sr=sr, n_fft=n_fft, n_mels=32)(sig)
+    assert mel.shape[1] == 32
+    mfcc = features.MFCC(sr=sr, n_mfcc=13, n_mels=32, n_fft=n_fft)(sig)
+    assert mfcc.shape[1] == 13
+    # librosa-style mel conversion sanity
+    np.testing.assert_allclose(
+        AF.mel_to_hz(AF.hz_to_mel(1000.0)).numpy(), 1000.0, rtol=1e-4)
+
+
+def test_audio_wav_roundtrip(tmp_path):
+    from paddle_tpu.audio import backends
+    sr = 8000
+    sig = (np.sin(np.linspace(0, 40 * np.pi, sr)) * 0.5).astype(np.float32)
+    path = str(tmp_path / "t.wav")
+    backends.save(path, P.to_tensor(sig[None, :]), sr)
+    loaded, sr2 = backends.load(path)
+    assert sr2 == sr
+    np.testing.assert_allclose(loaded.numpy()[0], sig, atol=1e-3)
+    inf = backends.info(path)
+    assert inf.sample_rate == sr and inf.num_frames == sr
+
+
+# ---------------- geometric ----------------
+
+def test_geometric_segment_and_message_passing():
+    import paddle_tpu.geometric as G
+    x = P.to_tensor(np.array([[1.0], [2.0], [3.0], [4.0]], np.float32))
+    seg = P.to_tensor(np.array([0, 0, 1, 1]))
+    np.testing.assert_array_equal(G.segment_sum(x, seg).numpy(), [[3.0], [7.0]])
+    np.testing.assert_array_equal(G.segment_mean(x, seg).numpy(), [[1.5], [3.5]])
+    np.testing.assert_array_equal(G.segment_max(x, seg).numpy(), [[2.0], [4.0]])
+    np.testing.assert_array_equal(G.segment_min(x, seg).numpy(), [[1.0], [3.0]])
+
+    src = P.to_tensor(np.array([0, 1, 2]))
+    dst = P.to_tensor(np.array([1, 2, 1]))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_array_equal(out.numpy(), [[0.0], [4.0], [2.0], [0.0]])
+    e = P.to_tensor(np.array([[10.0], [20.0], [30.0]], np.float32))
+    out2 = G.send_ue_recv(x, e, src, dst, message_op="add", reduce_op="max")
+    np.testing.assert_array_equal(out2.numpy(),
+                                  [[0.0], [33.0], [22.0], [0.0]])
+    uv = G.send_uv(x, x, src, dst, message_op="mul")
+    np.testing.assert_array_equal(uv.numpy(), [[2.0], [6.0], [6.0]])
+
+
+# ---------------- text / viterbi ----------------
+
+def test_viterbi_decode_matches_bruteforce():
+    from paddle_tpu.text import ViterbiDecoder
+    rng = np.random.RandomState(0)
+    B, T, N = 2, 5, 3
+    emis = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+    scores, paths = dec(P.to_tensor(emis))
+
+    # brute force
+    import itertools
+    for b in range(B):
+        best, best_path = -1e9, None
+        for path in itertools.product(range(N), repeat=T):
+            s = emis[b, 0, path[0]] + sum(
+                trans[path[t - 1], path[t]] + emis[b, t, path[t]]
+                for t in range(1, T))
+            if s > best:
+                best, best_path = s, path
+        np.testing.assert_allclose(float(scores.numpy()[b]), best, rtol=1e-5)
+        assert tuple(paths.numpy()[b]) == best_path
+
+
+def test_text_dataset_requires_local_file(tmp_path):
+    from paddle_tpu.text import UCIHousing
+    with pytest.raises(RuntimeError, match="no network egress"):
+        UCIHousing()
+    f = tmp_path / "housing.data"
+    rows = np.random.RandomState(0).randn(10, 14).astype(np.float32)
+    np.savetxt(f, rows)
+    ds = UCIHousing(data_file=str(f), mode="train")
+    x, y = ds[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+# ---------------- asp ----------------
+
+def test_asp_2in4_prune_and_decorate():
+    from paddle_tpu.incubate import asp
+
+    P.seed(0)
+    model = nn.Linear(16, 8)
+    masks = asp.prune_model(model)
+    assert masks, "no weights pruned"
+    assert asp.check_sparsity(model.weight)
+    np.testing.assert_allclose(asp.calculate_density(model.weight), 0.5,
+                               atol=0.01)
+
+    opt = asp.decorate(P.optimizer.SGD(learning_rate=0.1,
+                                       parameters=model.parameters()))
+    x = P.randn([4, 16])
+    (model(x) ** 2).sum().backward()
+    opt.step()
+    # sparsity preserved after the update
+    assert asp.check_sparsity(model.weight)
+    asp.reset_excluded_layers()
+
+
+def test_sparse_ops_differentiable():
+    """Regression: sparse ops must record on the autograd tape."""
+    import paddle_tpu.sparse as sp
+    dense = np.array([[0, 1.0], [2.0, 0]], np.float32)
+    t = sp.to_sparse_coo(P.to_tensor(dense))
+    w = P.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    out = sp.matmul(t, w)
+    out.sum().backward()
+    assert w.grad is not None
+    # d(sum(t @ w))/dw = t^T @ ones: column sums of t
+    np.testing.assert_allclose(w.grad.numpy(),
+                               np.array([[2.0, 2.0], [1.0, 1.0]]), rtol=1e-5)
+    r = sp.nn.relu(sp.multiply(t, t))
+    assert r.is_sparse_coo()
+    x2 = P.to_tensor(dense, stop_gradient=False)
+    out2 = sp.add(sp.to_sparse_coo(P.to_tensor(dense)), x2)
+    out2.to_dense().sum().backward()
+    assert x2.grad is not None
+
+
+def test_viterbi_bos_eos_rows():
+    """include_bos_eos_tag=True uses last row as start, last col as stop."""
+    from paddle_tpu.text import viterbi_decode
+    N, T = 3, 4
+    rng = np.random.RandomState(1)
+    emis = rng.randn(1, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    sc, path = viterbi_decode(P.to_tensor(emis), P.to_tensor(trans),
+                              include_bos_eos_tag=True)
+    import itertools
+    best, best_path = -1e9, None
+    for p in itertools.product(range(N), repeat=T):
+        s = trans[-1, p[0]] + emis[0, 0, p[0]] + sum(
+            trans[p[t - 1], p[t]] + emis[0, t, p[t]] for t in range(1, T))
+        s += trans[p[-1], -1]
+        if s > best:
+            best, best_path = s, p
+    np.testing.assert_allclose(float(sc.numpy()[0]), best, rtol=1e-5)
+    assert tuple(path.numpy()[0]) == best_path
+
+
+def test_qat_layer_config_survives_deepcopy():
+    """Regression: add_layer_config keyed by identity must survive the
+    default non-inplace quantize."""
+    from paddle_tpu.quantization import (FakeQuanterWithAbsMaxObserver, QAT,
+                                         QuantConfig)
+    from paddle_tpu.quantization.qat import QuantedWrapper
+
+    model = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    cfg = QuantConfig()
+    cfg.add_layer_config(model[0], activation=FakeQuanterWithAbsMaxObserver,
+                         weight=None)
+    qm = QAT(cfg).quantize(model)  # inplace=False deepcopy
+    assert isinstance(qm[0], QuantedWrapper)
+    assert not isinstance(qm[1], QuantedWrapper)
+
+
+def test_audio_8bit_wav(tmp_path):
+    from paddle_tpu.audio import backends
+    sr = 4000
+    sig = (np.sin(np.linspace(0, 20 * np.pi, sr)) * 0.5).astype(np.float32)
+    path = str(tmp_path / "t8.wav")
+    backends.save(path, P.to_tensor(sig[None, :]), sr, bits_per_sample=8)
+    loaded, _ = backends.load(path)
+    np.testing.assert_allclose(loaded.numpy()[0], sig, atol=0.02)
+
+
+def test_segment_ops_under_jit_require_num_segments():
+    import jax
+    import paddle_tpu.geometric as G
+
+    x = P.to_tensor(np.ones((4, 2), np.float32))
+    ids = P.to_tensor(np.array([0, 0, 1, 1]))
+
+    @jax.jit
+    def f(v, i):
+        return G.segment_sum(P.Tensor(v), P.Tensor(i), num_segments=2)._value
+
+    out = f(x._value, ids._value)
+    np.testing.assert_array_equal(np.asarray(out), [[2.0, 2.0], [2.0, 2.0]])
+
+    @jax.jit
+    def g(v, i):
+        return G.segment_sum(P.Tensor(v), P.Tensor(i))._value
+
+    with pytest.raises(ValueError, match="num_segments"):
+        g(x._value, ids._value)
