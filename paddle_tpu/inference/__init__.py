@@ -1,0 +1,163 @@
+"""paddle_tpu.inference — deployment predictor.
+
+Analog of paddle.inference (AnalysisPredictor,
+paddle/fluid/inference/api/analysis_predictor.h:94): Config + create_predictor
+over a jit.save'd artifact (.pdmodel = serialized StableHLO program,
+.pdiparams = weights). The graph-pass pipeline of the reference is XLA's
+compilation; the predictor pre-places weights on device, exposes the
+zero-copy handle API (get_input_handle/copy_from_cpu/run/copy_to_cpu), and
+clone() shares weights between predictors (multi-thread serving contract).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit import save_load as _sl
+
+
+class Config:
+    """Analog of paddle.inference.Config."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # accept either the jit.save prefix or explicit file paths
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.model_prefix = prog_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._memory_pool_mb = 0
+        self._enable_memory_optim = True
+        self._switch_ir_optim = True
+
+    # -- device selection (XLA owns placement; kept for API parity) --
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        self._device = "tpu"  # accelerator of this build
+        self._device_id = device_id
+        self._memory_pool_mb = memory_pool_init_size_mb
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.model_prefix = prog_file
+
+    def model_dir(self):
+        return self.model_prefix
+
+    def switch_ir_optim(self, on: bool = True):
+        self._switch_ir_optim = on
+
+    def enable_memory_optim(self, on: bool = True):
+        self._enable_memory_optim = on
+
+    def summary(self) -> str:
+        return (f"Config(model={self.model_prefix!r}, device={self._device}:"
+                f"{self._device_id})")
+
+
+class _IOHandle:
+    """Zero-copy tensor handle (analog of ZeroCopyTensor)."""
+
+    def __init__(self, name: str, shape=None, dtype=None):
+        self.name = name
+        self._shape = shape
+        self._dtype = dtype
+        self._value = None
+
+    def reshape(self, shape):
+        self._shape = list(shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = jax.numpy.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def share_external_data(self, tensor):
+        self._value = tensor._value if isinstance(tensor, Tensor) else tensor
+
+    def shape(self):
+        v = self._value
+        return list(v.shape) if v is not None else self._shape
+
+
+class Predictor:
+    def __init__(self, config: Config, _shared=None):
+        self.config = config
+        if _shared is not None:
+            self._layer = _shared
+        else:
+            if config.model_prefix is None:
+                raise ValueError("Config has no model path")
+            self._layer = _sl.load(config.model_prefix)
+        meta = getattr(self._layer, "_meta", {}) or {}
+        n_in = len(meta.get("input_shapes", [])) or 1
+        self._input_names = [f"input_{i}" for i in range(n_in)]
+        self._inputs = {n: _IOHandle(n) for n in self._input_names}
+        self._output_names: List[str] = []
+        self._outputs = {}
+
+    # -- handle API --
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[list] = None):
+        """Execute the program. With `inputs` (list of Tensors/arrays) returns
+        outputs directly (paddle's newer API); otherwise uses the handles."""
+        if inputs is not None:
+            vals = [i if isinstance(i, Tensor) else Tensor(np.asarray(i))
+                    for i in inputs]
+        else:
+            missing = [n for n in self._input_names
+                       if self._inputs[n]._value is None]
+            if missing:
+                raise ValueError(
+                    f"Predictor.run(): input handle(s) {missing} were never "
+                    f"filled — call get_input_handle(name).copy_from_cpu(...) "
+                    f"for each input first")
+            vals = [Tensor(self._inputs[n]._value) for n in self._input_names]
+        out = self._layer(*vals)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        self._outputs = {}
+        for n, o in zip(self._output_names, outs):
+            h = _IOHandle(n)
+            h.share_external_data(o)
+            self._outputs[n] = h
+        if inputs is not None:
+            return outs
+        return True
+
+    def clone(self) -> "Predictor":
+        """Second predictor sharing weights/program (multi-thread serving)."""
+        return Predictor(self.config, _shared=self._layer)
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+__all__ = ["Config", "Predictor", "create_predictor"]

@@ -3,3 +3,11 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaDecoderLayer,
     build_hybrid_train_step,
 )
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification, BertForPretraining,
+    bert_pretraining_loss, ErnieConfig, ErnieModel,
+    ErnieForSequenceClassification,
+)
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
+from .deepfm import DeepFM  # noqa: F401
+from .ocr import DBNet, CRNN, db_loss, ctc_rec_loss  # noqa: F401

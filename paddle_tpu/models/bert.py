@@ -1,0 +1,178 @@
+"""BERT / ERNIE family — BASELINE config "ERNIE/BERT GLUE fine-tune".
+
+Reference parity: PaddleNLP bert/ernie modeling (the reference framework's
+transformer stack: python/paddle/nn/layer/transformer.py drives both).
+TPU-native: one encoder definition; batch rides the 'dp' mesh axis, the
+encoder matmuls pick up 'mp' sharding from the TP layers when a mesh is
+installed; whole fine-tune step compiles via parallel/trainer.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..ops.dispatch import apply
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, intermediate_size=128,
+                 max_position_embeddings=64, type_vocab_size=2)
+        d.update(kw)
+        return cls(**d)
+
+
+# ERNIE shares the architecture; its configs differ (vocab, act).
+ErnieConfig = BertConfig
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(seq)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros_like(input_ids._value))
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, hidden):
+        first = hidden[:, 0]
+        return F.tanh(self.dense(first))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob)
+        self.encoder = TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is None:
+            pad = self.config.pad_token_id
+            attention_mask = apply(
+                lambda ids: jnp.where(ids == pad, -1e9, 0.0)[:, None, None, :],
+                input_ids, op_name="bert_pad_mask")
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq_out = self.encoder(emb, attention_mask)
+        return seq_out, self.pooler(seq_out)
+
+
+class BertForSequenceClassification(Layer):
+    """GLUE fine-tune head (the BASELINE workload)."""
+
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertLMPredictionHead(Layer):
+    def __init__(self, cfg: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.act = cfg.hidden_act
+        # tied [vocab, hidden] weight: stash WITHOUT registering it here (its
+        # canonical state_dict key stays bert.embeddings.word_embeddings.weight)
+        object.__setattr__(self, "_tied", embedding_weights)
+        self.decoder_bias = self.create_parameter([cfg.vocab_size])
+
+    def forward(self, hidden):
+        h = self.layer_norm(getattr(F, self.act)(self.transform(hidden)))
+        w = self._tied
+        return apply(lambda hv, wv, b: hv @ wv.T + b, h, w, self.decoder_bias,
+                     op_name="mlm_logits")
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (BertPretrainingCriterion pairing)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.cls = BertLMPredictionHead(
+            cfg, self.bert.embeddings.word_embeddings.weight)
+        self.nsp = Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids,
+                                    attention_mask=attention_mask)
+        return self.cls(seq_out), self.nsp(pooled)
+
+
+def bert_pretraining_loss(mlm_logits, nsp_logits, masked_labels, nsp_labels,
+                          ignore_index: int = -100):
+    """Analog of BertPretrainingCriterion."""
+    import jax
+
+    def f(ml, nl, mlab, nlab):
+        logp = jax.nn.log_softmax(ml, -1)
+        mask = (mlab != ignore_index)
+        lab = jnp.where(mask, mlab, 0)
+        nll = -jnp.take_along_axis(logp, lab[..., None], -1)[..., 0]
+        mlm = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        nlogp = jax.nn.log_softmax(nl, -1)
+        nsp = -jnp.mean(jnp.take_along_axis(nlogp, nlab[:, None], -1))
+        return mlm + nsp
+    return apply(f, mlm_logits, nsp_logits, masked_labels, nsp_labels,
+                 op_name="bert_pretraining_loss")
+
+
+ErnieModel = BertModel
+ErnieForSequenceClassification = BertForSequenceClassification

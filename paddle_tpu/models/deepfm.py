@@ -1,0 +1,69 @@
+"""DeepFM — BASELINE config "DeepFM CTR". Reference: PaddleRec deepfm
+(reference's PS-based CTR stack, SURVEY.md §2.5/§2.6). BASELINE.json maps the
+parameter-server world to ICI data-parallel allreduce on TPU: embedding tables
+live as ordinary (shardable) parameters; the FM + DNN compute is dense
+einsums that ride the MXU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+
+
+class DeepFM(Layer):
+    """sparse_field_num categorical fields + dense_dim numeric features.
+
+    forward(sparse_ids [B, F], dense [B, D]) -> logits [B, 1]
+    """
+
+    def __init__(self, sparse_feature_number: int, sparse_feature_dim: int = 9,
+                 dense_feature_dim: int = 13, sparse_field_num: int = 26,
+                 layer_sizes=(512, 256, 128)):
+        super().__init__()
+        self.sparse_field_num = sparse_field_num
+        self.dense_feature_dim = dense_feature_dim
+        k = sparse_feature_dim
+        # FM first order: per-feature scalar weight; second order: k-dim factors
+        self.emb_first = Embedding(sparse_feature_number, 1)
+        self.emb_factor = Embedding(sparse_feature_number, k)
+        self.dense_first = Linear(dense_feature_dim, 1)
+        self.dense_factor = Linear(dense_feature_dim, dense_feature_dim * k)
+
+        dnn_in = (sparse_field_num + dense_feature_dim) * k
+        self.dnn = LayerList()
+        sizes = [dnn_in] + list(layer_sizes)
+        for i in range(len(layer_sizes)):
+            self.dnn.append(Linear(sizes[i], sizes[i + 1]))
+        self.dnn_out = Linear(sizes[-1], 1)
+
+    def forward(self, sparse_ids, dense):
+        k = self.emb_factor.weight.shape[1]
+        first_sparse = self.emb_first(sparse_ids)          # [B, F, 1]
+        factors_sparse = self.emb_factor(sparse_ids)       # [B, F, k]
+        first_dense = self.dense_first(dense)              # [B, 1]
+        fd = self.dense_factor(dense)                      # [B, D*k]
+        from ..ops.manip import reshape, concat
+        factors_dense = reshape(fd, [dense.shape[0], self.dense_feature_dim, k])
+
+        factors = concat([factors_sparse, factors_dense], axis=1)  # [B, F+D, k]
+
+        def fm(f1s, f1d, v):
+            # second-order: 0.5 * (sum^2 - sum of squares), summed over k
+            s = jnp.sum(v, axis=1)
+            second = 0.5 * jnp.sum(s * s - jnp.sum(v * v, axis=1), axis=-1,
+                                   keepdims=True)
+            return jnp.sum(f1s, axis=1) + f1d + second
+        fm_out = apply(fm, first_sparse, first_dense, factors, op_name="fm")
+
+        h = reshape(factors, [factors.shape[0], -1])
+        for lin in self.dnn:
+            h = F.relu(lin(h))
+        return fm_out + self.dnn_out(h)
+
+    def predict(self, sparse_ids, dense):
+        return F.sigmoid(self.forward(sparse_ids, dense))

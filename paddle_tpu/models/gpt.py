@@ -1,0 +1,118 @@
+"""GPT family (decoder-only, learned positions) — reference parity with
+PaddleNLP gpt modeling on the same transformer stack as BERT/LLaMA.
+Greedy/temperature `generate` runs each step through the jit-able forward.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..core import generator as gen
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..ops.dispatch import apply
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, intermediate_size=128,
+                 max_position_embeddings=64)
+        d.update(kw)
+        return cls(**d)
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.config = cfg
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        layer = TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation="gelu",
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            normalize_before=True)
+        self.decoder = TransformerEncoder(layer, cfg.num_hidden_layers)
+        self.final_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None):
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(seq)[None, :])
+        h = self.dropout(self.word_embeddings(input_ids)
+                         + self.position_embeddings(position_ids))
+        from ..nn.layer.transformer import Transformer
+        causal = Transformer.generate_square_subsequent_mask(seq)
+        causal = Tensor(causal._value[None, None])
+        h = self.decoder(h, causal)
+        return self.final_norm(h)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids, position_ids=None, labels=None):
+        h = self.gpt(input_ids, position_ids)
+        # tied output head: read through self.gpt so the weight keeps its
+        # canonical state_dict key (gpt.word_embeddings.weight)
+        logits = apply(lambda hv, wv: hv @ wv.T, h,
+                       self.gpt.word_embeddings.weight, op_name="gpt_logits")
+        if labels is None:
+            return logits
+        loss = apply(
+            lambda lg, lab: -jnp.mean(jnp.take_along_axis(
+                jax.nn.log_softmax(lg[:, :-1], -1),
+                lab[:, 1:, None], -1)),
+            logits, labels, op_name="gpt_lm_loss")
+        return logits, loss
+
+    def generate(self, input_ids, max_new_tokens: int = 16,
+                 temperature: float = 0.0, top_k: int = 0):
+        """Greedy (temperature=0) or sampled decoding."""
+        ids = input_ids
+        from ..autograd.grad_mode import no_grad
+        from ..ops.manip import concat
+        with no_grad():
+            for _ in range(max_new_tokens):
+                window = ids if ids.shape[1] <= self.gpt.config.max_position_embeddings \
+                    else ids[:, -self.gpt.config.max_position_embeddings:]
+                logits = self.forward(window)
+                nxt_logits = logits[:, -1]
+                if temperature <= 0:
+                    nxt = apply(lambda lv: jnp.argmax(lv, -1)[:, None],
+                                nxt_logits, op_name="greedy_pick")
+                else:
+                    key = gen.next_key()
+
+                    def pick(lv):
+                        lv = lv / temperature
+                        if top_k:
+                            kth = jnp.sort(lv, -1)[:, -top_k][:, None]
+                            lv = jnp.where(lv < kth, -jnp.inf, lv)
+                        return jax.random.categorical(key, lv)[:, None]
+                    nxt = apply(pick, nxt_logits, op_name="sample_pick")
+                ids = concat([ids, nxt], axis=1)
+        return ids

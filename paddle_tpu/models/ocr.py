@@ -1,0 +1,149 @@
+"""PP-OCR-style detection + recognition — BASELINE config "PP-OCRv4".
+
+Reference: PaddleOCR det_db + rec_crnn (built on the reference framework's
+conv/bn/lstm/ctc stack). Minimal but trainable TPU-native versions:
+- DBNet: light conv backbone + FPN-ish neck + DB head (probability map,
+  threshold map, approximate binary map).
+- CRNN: conv feature extractor -> BiLSTM encoder -> CTC head, paired with
+  nn.functional.ctc_loss.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer.common import Linear
+from ..nn.layer.container import LayerList, Sequential
+from ..nn.layer.conv import Conv2D, Conv2DTranspose
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import BatchNorm2D
+from ..nn.layer.pooling import MaxPool2D
+from ..nn.layer.rnn import LSTM
+from ..ops.dispatch import apply
+
+
+def jax_image_resize(v, hw):
+    """Nearest upsample of NCHW maps to spatial size hw (handles levels whose
+    strides don't divide evenly, e.g. inputs not a multiple of 32)."""
+    import jax
+    return jax.image.resize(v, v.shape[:2] + tuple(hw), method="nearest")
+
+
+def _conv_bn(cin, cout, stride=1, k=3):
+    return Sequential(
+        Conv2D(cin, cout, k, stride=stride, padding=k // 2),
+        BatchNorm2D(cout),
+    )
+
+
+class _Backbone(Layer):
+    """4-stage conv backbone, strides 4/8/16/32."""
+
+    def __init__(self, cin=3, base=16):
+        super().__init__()
+        self.stem = _conv_bn(cin, base, stride=2)
+        self.stages = LayerList([
+            _conv_bn(base, base * 2, stride=2),
+            _conv_bn(base * 2, base * 4, stride=2),
+            _conv_bn(base * 4, base * 8, stride=2),
+        ])
+
+    def forward(self, x):
+        x = F.relu(self.stem(x))
+        feats = []
+        for s in self.stages:
+            x = F.relu(s(x))
+            feats.append(x)
+        return feats  # strides 4, 8, 16 (relative to stem) — 3 levels
+
+
+class DBNet(Layer):
+    """Differentiable Binarization detector (det_db)."""
+
+    def __init__(self, in_channels=3, base=16, k=50.0):
+        super().__init__()
+        self.k = k
+        self.backbone = _Backbone(in_channels, base)
+        chans = [base * 2, base * 4, base * 8]
+        neck_c = base * 4
+        self.lateral = LayerList([Conv2D(c, neck_c, 1) for c in chans])
+        self.prob_head = Sequential(
+            Conv2D(neck_c, neck_c // 2, 3, padding=1),
+            BatchNorm2D(neck_c // 2),
+        )
+        self.prob_out = Conv2DTranspose(neck_c // 2, 1, 4, stride=4)
+        self.thresh_head = Sequential(
+            Conv2D(neck_c, neck_c // 2, 3, padding=1),
+            BatchNorm2D(neck_c // 2),
+        )
+        self.thresh_out = Conv2DTranspose(neck_c // 2, 1, 4, stride=4)
+
+    def forward(self, x):
+        feats = self.backbone(x)
+        # top-down: upsample deeper levels to the finest and sum
+        mapped = [lat(f) for lat, f in zip(self.lateral, feats)]
+        target_hw = mapped[0].shape[2:]
+        merged = mapped[0]
+        for m in mapped[1:]:
+            merged = merged + apply(
+                lambda v, hw=tuple(target_hw): jax_image_resize(v, hw),
+                m, op_name="fpn_upsample")
+        prob = F.sigmoid(self.prob_out(F.relu(self.prob_head(merged))))
+        thresh = F.sigmoid(self.thresh_out(F.relu(self.thresh_head(merged))))
+        # approximate binary map (DB): 1/(1+exp(-k(P-T)))
+        binary = apply(lambda p, t: 1.0 / (1.0 + jnp.exp(-self.k * (p - t))),
+                       prob, thresh, op_name="db_binarize")
+        return {"maps": prob, "thresh": thresh, "binary": binary}
+
+
+def db_loss(out, gt_prob, gt_thresh=None, alpha=5.0, beta=10.0):
+    """BCE on prob/binary + L1 on threshold (simplified DBLoss)."""
+    prob, binary = out["maps"], out["binary"]
+    lp = F.binary_cross_entropy(prob, gt_prob)
+    lb = F.binary_cross_entropy(binary, gt_prob)
+    loss = lp * alpha + lb
+    if gt_thresh is not None:
+        loss = loss + beta * F.l1_loss(out["thresh"], gt_thresh)
+    return loss
+
+
+class CRNN(Layer):
+    """conv -> BiLSTM -> CTC logits (rec_crnn)."""
+
+    def __init__(self, in_channels=3, num_classes=63, hidden=96, base=16):
+        super().__init__()
+        self.conv = Sequential(
+            Conv2D(in_channels, base, 3, padding=1), BatchNorm2D(base),
+        )
+        self.pool1 = MaxPool2D(2, 2)
+        self.conv2 = Sequential(
+            Conv2D(base, base * 2, 3, padding=1), BatchNorm2D(base * 2),
+        )
+        self.pool2 = MaxPool2D(2, 2)
+        self.rnn = LSTM(base * 2 * 8, hidden, direction="bidirect")
+        self.fc = Linear(hidden * 2, num_classes)
+
+    def forward(self, x):
+        """x: [B, C, 32, W] -> logits [B, W//4, num_classes]."""
+        h = self.pool1(F.relu(self.conv(x)))
+        h = self.pool2(F.relu(self.conv2(h)))          # [B, C', 8, W//4]
+        from ..ops.manip import reshape, transpose
+        b, c, hh, w = h.shape
+        h = transpose(h, [0, 3, 1, 2])                 # [B, W, C', H]
+        h = reshape(h, [b, w, c * hh])
+        out, _ = self.rnn(h)
+        return self.fc(out)
+
+
+def ctc_rec_loss(logits, labels, label_lengths, blank: int = 0):
+    """CTC loss over CRNN logits ([B, T, C])."""
+    T = logits.shape[1]
+    from ..core.tensor import Tensor
+    input_lengths = Tensor(jnp.full((logits.shape[0],), T, jnp.int32))
+    log_probs = apply(lambda lv: jnp.transpose(lv, (1, 0, 2)), logits,
+                      op_name="to_time_major")
+    import jax
+    log_probs = apply(lambda lv: jax.nn.log_softmax(lv, -1), log_probs,
+                      op_name="log_softmax")
+    return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                      blank=blank)

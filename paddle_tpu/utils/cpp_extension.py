@@ -1,0 +1,223 @@
+"""Custom C++ op extension — analog of paddle.utils.cpp_extension
+(cpp_extension.py:79 setup, :799 JIT load; C++ side PD_BUILD_OP,
+paddle/phi/api/ext/op_meta_info.h:831).
+
+TPU-native contract: device compute belongs in Pallas/JAX, so custom C++ ops
+are HOST ops. A user .cc exports flat C functions over float buffers:
+
+    extern "C" void my_op(const float* x, float* y, int64_t n);      // map
+    extern "C" void my_op_grad(const float* x, const float* gy,
+                               float* gx, int64_t n);                // vjp
+
+`load(name, sources)` compiles with g++ (no pybind11 in the image — ctypes
+binds the C ABI) and returns a module-like object whose ops are registered as
+framework ops: they run under `jit` via jax.pure_callback (host callback, the
+TPU analog of a CPU kernel) and differentiate when `<op>_grad` is exported.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply
+
+
+class CppExtensionError(RuntimeError):
+    pass
+
+
+def _compile(name: str, sources: Sequence[str], extra_cxx_cflags=(),
+             extra_ldflags=(), build_directory: Optional[str] = None,
+             verbose: bool = False) -> str:
+    import hashlib
+    import tempfile
+    build_dir = build_directory or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions", name)
+    os.makedirs(build_dir, exist_ok=True)
+    srcs = [os.path.abspath(s) for s in sources]
+    # flags participate in the cache key so changed flags rebuild
+    tag = hashlib.sha1(("\0".join(list(extra_cxx_cflags) + list(extra_ldflags))
+                        ).encode()).hexdigest()[:8]
+    so_path = os.path.join(build_dir, f"lib{name}-{tag}.so")
+    newest = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(so_path) and os.path.getmtime(so_path) >= newest:
+        return so_path
+    # per-process temp output -> atomic publish (safe under parallel builds)
+    fd, tmp_out = tempfile.mkstemp(suffix=".so", dir=build_dir)
+    os.close(fd)
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+           *extra_cxx_cflags, *srcs, "-o", tmp_out, *extra_ldflags]
+    if verbose:
+        print("[cpp_extension]", " ".join(cmd))
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise CppExtensionError(
+                f"compiling {name} failed:\n{proc.stderr[-4000:]}")
+        os.replace(tmp_out, so_path)
+    finally:
+        if os.path.exists(tmp_out):
+            os.remove(tmp_out)
+    return so_path
+
+
+class _CustomOp:
+    """A loaded C op: y = f(x) elementwise-shaped (y same shape as x)."""
+
+    def __init__(self, lib: ctypes.CDLL, name: str):
+        self._name = name
+        self._fn = getattr(lib, name)
+        self._fn.restype = None
+        self._fn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                             ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        self._grad_fn = None
+        grad_name = name + "_grad"
+        if hasattr(lib, grad_name):
+            g = getattr(lib, grad_name)
+            g.restype = None
+            g.argtypes = [ctypes.POINTER(ctypes.c_float),
+                          ctypes.POINTER(ctypes.c_float),
+                          ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+            self._grad_fn = g
+
+    # host implementations over numpy
+    def _host(self, x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        y = np.empty_like(x)
+        self._fn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                 y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size)
+        return y
+
+    def _host_grad(self, x: np.ndarray, gy: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        gy = np.ascontiguousarray(gy, np.float32)
+        gx = np.empty_like(x)
+        self._grad_fn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      gy.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      gx.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      x.size)
+        return gx
+
+    def _jax_fn(self):
+        host = self._host
+        host_grad = self._host_grad
+        name = self._name
+
+        @jax.custom_vjp
+        def f(x):
+            return jax.pure_callback(
+                lambda v: host(np.asarray(v)),
+                jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                x.astype(jnp.float32), vmap_method="sequential")
+
+        def fwd(x):
+            return f(x), x
+
+        def bwd(x, gy):
+            if self._grad_fn is None:
+                raise CppExtensionError(
+                    f"custom op {name!r} has no {name}_grad — not differentiable")
+            gx = jax.pure_callback(
+                lambda v, g: host_grad(np.asarray(v), np.asarray(g)),
+                jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                x.astype(jnp.float32), gy.astype(jnp.float32),
+                vmap_method="sequential")
+            return (gx,)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    def __call__(self, x):
+        t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        if isinstance(t._value, jax.core.Tracer):
+            # traced path: host callback primitive (works on CPU backends;
+            # TPU runtimes without host send/recv reject it at run time)
+            return apply(self._jax_fn(), t, op_name=f"custom:{self._name}")
+        # eager path: run the C kernel directly on host memory and record a
+        # tape node by hand (no callback primitive involved, so it works on
+        # every backend)
+        from ..autograd.grad_mode import is_grad_enabled
+        from ..ops.dispatch import GradNode
+        x_np = np.asarray(t._value, np.float32)
+        y = jnp.asarray(self._host(x_np))
+        out = Tensor(y)
+        if not t.stop_gradient and is_grad_enabled():
+            host_grad = self._host_grad
+            name = self._name
+            has_grad = self._grad_fn is not None
+
+            def vjp_fn(ct):
+                # error only if backward actually reaches this op
+                if not has_grad:
+                    raise CppExtensionError(
+                        f"custom op {name!r} has no {name}_grad — "
+                        "not differentiable")
+                return (jnp.asarray(host_grad(x_np, np.asarray(ct, np.float32))),)
+
+            node = GradNode(vjp_fn, [t], [(y.shape, y.dtype)], False,
+                            f"custom:{self._name}")
+            out._grad_node = node
+            out._out_index = 0
+            out.stop_gradient = False
+        return out
+
+
+class CustomOpModule:
+    """What `load` returns: ops as attributes (paddle returns a module with
+    the registered ops as functions)."""
+
+    def __init__(self, name: str, so_path: str):
+        self.__name__ = name
+        self._so_path = so_path
+        self._lib = ctypes.CDLL(so_path)
+        self._ops = {}
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        if item not in self._ops:
+            try:
+                self._ops[item] = _CustomOp(self._lib, item)
+            except AttributeError:
+                raise AttributeError(
+                    f"extension {self.__name__!r} exports no symbol {item!r}")
+        return self._ops[item]
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_cflags=(),
+         extra_cuda_cflags=(), extra_ldflags=(), build_directory=None,
+         verbose: bool = False) -> CustomOpModule:
+    """JIT-compile user C++ sources and expose their ops (analog of
+    paddle.utils.cpp_extension.load; CUDA flags accepted and ignored — device
+    code belongs in Pallas on this backend)."""
+    so = _compile(name, sources, extra_cxx_cflags, extra_ldflags,
+                  build_directory, verbose)
+    return CustomOpModule(name, so)
+
+
+def setup(name: str, ext_modules=None, **kw):
+    """setuptools-style build (cpp_extension.py:79). Compiles eagerly and
+    returns the module; packaging into a wheel is out of scope here."""
+    sources = []
+    for ext in (ext_modules or []):
+        sources.extend(getattr(ext, "sources", []))
+    if not sources:
+        raise ValueError("setup() needs ext_modules with sources")
+    return load(name, sources, **{k: v for k, v in kw.items()
+                                  if k in ("extra_cxx_cflags", "extra_ldflags",
+                                           "build_directory", "verbose")})
+
+
+class CppExtension:
+    def __init__(self, sources, **kw):
+        self.sources = list(sources)
+
+
+CUDAExtension = CppExtension  # CUDA sources are rejected at compile time

@@ -1,0 +1,157 @@
+"""Inference predictor + custom C++ op extension tests
+(SURVEY.md §2.8 AnalysisPredictor and §2.7 cpp_extension rows)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+
+
+def test_predictor_roundtrip(tmp_path):
+    from paddle_tpu import inference
+    from paddle_tpu.jit.api import InputSpec
+
+    P.seed(0)
+    model = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 2))
+    model.eval()
+    x = P.randn([4, 8])
+    expect = model(x).numpy()
+
+    prefix = str(tmp_path / "deploy" / "model")
+    P.jit.save(model, prefix, input_spec=[InputSpec([None, 8], "float32")])
+
+    cfg = inference.Config(prefix)
+    pred = inference.create_predictor(cfg)
+    # handle API
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x.numpy())
+    assert pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, expect, rtol=2e-2, atol=1e-4)
+    # direct API + different batch size (symbolic batch dim)
+    out2 = pred.run([P.randn([7, 8])])
+    assert out2[0].shape == [7, 2]
+    # clone shares the program
+    p2 = pred.clone()
+    out3 = p2.run([x])
+    np.testing.assert_allclose(out3[0].numpy(), expect, rtol=2e-2, atol=1e-4)
+
+
+CPP_SOURCE = r"""
+#include <cstdint>
+#include <cmath>
+
+extern "C" void swishish(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] / (1.0f + std::exp(-x[i]));
+}
+
+extern "C" void swishish_grad(const float* x, const float* gy, float* gx,
+                              int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    float s = 1.0f / (1.0f + std::exp(-x[i]));
+    gx[i] = gy[i] * (s + x[i] * s * (1.0f - s));
+  }
+}
+
+extern "C" void clip01(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i)
+    y[i] = x[i] < 0.f ? 0.f : (x[i] > 1.f ? 1.f : x[i]);
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    from paddle_tpu.utils import cpp_extension
+    d = tmp_path_factory.mktemp("cppext")
+    src = d / "ops.cc"
+    src.write_text(CPP_SOURCE)
+    return cpp_extension.load("my_ops", [str(src)],
+                              build_directory=str(d / "build"))
+
+
+def test_custom_op_forward(ext):
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+    out = ext.swishish(P.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), x / (1 + np.exp(-x)), rtol=1e-6)
+    out2 = ext.clip01(P.to_tensor(x))
+    np.testing.assert_allclose(out2.numpy(), np.clip(x, 0, 1), rtol=1e-6)
+
+
+def test_custom_op_gradient(ext):
+    x = P.to_tensor(np.linspace(-2, 2, 9).astype(np.float32),
+                    stop_gradient=False)
+    y = ext.swishish(x)
+    y.sum().backward()
+    xv = x.numpy()
+    s = 1 / (1 + np.exp(-xv))
+    np.testing.assert_allclose(x.grad.numpy(), s + xv * s * (1 - s), rtol=1e-5)
+
+
+def test_custom_op_under_jit(ext):
+    import jax
+
+    @jax.jit
+    def f(v):
+        return ext.swishish(P.Tensor(v))._value
+
+    x = np.linspace(-1, 1, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(f(x)), x / (1 + np.exp(-x)),
+                               rtol=1e-6)
+
+
+def test_custom_op_in_model(ext):
+    """Custom op as an activation inside a trained model."""
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(4, 16)
+            self.l2 = nn.Linear(16, 1)
+
+        def forward(self, x):
+            return self.l2(ext.swishish(self.l1(x)))
+
+    P.seed(0)
+    net = Net()
+    opt = P.optimizer.AdamW(learning_rate=0.02, parameters=net.parameters())
+    x, y = P.randn([32, 4]), P.randn([32, 1])
+    first = last = None
+    for _ in range(25):
+        loss = nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(loss.numpy())
+        first = first if first is not None else v
+        last = v
+    assert last < first * 0.7, (first, last)
+
+
+def test_missing_symbol_raises(ext):
+    with pytest.raises(AttributeError, match="no symbol"):
+        ext.does_not_exist
+
+
+def test_gradless_op_forward_ok_backward_raises(ext):
+    """Regression: a grad-less op must run forward on grad-requiring input;
+    only backward through it raises."""
+    from paddle_tpu.utils.cpp_extension import CppExtensionError
+    x = P.to_tensor(np.array([0.5, -0.5], np.float32), stop_gradient=False)
+    y = ext.clip01(x)  # forward must not raise
+    with pytest.raises(CppExtensionError, match="clip01_grad"):
+        y.sum().backward()
+
+
+def test_predictor_unfilled_handle_error(tmp_path):
+    from paddle_tpu import inference
+    from paddle_tpu.jit.api import InputSpec
+    model = nn.Linear(4, 2)
+    model.eval()
+    prefix = str(tmp_path / "m")
+    P.jit.save(model, prefix, input_spec=[InputSpec([None, 4], "float32")])
+    pred = inference.create_predictor(inference.Config(prefix))
+    with pytest.raises(ValueError, match="never\\s+filled"):
+        pred.run()

@@ -1,0 +1,169 @@
+"""Model-family tests: BERT/ERNIE (GLUE path), GPT, DeepFM, OCR det+rec —
+the BASELINE workload configs beyond LLaMA/ResNet."""
+import numpy as np
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.models import (CRNN, BertConfig, BertForPretraining,
+                               BertForSequenceClassification, DBNet, DeepFM,
+                               GPTConfig, GPTForCausalLM, bert_pretraining_loss,
+                               ctc_rec_loss, db_loss)
+
+
+def _ids(rng, b, s, vocab):
+    return P.to_tensor(rng.randint(0, vocab, (b, s)))
+
+
+def test_bert_sequence_classification_trains():
+    P.seed(0)
+    rng = np.random.RandomState(0)
+    cfg = BertConfig.tiny()
+    model = BertForSequenceClassification(cfg, num_classes=2)
+    opt = P.optimizer.AdamW(learning_rate=5e-3, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    ids = _ids(rng, 8, 16, cfg.vocab_size)
+    # learnable rule: label = parity of first token
+    labels = P.to_tensor((rng.randint(0, 2, (8,))).astype(np.int64))
+    first = last = None
+    for _ in range(30):
+        logits = model(ids)
+        loss = loss_fn(logits, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(loss.numpy())
+        first = first if first is not None else v
+        last = v
+    assert last < first * 0.5, (first, last)
+
+
+def test_bert_pretraining_heads():
+    P.seed(0)
+    rng = np.random.RandomState(0)
+    cfg = BertConfig.tiny()
+    model = BertForPretraining(cfg)
+    ids = _ids(rng, 4, 12, cfg.vocab_size)
+    mlm_logits, nsp_logits = model(ids)
+    assert mlm_logits.shape == [4, 12, cfg.vocab_size]
+    assert nsp_logits.shape == [4, 2]
+    masked = np.full((4, 12), -100, np.int64)
+    masked[:, 3] = rng.randint(0, cfg.vocab_size, 4)
+    loss = bert_pretraining_loss(mlm_logits, nsp_logits,
+                                 P.to_tensor(masked),
+                                 P.to_tensor(rng.randint(0, 2, (4,))))
+    loss.backward()
+    assert model.bert.embeddings.word_embeddings.weight.grad is not None
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_gpt_lm_trains_and_generates():
+    P.seed(0)
+    rng = np.random.RandomState(0)
+    cfg = GPTConfig.tiny()
+    model = GPTForCausalLM(cfg)
+    opt = P.optimizer.AdamW(learning_rate=5e-3, parameters=model.parameters())
+    # repeatable sequence task
+    ids = P.to_tensor(np.tile(np.arange(16) % 8, (4, 1)))
+    first = last = None
+    for _ in range(40):
+        _, loss = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(loss.numpy())
+        first = first if first is not None else v
+        last = v
+    assert last < first * 0.5, (first, last)
+    model.eval()
+    out = model.generate(P.to_tensor(np.arange(8)[None, :] % 8),
+                         max_new_tokens=4)
+    assert out.shape == [1, 12]
+    # after training on the cyclic pattern, continuation should follow it
+    sampled = model.generate(P.to_tensor(np.arange(8)[None, :] % 8),
+                             max_new_tokens=4, temperature=1.0, top_k=2)
+    assert sampled.shape == [1, 12]
+
+
+def test_deepfm_trains_on_synthetic_ctr():
+    P.seed(0)
+    rng = np.random.RandomState(0)
+    model = DeepFM(sparse_feature_number=100, sparse_feature_dim=8,
+                   dense_feature_dim=4, sparse_field_num=6,
+                   layer_sizes=(32, 16))
+    opt = P.optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    B = 64
+    sparse = rng.randint(0, 100, (B, 6))
+    dense = rng.randn(B, 4).astype(np.float32)
+    y = ((sparse[:, 0] % 2) ^ (dense[:, 0] > 0)).astype(np.float32)[:, None]
+    sp_t, de_t, y_t = P.to_tensor(sparse), P.to_tensor(dense), P.to_tensor(y)
+    first = last = None
+    for _ in range(60):
+        logits = model(sp_t, de_t)
+        loss = nn.functional.binary_cross_entropy_with_logits(logits, y_t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(loss.numpy())
+        first = first if first is not None else v
+        last = v
+    assert last < first * 0.7, (first, last)
+    probs = model.predict(sp_t, de_t)
+    assert probs.shape == [B, 1]
+    assert 0.0 <= float(probs.numpy().min()) and float(probs.numpy().max()) <= 1.0
+
+
+def test_dbnet_det_forward_and_loss():
+    P.seed(0)
+    model = DBNet(in_channels=3, base=8)
+    x = P.randn([2, 3, 64, 64])
+    out = model(x)
+    assert out["maps"].shape == out["binary"].shape
+    assert out["maps"].shape[0] == 2 and out["maps"].shape[1] == 1
+    gt = P.to_tensor(np.random.RandomState(0).rand(
+        *out["maps"].shape).astype(np.float32))
+    loss = db_loss(out, gt, gt)
+    loss.backward()
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_crnn_rec_ctc_trains():
+    P.seed(0)
+    rng = np.random.RandomState(0)
+    model = CRNN(in_channels=1, num_classes=12, hidden=32, base=8)
+    opt = P.optimizer.AdamW(learning_rate=5e-3, parameters=model.parameters())
+    x = P.to_tensor(rng.randn(2, 1, 32, 64).astype(np.float32))
+    labels = P.to_tensor(rng.randint(1, 12, (2, 4)))
+    label_lens = P.to_tensor(np.array([4, 3], np.int32))
+    first = last = None
+    for _ in range(15):
+        logits = model(x)  # [B, 16, 12]
+        assert logits.shape[1] == 16
+        loss = ctc_rec_loss(logits, labels, label_lens)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(loss.numpy())
+        first = first if first is not None else v
+        last = v
+    assert last < first, (first, last)
+
+
+def test_gpt_state_dict_keys_canonical():
+    """Regression: tied output head must not shadow the embedding weight."""
+    from paddle_tpu.models import GPTModel
+    cfg = GPTConfig.tiny()
+    lm = GPTForCausalLM(cfg)
+    keys = set(lm.state_dict().keys())
+    assert "gpt.word_embeddings.weight" in keys
+    assert "_tied" not in keys
+    # checkpoint interchanges with the bare GPTModel
+    base = GPTModel(cfg)
+    base_keys = {"gpt." + k for k in base.state_dict().keys()}
+    assert base_keys <= keys
+
+
+def test_dbnet_non_multiple_of_32_input():
+    """Regression: FPN upsample must handle sizes where strides don't divide."""
+    model = DBNet(in_channels=3, base=8)
+    out = model(P.randn([1, 3, 72, 72]))
+    assert out["maps"].shape[0] == 1
