@@ -25,11 +25,12 @@ def main():
                       num_hidden_layers=8, num_attention_heads=16,
                       max_position_embeddings=1024)
     seq = 1024
-    batch = 8
+    batch = 16
 
     model = LlamaForCausalLM(cfg)
     opt = P.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
-    step = build_hybrid_train_step(model, opt, n_microbatches=1, remat=True)
+    step = build_hybrid_train_step(model, opt, n_microbatches=1, remat=True,
+                                   amp=True)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
@@ -40,24 +41,23 @@ def main():
     last = {}
 
     def run_blocked(n):
-        """Run n steps and force REAL completion by fetching data that
-        depends on the last step's updates (block_until_ready on relayed
-        buffers can return early in this environment)."""
+        """Run n steps and force REAL completion by fetching a scalar that
+        depends on the last step's parameter updates (block_until_ready on
+        relayed buffers can return early in this environment; a 4-byte
+        dependent fetch cannot)."""
         t0 = time.perf_counter()
         for _ in range(n):
             loss = step(b)
         last["loss"] = float(loss.numpy())
         leaf = _jax.tree_util.tree_leaves(step.state["params"])[0]
-        _ = np.asarray(leaf)[:1]
+        _ = float(leaf[(0,) * leaf.ndim])  # device-side index, tiny transfer
         return time.perf_counter() - t0
 
     # warmup (compile + steady state)
     run_blocked(3)
 
-    # two-point measurement cancels fixed per-fetch overhead
-    t_small = min(run_blocked(5), run_blocked(5))
-    t_large = min(run_blocked(25), run_blocked(25))
-    dt = (t_large - t_small) / 20
+    n_steps = 30
+    dt = min(run_blocked(n_steps), run_blocked(n_steps)) / n_steps
 
     tokens_per_sec = batch * seq / dt
 

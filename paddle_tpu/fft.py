@@ -1,0 +1,87 @@
+"""paddle_tpu.fft — analog of python/paddle/fft.py (~20 spectral functions).
+
+All map to jnp.fft (XLA's FFT HLO on TPU); they dispatch through the tape so
+forward/inverse transforms differentiate like any other op.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops.dispatch import apply
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(f"norm must be 'backward'/'ortho'/'forward', got {norm!r}")
+    return norm
+
+
+def _mk1d(jfn, name):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply(lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)), x,
+                     op_name=name)
+    op.__name__ = name
+    return op
+
+
+def _mk2d(jfn, name):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_=None):
+        return apply(lambda v: jfn(v, s=s, axes=tuple(axes), norm=_norm(norm)),
+                     x, op_name=name)
+    op.__name__ = name
+    return op
+
+
+def _mkn(jfn, name):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        ax = tuple(axes) if axes is not None else None
+        return apply(lambda v: jfn(v, s=s, axes=ax, norm=_norm(norm)), x,
+                     op_name=name)
+    op.__name__ = name
+    return op
+
+
+fft = _mk1d(jnp.fft.fft, "fft")
+ifft = _mk1d(jnp.fft.ifft, "ifft")
+rfft = _mk1d(jnp.fft.rfft, "rfft")
+irfft = _mk1d(jnp.fft.irfft, "irfft")
+hfft = _mk1d(jnp.fft.hfft, "hfft")
+ihfft = _mk1d(jnp.fft.ihfft, "ihfft")
+
+fft2 = _mk2d(jnp.fft.fft2, "fft2")
+ifft2 = _mk2d(jnp.fft.ifft2, "ifft2")
+rfft2 = _mk2d(jnp.fft.rfft2, "rfft2")
+irfft2 = _mk2d(jnp.fft.irfft2, "irfft2")
+fftn = _mkn(jnp.fft.fftn, "fftn")
+ifftn = _mkn(jnp.fft.ifftn, "ifftn")
+rfftn = _mkn(jnp.fft.rfftn, "rfftn")
+irfftn = _mkn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def fftshift(x, axes=None):
+    return apply(lambda v: jnp.fft.fftshift(v, axes=axes), x, op_name="fftshift")
+
+
+def ifftshift(x, axes=None):
+    return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), x,
+                 op_name="ifftshift")

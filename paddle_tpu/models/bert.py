@@ -90,7 +90,8 @@ class BertModel(Layer):
         enc_layer = TransformerEncoderLayer(
             cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
             dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
-            attn_dropout=cfg.attention_probs_dropout_prob)
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            layer_norm_eps=cfg.layer_norm_eps)
         self.encoder = TransformerEncoder(enc_layer, cfg.num_hidden_layers)
         self.pooler = BertPooler(cfg)
 

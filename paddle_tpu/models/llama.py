@@ -250,7 +250,8 @@ def _call_with_params(layer, names, vals, fn):
 
 
 def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
-                            n_microbatches: int = 1, remat: bool = True):
+                            n_microbatches: int = 1, remat: bool = True,
+                            amp: bool = False):
     """Build a fully-compiled hybrid train step.
 
     The decoder blocks' params are stacked on a leading dim of size L and
@@ -314,13 +315,25 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
             for p, v in zip(outer_params, saved):
                 p._value = v
 
+    def _amp_cast(tree):
+        """bf16 compute with fp32 master params: the cast is differentiable,
+        so grads flow back to (and optimizer states stay in) fp32."""
+        return jax.tree_util.tree_map(
+            lambda v: v.astype(jnp.bfloat16)
+            if jnp.issubdtype(v.dtype, jnp.floating) else v, tree)
+
     def loss_fn(params, batch, rng):
         outer_vals, stacked_vals = params
+        if amp:
+            outer_vals = _amp_cast(outer_vals)
+            stacked_vals = _amp_cast(stacked_vals)
         ids, labels = batch["input_ids"], batch["labels"]
 
         with gen.key_override(rng), no_grad():
             def run():
                 x = model.llama.embed_tokens(Tensor(ids))._value
+                if amp:
+                    x = x.astype(jnp.bfloat16)
                 x = mesh_mod.shard_constraint(x, "dp", None, None)
                 if pp > 1:
                     b, s, h = x.shape
@@ -335,6 +348,8 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                     x2 = blocks_scan(stacked_vals, x)
                 h_out = model.llama.norm(Tensor(x2))
                 logits = model.lm_head(h_out)
+                if amp:  # softmax/CE in fp32 for numeric stability
+                    logits = Tensor(logits._value.astype(jnp.float32))
                 loss = F.cross_entropy(logits, Tensor(labels), reduction="mean")
                 return loss._value
             return outer_apply(outer_vals, run)

@@ -22,13 +22,6 @@ from ..nn.layer.rnn import LSTM
 from ..ops.dispatch import apply
 
 
-def jax_image_resize(v, hw):
-    """Nearest upsample of NCHW maps to spatial size hw (handles levels whose
-    strides don't divide evenly, e.g. inputs not a multiple of 32)."""
-    import jax
-    return jax.image.resize(v, v.shape[:2] + tuple(hw), method="nearest")
-
-
 def _conv_bn(cin, cout, stride=1, k=3):
     return Sequential(
         Conv2D(cin, cout, k, stride=stride, padding=k // 2),
@@ -85,9 +78,10 @@ class DBNet(Layer):
         target_hw = mapped[0].shape[2:]
         merged = mapped[0]
         for m in mapped[1:]:
-            merged = merged + apply(
-                lambda v, hw=tuple(target_hw): jax_image_resize(v, hw),
-                m, op_name="fpn_upsample")
+            # nearest upsample to the finest level (robust to sizes where
+            # strides don't divide evenly)
+            merged = merged + F.interpolate(m, size=tuple(target_hw),
+                                            mode="nearest")
         prob = F.sigmoid(self.prob_out(F.relu(self.prob_head(merged))))
         thresh = F.sigmoid(self.thresh_out(F.relu(self.thresh_head(merged))))
         # approximate binary map (DB): 1/(1+exp(-k(P-T)))

@@ -59,15 +59,15 @@ class MultiHeadAttention(Layer):
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1, activation="relu",
                  attn_dropout=None, act_dropout=None, normalize_before=False,
-                 weight_attr=None, bias_attr=None):
+                 weight_attr=None, bias_attr=None, layer_norm_eps=1e-5):
         super().__init__()
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(d_model, nhead,
                                             attn_dropout if attn_dropout is not None else dropout)
         self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
         self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
-        self.norm1 = LayerNorm(d_model)
-        self.norm2 = LayerNorm(d_model)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
         self.dropout1 = Dropout(dropout)
         self.dropout2 = Dropout(dropout)
         self.dropout_act = Dropout(act_dropout if act_dropout is not None else dropout)

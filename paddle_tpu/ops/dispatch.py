@@ -69,8 +69,11 @@ def _is_diff_tensor(a) -> bool:
 
 def _wrap_outputs(raw, op_name):
     if isinstance(raw, (tuple, list)):
-        return type(raw)(Tensor(r) if isinstance(r, (jax.Array, jax.core.Tracer)) else r
-                         for r in raw), True
+        items = [Tensor(r) if isinstance(r, (jax.Array, jax.core.Tracer)) else r
+                 for r in raw]
+        if hasattr(raw, "_fields"):  # namedtuple (e.g. jnp SVDResult/EighResult)
+            return type(raw)(*items), True
+        return type(raw)(items), True
     return Tensor(raw), False
 
 

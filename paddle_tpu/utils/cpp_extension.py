@@ -42,9 +42,10 @@ def _compile(name: str, sources: Sequence[str], extra_cxx_cflags=(),
         os.path.expanduser("~"), ".cache", "paddle_tpu_extensions", name)
     os.makedirs(build_dir, exist_ok=True)
     srcs = [os.path.abspath(s) for s in sources]
-    # flags participate in the cache key so changed flags rebuild
-    tag = hashlib.sha1(("\0".join(list(extra_cxx_cflags) + list(extra_ldflags))
-                        ).encode()).hexdigest()[:8]
+    # flags AND source paths participate in the cache key so a same-named
+    # extension built from different sources/flags rebuilds
+    key = "\0".join(list(extra_cxx_cflags) + list(extra_ldflags) + srcs)
+    tag = hashlib.sha1(key.encode()).hexdigest()[:8]
     so_path = os.path.join(build_dir, f"lib{name}-{tag}.so")
     newest = max(os.path.getmtime(s) for s in srcs)
     if os.path.exists(so_path) and os.path.getmtime(so_path) >= newest:
@@ -112,10 +113,13 @@ class _CustomOp:
 
         @jax.custom_vjp
         def f(x):
-            return jax.pure_callback(
+            out = jax.pure_callback(
                 lambda v: host(np.asarray(v)),
                 jax.ShapeDtypeStruct(x.shape, jnp.float32),
                 x.astype(jnp.float32), vmap_method="sequential")
+            # kernels compute in f32 (the C ABI contract) but the op must
+            # preserve the caller's dtype like every built-in op
+            return out.astype(x.dtype)
 
         def fwd(x):
             return f(x), x
@@ -129,7 +133,7 @@ class _CustomOp:
                 jax.ShapeDtypeStruct(x.shape, jnp.float32),
                 x.astype(jnp.float32), gy.astype(jnp.float32),
                 vmap_method="sequential")
-            return (gx,)
+            return (gx.astype(x.dtype),)
 
         f.defvjp(fwd, bwd)
         return f
@@ -146,12 +150,14 @@ class _CustomOp:
         from ..autograd.grad_mode import is_grad_enabled
         from ..ops.dispatch import GradNode
         x_np = np.asarray(t._value, np.float32)
-        y = jnp.asarray(self._host(x_np))
+        y = jnp.asarray(self._host(x_np)).astype(t._value.dtype)
         out = Tensor(y)
         if not t.stop_gradient and is_grad_enabled():
             host_grad = self._host_grad
             name = self._name
             has_grad = self._grad_fn is not None
+
+            in_dtype = t._value.dtype
 
             def vjp_fn(ct):
                 # error only if backward actually reaches this op
@@ -159,7 +165,8 @@ class _CustomOp:
                     raise CppExtensionError(
                         f"custom op {name!r} has no {name}_grad — "
                         "not differentiable")
-                return (jnp.asarray(host_grad(x_np, np.asarray(ct, np.float32))),)
+                gx = host_grad(x_np, np.asarray(ct, np.float32))
+                return (jnp.asarray(gx).astype(in_dtype),)
 
             node = GradNode(vjp_fn, [t], [(y.shape, y.dtype)], False,
                             f"custom:{self._name}")

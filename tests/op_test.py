@@ -1,0 +1,124 @@
+"""OpTest harness — the backbone of the reference's op test strategy
+(test/legacy_test/eager_op_test.py:379, SURVEY.md §4.1): each op is checked
+against a numpy reference in BOTH eager and compiled (jit-traced) modes, and
+gradients are verified numerically (central finite differences) against the
+autograd tape.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as P
+from paddle_tpu.core.tensor import Tensor
+
+
+def _to_tensors(arrays):
+    return [Tensor(jnp.asarray(a)) for a in arrays]
+
+
+def _np_of(out):
+    if isinstance(out, (tuple, list)):
+        return [np.asarray(o.numpy() if isinstance(o, Tensor) else o)
+                for o in out]
+    return [np.asarray(out.numpy() if isinstance(out, Tensor) else out)]
+
+
+class OpTest:
+    """Mixin-style harness. Subclass in a pytest test class or use the module
+    functions directly."""
+
+    rtol = 1e-5
+    atol = 1e-6
+
+    @staticmethod
+    def run_eager(op: Callable, arrays: Sequence[np.ndarray], **kwargs):
+        return _np_of(op(*_to_tensors(arrays), **kwargs))
+
+    @staticmethod
+    def run_compiled(op: Callable, arrays: Sequence[np.ndarray], **kwargs):
+        """Trace the op through jax.jit — the to_static/compiled mode path."""
+        def pure(*vals):
+            out = op(*[Tensor(v) for v in vals], **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._value if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._value if isinstance(out, Tensor) else out
+        out = jax.jit(pure)(*[jnp.asarray(a) for a in arrays])
+        if isinstance(out, tuple):
+            return [np.asarray(o) for o in out]
+        return [np.asarray(out)]
+
+    @classmethod
+    def check_output(cls, op: Callable, arrays: Sequence[np.ndarray],
+                     reference: Callable, rtol=None, atol=None, **kwargs):
+        """Run eager AND compiled; compare both against the numpy reference."""
+        rtol = rtol if rtol is not None else cls.rtol
+        atol = atol if atol is not None else cls.atol
+        expect = reference(*arrays)
+        if not isinstance(expect, (tuple, list)):
+            expect = [expect]
+        expect = [np.asarray(e) for e in expect]
+        for mode, runner in (("eager", cls.run_eager),
+                             ("compiled", cls.run_compiled)):
+            got = runner(op, arrays, **kwargs)
+            assert len(got) == len(expect), \
+                f"{mode}: {len(got)} outputs vs {len(expect)} expected"
+            for g, e in zip(got, expect):
+                np.testing.assert_allclose(
+                    g, e, rtol=rtol, atol=atol,
+                    err_msg=f"[{mode}] op output mismatch vs numpy reference")
+
+    @classmethod
+    def check_grad(cls, op: Callable, arrays: Sequence[np.ndarray],
+                   wrt: Sequence[int] = (0,), eps: float = 1e-3,
+                   rtol: float = 5e-2, atol: float = 1e-3,
+                   output_index: int | None = None, **kwargs):
+        """Numeric-vs-autograd gradient check (the reference's
+        check_grad_with_place finite-difference protocol).
+
+        Scalarizes the op as sum(op(...)) and compares d/d inputs[wrt]."""
+        arrays = [np.asarray(a, np.float64 if np.asarray(a).dtype.kind == "f"
+                             else np.asarray(a).dtype) for a in arrays]
+
+        def scalar(*arrs):
+            out = op(*_to_tensors(arrs), **kwargs)
+            if isinstance(out, (tuple, list)):
+                out = out[output_index if output_index is not None else 0]
+            return out
+
+        # autograd gradients
+        tensors = _to_tensors(arrays)
+        for i in wrt:
+            tensors[i].stop_gradient = False
+        out = op(*tensors, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[output_index if output_index is not None else 0]
+        out.sum().backward()
+        auto_grads = [np.asarray(tensors[i].grad.numpy()) for i in wrt]
+
+        # numeric gradients (central differences)
+        for k, i in enumerate(wrt):
+            base = arrays[i]
+            num = np.zeros_like(base, np.float64)
+            flat = base.reshape(-1)
+            numf = num.reshape(-1)
+            for j in range(flat.size):
+                orig = flat[j]
+                flat[j] = orig + eps
+                up = float(scalar(*arrays).sum().numpy())
+                flat[j] = orig - eps
+                dn = float(scalar(*arrays).sum().numpy())
+                flat[j] = orig
+                numf[j] = (up - dn) / (2 * eps)
+            np.testing.assert_allclose(
+                auto_grads[k], num, rtol=rtol, atol=atol,
+                err_msg=f"gradient mismatch for input {i} "
+                        f"(autograd vs finite differences)")
+
+
+check_output = OpTest.check_output
+check_grad = OpTest.check_grad

@@ -1,0 +1,174 @@
+"""Op battery on the OpTest harness: numpy-reference parity in eager AND
+compiled modes + numeric gradient checks (SURVEY.md §4.1 protocol)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import fft as pfft
+
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(7)
+
+
+# ---------------- output parity: math ----------------
+
+@pytest.mark.parametrize("op,ref,arrs", [
+    (P.add, np.add, [RNG.randn(3, 4).astype(np.float32),
+                     RNG.randn(3, 4).astype(np.float32)]),
+    (P.multiply, np.multiply, [RNG.randn(3, 4).astype(np.float32),
+                               RNG.randn(3, 4).astype(np.float32)]),
+    (P.matmul, np.matmul, [RNG.randn(4, 5).astype(np.float32),
+                           RNG.randn(5, 3).astype(np.float32)]),
+    (P.exp, np.exp, [RNG.randn(6).astype(np.float32)]),
+    (P.log, np.log, [RNG.rand(6).astype(np.float32) + 0.5]),
+    (P.sqrt, np.sqrt, [RNG.rand(6).astype(np.float32) + 0.1]),
+    (P.tanh, np.tanh, [RNG.randn(6).astype(np.float32)]),
+    (P.abs, np.abs, [RNG.randn(6).astype(np.float32)]),
+    (P.floor, np.floor, [RNG.randn(6).astype(np.float32) * 3]),
+    (P.maximum, np.maximum, [RNG.randn(5).astype(np.float32),
+                             RNG.randn(5).astype(np.float32)]),
+])
+def test_math_ops_match_numpy(op, ref, arrs):
+    check_output(op, arrs, ref)
+
+
+def test_reductions_match_numpy():
+    x = RNG.randn(3, 5).astype(np.float32)
+    check_output(lambda t: P.sum(t, axis=1), [x], lambda a: a.sum(1))
+    check_output(lambda t: P.mean(t, axis=0), [x], lambda a: a.mean(0))
+    check_output(lambda t: P.max(t, axis=1), [x], lambda a: a.max(1))
+    check_output(lambda t: P.prod(t, axis=1), [x], lambda a: a.prod(1))
+    check_output(P.logsumexp, [x],
+                 lambda a: np.log(np.exp(a).sum()), rtol=1e-4)
+
+
+def test_einsum_matches_numpy():
+    a = RNG.randn(3, 4).astype(np.float32)
+    b = RNG.randn(4, 5).astype(np.float32)
+    check_output(lambda x, y: P.einsum("ij,jk->ik", x, y), [a, b],
+                 lambda x, y: np.einsum("ij,jk->ik", x, y))
+    c = RNG.randn(2, 3, 4).astype(np.float32)
+    check_output(lambda x: P.einsum("bij->bji", x), [c],
+                 lambda x: np.einsum("bij->bji", x))
+
+
+def test_sort_search_ops():
+    x = RNG.randn(4, 6).astype(np.float32)
+    check_output(lambda t: P.sort(t, axis=1), [x], lambda a: np.sort(a, 1))
+    check_output(lambda t: P.argsort(t, axis=1), [x],
+                 lambda a: np.argsort(a, 1, kind="stable"))
+    check_output(lambda t: P.argmax(t, axis=1), [x], lambda a: a.argmax(1))
+    vals = np.sort(RNG.randn(8).astype(np.float32))
+    q = RNG.randn(5).astype(np.float32)
+    check_output(P.searchsorted, [vals, q], np.searchsorted)
+    check_output(lambda t: P.topk(t, 3, axis=1), [x],
+                 lambda a: (np.sort(a, 1)[:, ::-1][:, :3].copy(),
+                            np.argsort(-a, 1, kind="stable")[:, :3]))
+
+
+def test_manip_ops():
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    check_output(lambda t: P.transpose(t, [2, 0, 1]), [x],
+                 lambda a: a.transpose(2, 0, 1))
+    check_output(lambda t: P.reshape(t, [6, 4]), [x],
+                 lambda a: a.reshape(6, 4))
+    check_output(lambda t: P.split(t, 3, axis=1), [x],
+                 lambda a: tuple(np.split(a, 3, 1)))
+    check_output(lambda t: P.flip(t, axis=[1]), [x],
+                 lambda a: np.flip(a, 1))
+    check_output(lambda t: P.roll(t, 2, axis=2), [x],
+                 lambda a: np.roll(a, 2, 2))
+    check_output(lambda t: P.tile(t, [1, 2, 1]), [x],
+                 lambda a: np.tile(a, (1, 2, 1)))
+    pairs = [RNG.randn(2, 3).astype(np.float32) for _ in range(2)]
+    check_output(lambda a, b: P.concat([a, b], axis=0), pairs,
+                 lambda a, b: np.concatenate([a, b], 0))
+    check_output(lambda a, b: P.stack([a, b], axis=1), pairs,
+                 lambda a, b: np.stack([a, b], 1))
+
+
+def test_linalg_ops():
+    a = RNG.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    b = RNG.randn(4, 2).astype(np.float32)
+    check_output(P.linalg.det, [spd], np.linalg.det, rtol=1e-3)
+    check_output(P.linalg.inv, [spd], np.linalg.inv, rtol=1e-3)
+    check_output(P.linalg.solve, [spd, b], np.linalg.solve, rtol=1e-3)
+    check_output(P.linalg.cholesky, [spd], np.linalg.cholesky, rtol=1e-3)
+    # svd: compare singular values (vectors are sign-ambiguous)
+    check_output(lambda t: P.linalg.svd(t)[1], [a],
+                 lambda m: np.linalg.svd(m)[1], rtol=1e-3)
+    check_output(lambda t: P.linalg.eigvalsh(t), [spd],
+                 lambda m: np.linalg.eigvalsh(m), rtol=1e-3)
+
+
+def test_fft_ops():
+    x = RNG.randn(8).astype(np.float32)
+    check_output(pfft.rfft, [x], np.fft.rfft, rtol=1e-4, atol=1e-4)
+    xc = (RNG.randn(8) + 1j * RNG.randn(8)).astype(np.complex64)
+    check_output(pfft.fft, [xc], np.fft.fft, rtol=1e-4, atol=1e-4)
+    check_output(pfft.ifft, [xc], np.fft.ifft, rtol=1e-4, atol=1e-4)
+    x2 = RNG.randn(4, 6).astype(np.float32)
+    check_output(pfft.fft2, [x2], np.fft.fft2, rtol=1e-4, atol=1e-4)
+    check_output(pfft.fftshift, [x2], np.fft.fftshift)
+    np.testing.assert_allclose(pfft.fftfreq(8, 0.5).numpy(),
+                               np.fft.fftfreq(8, 0.5))
+    # round trip
+    rt = pfft.irfft(pfft.rfft(P.to_tensor(x)), n=8)
+    np.testing.assert_allclose(rt.numpy(), x, atol=1e-5)
+
+
+# ---------------- numeric gradient checks ----------------
+
+def test_grad_unary_ops():
+    x = RNG.rand(3, 3).astype(np.float64) + 0.5
+    check_grad(P.exp, [x])
+    check_grad(P.log, [x])
+    check_grad(P.sqrt, [x])
+    check_grad(P.tanh, [x])
+    check_grad(lambda t: P.sum(t * t), [x])
+
+
+def test_grad_binary_and_matmul():
+    a = RNG.randn(3, 4)
+    b = RNG.randn(4, 2)
+    check_grad(P.matmul, [a, b], wrt=(0, 1))
+    c = RNG.randn(3, 4)
+    check_grad(P.multiply, [a, c], wrt=(0, 1))
+    check_grad(P.divide, [a, np.abs(c) + 1.0], wrt=(0, 1))
+
+
+def test_grad_reductions_and_softmax():
+    x = RNG.randn(4, 5)
+    import paddle_tpu.nn.functional as F
+    check_grad(lambda t: P.mean(t), [x])
+    check_grad(lambda t: P.max(t, axis=1), [x])
+    check_grad(lambda t: F.softmax(t, axis=-1), [x])
+    check_grad(lambda t: F.log_softmax(t, axis=-1), [x])
+
+
+def test_grad_einsum_and_linalg():
+    a = RNG.randn(3, 4)
+    b = RNG.randn(4, 3)
+    check_grad(lambda x, y: P.einsum("ij,jk->ik", x, y), [a, b], wrt=(0, 1))
+    spd = (a @ a.T + 4 * np.eye(3)).astype(np.float64)
+    check_grad(lambda t: P.linalg.det(t), [spd], rtol=8e-2)
+    check_grad(lambda t: P.linalg.inv(t), [spd], rtol=8e-2)
+
+
+def test_grad_fft():
+    x = RNG.randn(8)
+    check_grad(lambda t: P.abs(pfft.rfft(t)), [x], rtol=8e-2)
+
+
+def test_fftn_all_axes_default():
+    """Regression: fftn with no axes must transform ALL axes (paddle/numpy
+    semantics), and axes=None must be accepted."""
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(pfft.fftn(P.to_tensor(x)).numpy(),
+                               np.fft.fftn(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(pfft.fftn(P.to_tensor(x), axes=None).numpy(),
+                               np.fft.fftn(x), rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError, match="norm"):
+        pfft.fft(P.to_tensor(x[0, 0]), norm="orthonormal")
