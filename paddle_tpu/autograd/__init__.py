@@ -1,4 +1,5 @@
 """paddle_tpu.autograd — analog of python/paddle/autograd/."""
 from .backward import backward, grad  # noqa: F401
+from .functional import Hessian, Jacobian, hessian, jacobian, jvp, vhp, vjp  # noqa: F401
 from .grad_mode import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401

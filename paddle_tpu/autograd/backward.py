@@ -3,12 +3,19 @@
 Analog of egr::Backward / RunBackward (paddle/fluid/eager/backward.cc:421,:104):
 queue-driven reverse-topological walk over GradNodes with per-edge pending counts
 and gradient accumulation (GradTensorHolder analog is the `node_cots` map).
+
+Higher-order: with create_graph=True each node's vjp is re-derived as a jax
+function of (cotangents, inputs) and executed through `ops.dispatch.apply`, so
+the gradient computation itself lands on the tape (grad-of-grad nodes) — the
+analog of the reference's double-grad machinery
+(python/paddle/incubate/autograd/functional.py, eager double-grad nodes).
 """
 from __future__ import annotations
 
 from collections import defaultdict, deque
 from typing import List, Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..core import dtype as dtypes
@@ -19,14 +26,64 @@ def _accumulate(slot, grad):
     return grad if slot is None else slot + grad
 
 
+def _differentiable_vjp(node, cots):
+    """Run node's vjp through apply() so the grads are tape-recorded Tensors.
+
+    `cots` is a list of cotangent Tensors (one per node output). Returns a
+    tuple of Tensor grads, one per node.inputs entry.
+    """
+    from ..ops import dispatch
+
+    if node.recompute is None:
+        raise RuntimeError(
+            f"GradNode {node.op_name!r} was recorded without recompute info; "
+            "cannot build a higher-order graph through it")
+    jax_fn, vals, diff_idx, static_kwargs = node.recompute
+    ncot = len(node.out_avals)
+    multi = node.multi_output
+
+    def vjp_op(*arrs):
+        cot_vals = arrs[:ncot]
+        diff_vals = arrs[ncot:]
+
+        def f(*dv):
+            vv = list(vals)
+            for k, i in enumerate(diff_idx):
+                vv[i] = dv[k]
+            return jax_fn(*vv, **static_kwargs)
+
+        _, vjp = jax.vjp(f, *diff_vals)
+        return tuple(vjp(tuple(cot_vals) if multi else cot_vals[0]))
+
+    out = dispatch.apply(vjp_op, *cots, *node.inputs,
+                         op_name=node.op_name + "_grad")
+    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
 def backward(tensors: List[Tensor], grad_tensors: Optional[List[Optional[Tensor]]] = None,
-             retain_graph: bool = False):
+             retain_graph: bool = False, create_graph: bool = False):
+    if create_graph:
+        retain_graph = True
     roots = [t for t in tensors if isinstance(t, Tensor)]
     if grad_tensors is None:
         grad_tensors = [None] * len(roots)
 
-    # seed cotangents
+    # Cotangents are raw jax arrays in first-order mode and Tensors in
+    # create_graph mode (so accumulation `a + b` is itself tape-recorded).
     node_cots = {}   # node -> [cot per output]
+
+    def lift(g):
+        return Tensor(g) if create_graph and not isinstance(g, Tensor) else g
+
+    def assign_grad(t, g):
+        """Accumulate g into t.grad, preserving the tape in create_graph mode."""
+        if create_graph:
+            prev = t.grad
+            t.grad = g if prev is None else prev + (g if isinstance(g, Tensor) else Tensor(g))
+        else:
+            gv = g._value if isinstance(g, Tensor) else g
+            prev = t.grad._value if t.grad is not None else None
+            t.grad = Tensor(_accumulate(prev, gv))
 
     def seed(t, g):
         if g is None:
@@ -34,14 +91,16 @@ def backward(tensors: List[Tensor], grad_tensors: Optional[List[Optional[Tensor]
                 raise RuntimeError(
                     "grad must be provided for non-scalar tensor in backward()")
             g = jnp.ones(t._value.shape, t._value.dtype)
+        elif isinstance(g, Tensor):
+            g = g if create_graph else g._value
         else:
-            g = g._value if isinstance(g, Tensor) else jnp.asarray(g, t._value.dtype)
+            g = jnp.asarray(g, t._value.dtype)
+        g = lift(g)
         node = t._grad_node
         if node is None:
             # root is itself a leaf
             if not t.stop_gradient:
-                prev = t.grad._value if t.grad is not None else None
-                t.grad = Tensor(_accumulate(prev, g))
+                assign_grad(t, g)
             return
         cots = node_cots.setdefault(node, [None] * len(node.out_avals))
         cots[t._out_index] = _accumulate(cots[t._out_index], g)
@@ -81,27 +140,30 @@ def backward(tensors: List[Tensor], grad_tensors: Optional[List[Optional[Tensor]
         for c, aval in zip(cots, node.out_avals):
             if c is None:
                 shape, dt = aval
-                c = jnp.zeros(shape, dt)
+                c = lift(jnp.zeros(shape, dt))
             full.append(c)
-        cot_arg = tuple(full) if node.multi_output else full[0]
-        in_grads = node.vjp_fn(cot_arg)
+
+        if create_graph:
+            in_grads = _differentiable_vjp(node, full)
+        else:
+            cot_arg = tuple(full) if node.multi_output else full[0]
+            in_grads = node.vjp_fn(cot_arg)
 
         for inp, g in zip(node.inputs, in_grads):
             if g is None or inp.stop_gradient:
                 continue
             # fire user hooks on the flowing gradient
             if inp._backward_hooks:
-                gt = Tensor(g)
+                gt = g if isinstance(g, Tensor) else Tensor(g)
                 for hook in inp._backward_hooks:
                     r = hook(gt)
                     if r is not None:
                         gt = r if isinstance(r, Tensor) else Tensor(r)
-                g = gt._value
+                g = gt if create_graph else gt._value
             parent = inp._grad_node
             if parent is None or inp._retain_grads:
                 if not inp.stop_gradient:
-                    prev = inp.grad._value if inp.grad is not None else None
-                    inp.grad = Tensor(_accumulate(prev, g))
+                    assign_grad(inp, g)
             if parent is not None:
                 cots = node_cots.setdefault(parent, [None] * len(parent.out_avals))
                 cots[inp._out_index] = _accumulate(cots[inp._out_index], g)
@@ -112,6 +174,7 @@ def backward(tensors: List[Tensor], grad_tensors: Optional[List[Optional[Tensor]
         if not retain_graph:
             node.vjp_fn = None
             node.inputs = []
+            node.recompute = None
 
     if not retain_graph:
         for t in roots:
@@ -122,12 +185,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
          allow_unused=False):
     """Functional gradient — analog of paddle.grad (python/paddle/autograd).
 
-    Note: create_graph (higher-order) is not supported by the eager tape yet; use
-    the traced path (paddle_tpu.jit) + jax.grad composition for higher-order AD.
+    With create_graph=True the returned grads are themselves on the tape, so
+    grad-of-grad (double backward) works in eager mode.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.jit traced autograd for higher-order")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
 
@@ -137,7 +197,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
         t.grad = None
         t._retain_grads = True
     try:
-        backward(list(outputs), grad_outputs, retain_graph=retain_graph)
+        backward(list(outputs), grad_outputs, retain_graph=retain_graph,
+                 create_graph=create_graph)
         results = []
         for t in inputs:
             if t.grad is None and not allow_unused:

@@ -1,0 +1,216 @@
+"""Functional autograd transforms — jvp/vjp/jacobian/hessian.
+
+Analog of python/paddle/incubate/autograd/functional.py (jvp/vjp/Jacobian/
+Hessian). TPU-native design: instead of double-backward program rewrites, the
+user function (Tensor -> Tensor, built from paddle_tpu ops, all of which are
+jax-traceable) is lifted to a jax-level function and differentiated with
+jax.jvp / jax.vjp / jax.jacfwd / jax.jacrev — forward- and reverse-mode AD come
+from the same tracer, and the results compile under jit unchanged.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .grad_mode import no_grad
+
+__all__ = ["jvp", "vjp", "jacobian", "hessian", "Jacobian", "Hessian", "vhp"]
+
+
+def _as_tuple(xs):
+    return tuple(xs) if isinstance(xs, (list, tuple)) else (xs,)
+
+
+def _unwrap(t):
+    return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _wrap(v):
+    if isinstance(v, (tuple, list)):
+        return type(v)(_wrap(x) for x in v)
+    return Tensor(v)
+
+
+def _lift(func: Callable):
+    """Lift a Tensor->Tensor(s) function to arrays->arrays for jax transforms."""
+
+    def jf(*arrs):
+        with no_grad():
+            out = func(*[Tensor(a) for a in arrs])
+        if isinstance(out, (tuple, list)):
+            return tuple(_unwrap(o) for o in out)
+        return _unwrap(out)
+
+    return jf
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode Jacobian-vector product.
+
+    Returns (func(xs), J @ v). With v=None, uses all-ones tangents (matching
+    the reference's default, incubate/autograd/functional.py jvp).
+    """
+    xs_t = _as_tuple(xs)
+    arrs = tuple(_unwrap(x) for x in xs_t)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        tangents = tuple(_unwrap(t) for t in _as_tuple(v))
+    primals, tangents_out = jax.jvp(_lift(func), arrs, tangents)
+    return _wrap(primals), _wrap(tangents_out)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode vector-Jacobian product.
+
+    Returns (func(xs), v^T @ J) as Tensors. With v=None, uses all-ones
+    cotangents.
+    """
+    xs_t = _as_tuple(xs)
+    arrs = tuple(_unwrap(x) for x in xs_t)
+    primals, vjp_fn = jax.vjp(_lift(func), *arrs)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, primals)
+    else:
+        v_t = _as_tuple(v)
+        cot = (tuple(_unwrap(t) for t in v_t)
+               if isinstance(primals, tuple) else _unwrap(v_t[0]))
+    grads = vjp_fn(cot)
+    grads = grads[0] if len(grads) == 1 and not isinstance(xs, (list, tuple)) else grads
+    return _wrap(primals), _wrap(grads)
+
+
+def jacobian(func: Callable, xs, create_graph: bool = False):
+    """Dense Jacobian of func at xs (reverse-mode).
+
+    Single input + single output: a Tensor of shape out_shape + in_shape.
+    Multiple inputs: a tuple over inputs; multiple outputs: a tuple over
+    outputs (of per-input tuples when xs is a list).
+    """
+    xs_t = _as_tuple(xs)
+    arrs = tuple(_unwrap(x) for x in xs_t)
+    jf = _lift(func)
+    multi_out = isinstance(jax.eval_shape(jf, *arrs), tuple)
+    jac = jax.jacrev(jf, argnums=tuple(range(len(arrs))))(*arrs)
+    # jacrev nests: (outputs...) of (argnums...); drop the argnums level
+    # when xs was a single tensor
+    if not isinstance(xs, (list, tuple)):
+        jac = tuple(j[0] for j in jac) if multi_out else jac[0]
+    return _wrap(jac)
+
+
+def hessian(func: Callable, xs, create_graph: bool = False):
+    """Dense Hessian of a scalar-output func at xs (forward-over-reverse)."""
+    xs_t = _as_tuple(xs)
+    arrs = tuple(_unwrap(x) for x in xs_t)
+
+    jf = _lift(func)
+
+    def scalar_f(*a):
+        out = jf(*a)
+        out = out[0] if isinstance(out, tuple) else out
+        return jnp.reshape(out, ())
+
+    hess = jax.hessian(scalar_f, argnums=tuple(range(len(arrs))))(*arrs)
+    if not isinstance(xs, (list, tuple)):
+        hess = hess[0][0]
+    return _wrap(hess)
+
+
+def vhp(func: Callable, xs, v=None):
+    """Vector-Hessian product of a scalar-output func: returns (func(xs), v^T H)."""
+    xs_t = _as_tuple(xs)
+    arrs = tuple(_unwrap(x) for x in xs_t)
+    jf = _lift(func)
+
+    def scalar_f(*a):
+        out = jf(*a)
+        out = out[0] if isinstance(out, tuple) else out
+        return jnp.reshape(out, ())
+
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        tangents = tuple(_unwrap(t) for t in _as_tuple(v))
+
+    grad_f = jax.grad(scalar_f, argnums=tuple(range(len(arrs))))
+    primal_out = scalar_f(*arrs)
+    _, hvp = jax.jvp(lambda *a: grad_f(*a), arrs, tangents)
+    if not isinstance(xs, (list, tuple)):
+        hvp = hvp[0]
+    return _wrap(primal_out), _wrap(hvp)
+
+
+class Jacobian:
+    """Lazily-indexable Jacobian matrix (incubate/autograd/functional.py Jacobian).
+
+    Flattens outputs and inputs to 2-D [out_numel, in_numel] like the
+    reference, computing the full matrix once on first access.
+    """
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs = xs
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            xs_t = _as_tuple(self._xs)
+            arrs = tuple(_unwrap(x) for x in xs_t)
+            jf = _lift(self._func)
+            out_aval = jax.eval_shape(jf, *arrs)
+            multi_out = isinstance(out_aval, tuple)
+            out_avals = out_aval if multi_out else (out_aval,)
+            jac = jax.jacrev(jf, argnums=tuple(range(len(arrs))))(*arrs)
+            per_out = jac if multi_out else (jac,)
+            rows = []
+            for o_aval, per_arg in zip(out_avals, per_out):
+                o_size = 1
+                for s in o_aval.shape:
+                    o_size *= s
+                rows.append(jnp.concatenate(
+                    [jnp.reshape(per_arg[k], (o_size, -1))
+                     for k in range(len(arrs))], axis=1))
+            self._mat = Tensor(jnp.concatenate(rows, axis=0))
+        return self._mat
+
+    @property
+    def shape(self):
+        return self._materialize().shape
+
+    def __getitem__(self, idx):
+        return self._materialize()[idx]
+
+    def numpy(self):
+        return self._materialize().numpy()
+
+
+class Hessian(Jacobian):
+    """Lazily-indexable Hessian of a scalar function, flattened to 2-D over
+    all inputs (multi-input xs produces the full block matrix)."""
+
+    def _materialize(self):
+        if self._mat is None:
+            xs_t = _as_tuple(self._xs)
+            arrs = tuple(_unwrap(x) for x in xs_t)
+            sizes = [int(a.size) for a in arrs]
+            jf = _lift(self._func)
+
+            def scalar_f(*a):
+                out = jf(*a)
+                out = out[0] if isinstance(out, tuple) else out
+                return jnp.reshape(out, ())
+
+            blocks = jax.hessian(scalar_f,
+                                 argnums=tuple(range(len(arrs))))(*arrs)
+            rows = []
+            for i in range(len(arrs)):
+                rows.append(jnp.concatenate(
+                    [jnp.reshape(blocks[i][j], (sizes[i], sizes[j]))
+                     for j in range(len(arrs))], axis=1))
+            self._mat = Tensor(jnp.concatenate(rows, axis=0))
+        return self._mat

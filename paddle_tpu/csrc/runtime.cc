@@ -22,6 +22,7 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <set>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -207,6 +208,10 @@ struct StoreServer {
   std::mutex mu;
   std::condition_variable cv;  // signalled on any mutation
   std::map<std::string, std::vector<uint8_t>> kv;
+  // live connection fds: stop() must shutdown() each so handlers blocked in
+  // recv() on still-open (or half-dead) client connections actually wake up
+  std::mutex conn_mu;
+  std::set<int> conn_fds;
 
   void handle(int fd) {
     int one = 1;
@@ -276,6 +281,10 @@ struct StoreServer {
       if (!write_full(fd, &status, 8) || !write_full(fd, &rlen, 4)) break;
       if (rlen && !write_full(fd, reply.data(), rlen)) break;
     }
+    {
+      std::lock_guard<std::mutex> lk(conn_mu);
+      conn_fds.erase(fd);
+    }
     ::close(fd);
   }
 
@@ -289,6 +298,10 @@ struct StoreServer {
       if (stopping.load()) {
         ::close(fd);
         return;
+      }
+      {
+        std::lock_guard<std::mutex> lk(conn_mu);
+        conn_fds.insert(fd);
       }
       handlers.emplace_back([this, fd] { handle(fd); });
     }
@@ -345,6 +358,12 @@ PT_API void pt_store_server_stop(void* s_) {
     ::close(fd);
   }
   if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // accept loop is done: no new connections. Unblock handlers stuck in
+    // recv() on connections whose peer never closed (e.g. a crashed node).
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    for (int cfd : s->conn_fds) ::shutdown(cfd, SHUT_RDWR);
+  }
   for (auto& t : s->handlers)
     if (t.joinable()) t.join();
   delete s;

@@ -56,7 +56,12 @@ def shard_tensor(data, mesh: ProcessMesh, placements=None, dtype=None,
     if any(isinstance(p, Partial) for p in (placements or [])):
         raise ValueError("shard_tensor cannot create Partial placements; "
                          "Partial only arises from computation")
-    if not isinstance(val, jax.core.Tracer):
+    if isinstance(val, jax.core.Tracer):
+        # inside a traced region the sharding is attached as a GSPMD
+        # constraint (for Parameters too — ADVICE r1: the Parameter branch
+        # must not silently drop it)
+        val = jax.lax.with_sharding_constraint(val, sharding)
+    else:
         val = jax.device_put(val, sharding)
     if isinstance(t, Parameter):
         t._value = val
@@ -65,8 +70,6 @@ def shard_tensor(data, mesh: ProcessMesh, placements=None, dtype=None,
     else:
         out = Tensor(val, stop_gradient=t.stop_gradient if stop_gradient is None
                      else stop_gradient, name=t.name)
-        if isinstance(val, jax.core.Tracer):
-            out._value = jax.lax.with_sharding_constraint(val, sharding)
     return out
 
 

@@ -30,6 +30,28 @@ from ..core.tensor import Tensor
 _pending: Optional[threading.Thread] = None
 _pending_error: Optional[BaseException] = None
 _pending_lock = threading.Lock()
+_barrier_seq = 0
+
+
+def _next_barrier_tag(path: str) -> str:
+    """Unique per-save barrier id; every process calls save() in the same
+    order (SPMD discipline), so sequence numbers agree across hosts."""
+    global _barrier_seq
+    with _pending_lock:
+        _barrier_seq += 1
+        return f"pt_ckpt:{os.path.basename(path)}:{_barrier_seq}"
+
+
+def _host_barrier(tag: str, timeout_ms: int = 600_000):
+    """Host-side cross-process barrier over the coordination-service KV
+    (the TCPStore analog) — never touches device streams, so it is safe to
+    call from the async checkpoint writer thread."""
+    from jax._src import distributed
+
+    client = getattr(distributed.global_state, "client", None)
+    if client is None:
+        return  # single-process: nothing to synchronize
+    client.wait_at_barrier(tag, timeout_in_ms=timeout_ms)
 
 
 def _is_leaf(v):
@@ -139,13 +161,19 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         else:
             shards[f"{name}|full"] = np.asarray(val)
 
+    barrier_tag = _next_barrier_tag(path)
+
     def _write():
         np.savez(os.path.join(path, f"shard-{proc}.npz"), **shards)
         if nproc > 1:
-            # all hosts' shards must be durable before metadata announces the
-            # checkpoint (readers key on metadata.json presence)
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(f"ckpt_save:{path}")
+            # All hosts' shards must be durable before metadata announces the
+            # checkpoint (readers key on metadata.json presence). This must be
+            # a HOST-side barrier: a device collective issued from the async
+            # writer thread could interleave with the main thread's training
+            # collectives in different orders on different hosts and deadlock
+            # (ADVICE r1). The coordination-service KV barrier touches no
+            # device streams.
+            _host_barrier(barrier_tag)
         if proc == coordinator_rank:
             with open(os.path.join(path, "metadata.json"), "w") as f:
                 json.dump(meta, f)

@@ -103,32 +103,95 @@ class CollectiveController:
         self.procs.append(p)
 
     # ---- watcher / elastic restart ----
+    # A failed worker triggers a restart of ALL local ranks (and, via the
+    # store's restart-generation counter, every peer node's ranks too): a
+    # single respawned rank cannot rejoin an in-flight jax.distributed job —
+    # surviving ranks would block in collectives against the dead peer.
+    # Matches the reference's whole-pod restart on membership change
+    # (fleet/elastic/manager.py:253-266).
+
+    def _restart_generation(self) -> int:
+        try:
+            return int(self.store.add("restart_gen", 0))
+        except Exception:
+            return 0
+
+    def _restart_all(self, gen: int, reason: str) -> int:
+        sys.stderr.write(
+            f"[launch] {reason}; restarting all local ranks "
+            f"(generation {gen}, {self.pod_restarts}/{self.ctx.max_restart})\n")
+        self.stop(signal.SIGTERM)
+        # A fresh coordination-service port per generation: the old service
+        # (hosted inside old rank 0) is gone, and rebinding the same port
+        # across nodes would race. The master only publishes a port for the
+        # LATEST generation it observed, so non-masters must follow the
+        # newest generation while they wait (two nodes can bump restart_gen
+        # within one poll window, skipping a generation on the master).
+        if self.ctx.is_master_node():
+            from .context import _free_port
+            self.coord_port = _free_port()
+            self.store.set(f"coord_port/{gen}", str(self.coord_port))
+            self.store.add(f"coord_ready/{gen}", 1)
+        else:
+            deadline = time.monotonic() + 120.0
+            while True:
+                gen = max(gen, self._restart_generation())
+                if int(self.store.add(f"coord_ready/{gen}", 0)) > 0:
+                    self.coord_port = int(self.store.get(f"coord_port/{gen}"))
+                    break
+                if time.monotonic() > deadline:
+                    # master gone (crashed or gave up): exit instead of
+                    # wedging this node's launcher forever
+                    raise RuntimeError(
+                        f"pod restart generation {gen}: master never "
+                        "published a coordination port (is it down?)")
+                time.sleep(0.2)
+        self.procs.clear()
+        for local_rank in range(self.ctx.nproc_per_node):
+            self._spawn(local_rank, restarts=self.pod_restarts)
+        return gen
+
     def watch(self, poll: float = 0.2) -> int:
-        """Monitor the pod; restart failed workers up to max_restart.
+        """Monitor the pod; on worker failure restart the whole pod (all
+        local ranks + peers via the store) up to max_restart times.
         Returns the final exit code (0 iff all workers exited 0)."""
+        self.pod_restarts = getattr(self, "pod_restarts", 0)
+        seen_gen = self._restart_generation()
         while True:
+            # peer-initiated pod restart?
+            if self.ctx.nnodes > 1:
+                gen = self._restart_generation()
+                if gen > seen_gen:
+                    self.pod_restarts += 1
+                    seen_gen = self._restart_all(
+                        gen, "peer node requested pod restart")
             running = False
+            failed: Optional[_Proc] = None
             for p in list(self.procs):
                 code = p.popen.poll()
                 if code is None:
                     running = True
-                    continue
-                if code == 0:
-                    continue
-                if p.restarts < self.ctx.max_restart:
-                    local_rank = p.rank - self.ctx.node_rank * self.ctx.nproc_per_node
+                elif code != 0:
+                    failed = p
+                    break
+            if failed is not None:
+                code = failed.popen.poll()
+                if self.pod_restarts < self.ctx.max_restart:
+                    self.pod_restarts += 1
                     sys.stderr.write(
-                        f"[launch] worker rank={p.rank} exited {code}; "
-                        f"restart {p.restarts + 1}/{self.ctx.max_restart} "
-                        f"(log: {p.log_path})\n")
-                    self._spawn(local_rank, restarts=p.restarts + 1)
-                    running = True
-                else:
-                    sys.stderr.write(
-                        f"[launch] worker rank={p.rank} failed permanently "
-                        f"(exit {code}); stopping pod\n")
-                    self.stop(signal.SIGTERM)
-                    return code
+                        f"[launch] worker rank={failed.rank} exited {code}; "
+                        f"restart {self.pod_restarts}/{self.ctx.max_restart} "
+                        f"(log: {failed.log_path})\n")
+                    if self.ctx.nnodes > 1:
+                        seen_gen = int(self.store.add("restart_gen", 1))
+                    seen_gen = self._restart_all(seen_gen,
+                                                 f"rank {failed.rank} failed")
+                    continue
+                sys.stderr.write(
+                    f"[launch] worker rank={failed.rank} failed permanently "
+                    f"(exit {code}); stopping pod\n")
+                self.stop(signal.SIGTERM)
+                return code
             if not running:
                 return 0
             time.sleep(poll)

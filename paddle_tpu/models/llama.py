@@ -251,13 +251,18 @@ def _call_with_params(layer, names, vals, fn):
 
 def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                             n_microbatches: int = 1, remat: bool = True,
-                            amp: bool = False):
+                            amp: bool = False, schedule: str = "gpipe",
+                            n_virtual: int = 1):
     """Build a fully-compiled hybrid train step.
 
     The decoder blocks' params are stacked on a leading dim of size L and
     - pp == 1: consumed via lax.scan over layers (fast compile),
     - pp  > 1: sharded over 'pp' (layers grouped into stages) and executed by
-      spmd_pipeline (GPipe schedule compiled into one XLA program).
+      the selected pipeline schedule, compiled into one XLA program:
+      'gpipe' (fill-drain, AD backward), '1f1b' (manual fwd/bwd interleave,
+      ring-buffer activation stash — pipeline_parallel.py:387 analog), or
+      'vpp' (interleaved virtual stages, n_virtual chunks per pp rank —
+      PipelineParallelWithInterleave:1016 analog).
     Embedding / final norm / lm head run outside the pipeline in GSPMD.
     Returns step(batch_dict) -> loss Tensor.
     """
@@ -265,6 +270,13 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
     cfg = model.config
     L = cfg.num_hidden_layers
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp <= 1:
+        schedule = "gpipe"
+    if schedule == "vpp":
+        assert L % (pp * n_virtual) == 0, "layers must divide pp*n_virtual"
+        assert n_microbatches % pp == 0, "vpp needs n_microbatches % pp == 0"
+    else:
+        n_virtual = 1
     assert L % max(pp, 1) == 0, "layers must divide pp degree"
 
     block0 = model.llama.layers[0]
@@ -278,6 +290,14 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
             blk = model.llama.layers[li]
             vals.append(dict(blk.named_parameters())[n]._value)
         stacked[n] = jnp.stack(vals, 0)
+    if schedule == "vpp":
+        # Store chunk-major [v, pp, L/(pp*v), ...] AT REST (element [c, i] =
+        # virtual stage c*pp+i's layer block; flat C-order position equals
+        # layer index, so reshape is exactly the cyclic layout). Sharding
+        # dim 1 over pp then matches the schedule's view — no per-step
+        # parameter redistribution.
+        stacked = {n: a.reshape(n_virtual, pp, -1, *a.shape[1:])
+                   for n, a in stacked.items()}
 
     # non-block params
     outer_names, outer_params = [], []
@@ -340,9 +360,12 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                     assert b % n_microbatches == 0
                     mb = b // n_microbatches
                     x_mb = x.reshape(n_microbatches, mb, s, h)
-                    y_mb = spmd_pipeline(stage_fn, stacked_vals, x_mb,
-                                         n_microbatches=n_microbatches,
-                                         mesh=mesh, remat=remat)
+                    y_mb = spmd_pipeline(
+                        stage_fn, stacked_vals, x_mb,
+                        n_microbatches=n_microbatches,
+                        mesh=mesh, remat=remat,
+                        schedule="vpp" if schedule == "vpp" else "gpipe",
+                        n_virtual=n_virtual)
                     x2 = y_mb.reshape(b, s, h)
                 else:
                     x2 = blocks_scan(stacked_vals, x)
@@ -354,13 +377,86 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                 return loss._value
             return outer_apply(outer_vals, run)
 
+    # --- 1F1B: loss AND grads from the manually-scheduled pipeline ---------
+    # (value_and_grad cannot interleave fwd/bwd microbatches; the schedule
+    # computes its own vjps, so the embedding/head grads are chained on
+    # manually around spmd_pipeline_1f1b.)
+    embed_pos = [i for i, n in enumerate(outer_names) if "embed_tokens" in n]
+    head_pos = [i for i, n in enumerate(outer_names) if "embed_tokens" not in n]
+
+    def loss_and_grads_1f1b(params, batch, rng):
+        from ..parallel.pipeline import spmd_pipeline_1f1b
+
+        outer_vals, stacked_vals = params
+        cast_outer = _amp_cast(outer_vals) if amp else list(outer_vals)
+        cast_stacked = _amp_cast(stacked_vals) if amp else stacked_vals
+        ids, labels = batch["input_ids"], batch["labels"]
+        b = ids.shape[0]
+        assert b % n_microbatches == 0
+        mb = b // n_microbatches
+
+        with gen.key_override(rng), no_grad():
+            def embed_fn(embed_vals):
+                full = list(cast_outer)
+                for k, i in enumerate(embed_pos):
+                    full[i] = embed_vals[k]
+
+                def run():
+                    x = model.llama.embed_tokens(Tensor(ids))._value
+                    if amp:
+                        x = x.astype(jnp.bfloat16)
+                    x = mesh_mod.shard_constraint(x, "dp", None, None)
+                    return x.reshape(n_microbatches, mb, *x.shape[1:])
+                return outer_apply(full, run)
+
+            x_mb, embed_vjp = jax.vjp(
+                embed_fn, [cast_outer[i] for i in embed_pos])
+
+            def head_loss(head_vals, y, labels_mb):
+                full = list(cast_outer)
+                for k, i in enumerate(head_pos):
+                    full[i] = head_vals[k]
+
+                def run():
+                    h_out = model.llama.norm(Tensor(y))
+                    logits = model.lm_head(h_out)
+                    if amp:
+                        logits = Tensor(logits._value.astype(jnp.float32))
+                    loss = F.cross_entropy(logits, Tensor(labels_mb),
+                                           reduction="mean")
+                    return loss._value
+                return outer_apply(full, run)
+
+            labels_mb = labels.reshape(n_microbatches, mb, *labels.shape[1:])
+            loss, g_stacked, g_head, dx_mb = spmd_pipeline_1f1b(
+                stage_fn, head_loss, cast_stacked,
+                [cast_outer[i] for i in head_pos], x_mb, labels_mb,
+                n_microbatches=n_microbatches, mesh=mesh, remat=remat)
+            (g_embed,) = embed_vjp(dx_mb)
+
+        # assemble grads positionally, cast back to master-param dtype
+        outer_grads = [None] * len(outer_names)
+        for k, i in enumerate(embed_pos):
+            outer_grads[i] = g_embed[k].astype(outer_vals[i].dtype)
+        for k, i in enumerate(head_pos):
+            outer_grads[i] = g_head[k].astype(outer_vals[i].dtype)
+        g_stacked = {k: g.astype(stacked_vals[k].dtype)
+                     for k, g in g_stacked.items()}
+        return loss, (outer_grads, g_stacked)
+
     # shardings
     def stacked_spec(name, arr):
-        # leading L dim over pp; inner dims follow the layer's TP spec
+        # leading layer dim(s) over pp; inner dims follow the layer's TP spec
         p = dict(block0.named_parameters())[name]
-        inner = _clean_spec(getattr(p, "_sharding", None), arr.ndim - 1, mesh)
+        n_lead = 3 if schedule == "vpp" else 1
+        inner = _clean_spec(getattr(p, "_sharding", None), arr.ndim - n_lead,
+                            mesh)
         lead = "pp" if (mesh is not None and mesh.shape.get("pp", 1) > 1) else None
-        return PartitionSpec(lead, *inner) if mesh is not None else None
+        if mesh is None:
+            return None
+        if schedule == "vpp":
+            return PartitionSpec(None, lead, None, *inner)
+        return PartitionSpec(lead, *inner)
 
     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -429,7 +525,10 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                      shard_states(opt_state[1], stacked_sh))
 
     def pure_step(param_vals, opt_st, batch, lr, step, rng):
-        loss, grads = jax.value_and_grad(loss_fn)(param_vals, batch, rng)
+        if schedule == "1f1b" and pp > 1:
+            loss, grads = loss_and_grads_1f1b(param_vals, batch, rng)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(param_vals, batch, rng)
         clip = getattr(base_opt, "_grad_clip", None)
         if clip is not None:
             from ..nn.clip import ClipGradByGlobalNorm
@@ -489,6 +588,8 @@ def _write_back(model, params, outer_names, outer_params, block_names):
         p._value = jnp.copy(v)
     L = model.config.num_hidden_layers
     for n in block_names:
-        layer_vals = jnp.copy(stacked[n])
+        # vpp stores chunk-major [v, pp, Lb, ...]; flat C-order == layer order
+        pshape = dict(model.llama.layers[0].named_parameters())[n]._value.shape
+        layer_vals = jnp.copy(stacked[n]).reshape(L, *pshape)
         for li in range(L):
             dict(model.llama.layers[li].named_parameters())[n]._value = layer_vals[li]

@@ -43,16 +43,23 @@ class GradNode:
     """One recorded op on the tape.
 
     Holds the vjp closure over the op's differentiable inputs plus weak structure
-    info needed to seed missing cotangents with zeros.
+    info needed to seed missing cotangents with zeros.  `recompute` keeps the
+    ingredients (jax_fn, unwrapped arg values, diff positions, static kwargs)
+    needed to re-derive the vjp as a *differentiable* function of both inputs
+    and cotangents — the hook higher-order autograd uses (analog of the
+    reference's double-grad nodes, paddle/fluid/eager/ + prim composite grads).
     """
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "multi_output", "op_name", "__weakref__")
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "multi_output", "op_name",
+                 "recompute", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs: Sequence[Tensor], out_avals, multi_output, op_name):
+    def __init__(self, vjp_fn, inputs: Sequence[Tensor], out_avals, multi_output,
+                 op_name, recompute=None):
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)     # strong refs: keeps producer subgraph alive
         self.out_avals = out_avals     # [(shape, dtype), ...]
         self.multi_output = multi_output
         self.op_name = op_name
+        self.recompute = recompute     # (jax_fn, vals, diff_idx, static_kwargs)
 
     def __repr__(self):
         return f"<GradNode {self.op_name}>"
@@ -180,7 +187,8 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
         (o._value.shape, o._value.dtype) if isinstance(o, Tensor) else None
         for o in outs_list
     ]
-    node = GradNode(vjp_fn, [args[i] for i in diff_idx], out_avals, multi, name)
+    node = GradNode(vjp_fn, [args[i] for i in diff_idx], out_avals, multi, name,
+                    recompute=(jax_fn, vals, diff_idx, static_kwargs))
     for i, o in enumerate(outs_list):
         if isinstance(o, Tensor):
             o._grad_node = node

@@ -1,16 +1,38 @@
 """SPMD pipeline executor.
 
-TPU-native replacement for the reference's 1F1B runtime + P2P layer
-(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:132,387 and
-pp_utils/p2p_communication.py): instead of per-rank send/recv of
-(meta, tensor) pairs on comm streams, the whole schedule is ONE compiled XLA
-program — shard_map manual over the 'pp' mesh axis, microbatch loop as
-lax.scan, stage hand-off as lax.ppermute over ICI. dp/mp/sharding axes stay in
-GSPMD auto mode, so tensor-parallel constraints inside the stage body still
-apply. Reverse-mode AD through ppermute+scan yields the backward pipeline
-(inverted permutation) without hand-writing a schedule; activation memory is
-bounded via jax.checkpoint on the stage body (1F1B's memory goal, achieved by
-rematerialization instead of scheduling).
+TPU-native replacement for the reference's pipeline runtimes + P2P layer
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:132
+`PipelineParallel`, :387 `forward_backward_pipeline` (1F1B), :822/:1016
+`PipelineParallelWithInterleave` (VPP), and pp_utils/p2p_communication.py):
+instead of per-rank send/recv of (meta, tensor) pairs on comm streams, the
+whole schedule is ONE compiled XLA program — shard_map manual over the 'pp'
+mesh axis, the schedule clock as lax.scan, stage hand-off as lax.ppermute over
+ICI. dp/mp/sharding axes stay in GSPMD auto mode, so tensor-parallel
+constraints inside the stage body still apply.
+
+Three schedules:
+
+- ``gpipe``: forward fill-drain; backward comes from reverse-mode AD of the
+  scan (inverted permutation). Activation liveness = scan residuals over all
+  T = M+S-1 ticks (bounded via jax.checkpoint on the stage body).
+- ``1f1b``: a manually-scheduled forward/backward interleave in a single scan.
+  Schedule clock (S stages, M microbatches, global tick t): stage i runs
+  forward of microbatch m at tick  f_i(m) = m+i  while filling
+  (m < S-i) and at  f_i(m) = 2m+i  in steady state (throttled by the
+  in-flight limit S-i), and backward of m at  b_i(m) = 2S-1-i+2m .
+  All producer->consumer edges are exactly one tick apart, so each tick ends
+  with one down-stream ppermute (activations) and one up-stream ppermute
+  (cotangents). Backward units recompute the stage vjp from a stashed input
+  (recompute-style 1F1B, as the reference pairs recompute with 1F1B), so the
+  activation stash is a ring buffer of only  min(S, M)  microbatch inputs —
+  the 1F1B memory bound — versus GPipe's M.
+- ``vpp``: interleaved virtual-stage schedule. Each rank holds v chunks;
+  virtual stage vs = c*S + i lives on rank i. Microbatches are processed in
+  groups of S: chunk c of rank i runs microbatch m = g*S + r at tick
+  t = i + r + S*(g*v + c) — exactly one chunk-unit per rank per tick, with
+  every virtual-stage edge one tick apart (the ring ppermute covers both the
+  i->i+1 edge and the chunk-boundary wrap S-1 -> 0). Pipeline bubble shrinks
+  from (S-1)/(M+S-1) to (S-1)/(Mv+S-1). Backward via AD of the scan.
 """
 from __future__ import annotations
 
@@ -25,19 +47,32 @@ PP_AXIS = "pp"
 
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
                   n_microbatches: int, mesh, axis: str = PP_AXIS,
-                  remat: bool = True):
+                  remat: bool = True, schedule: str = "gpipe",
+                  n_virtual: int = 1):
     """Run `stage_fn(params, x) -> y` as a pp-pipelined computation.
 
     Args:
       stage_fn: the per-stage computation; identical structure on every stage
         (e.g. `layers_per_stage` transformer blocks applied via lax.scan).
-      stage_params: pytree whose leaves have a leading stage dim of size
-        pp_degree, sharded over the 'pp' axis (leaf shape [pp, ...]).
+      stage_params: pytree whose leaves have a leading stage dim, sharded over
+        the 'pp' axis. For gpipe: leaf shape [pp, ...]. For vpp: leaf shape
+        [v, pp, ...] with element [c, i] = virtual stage c*pp + i.
       microbatches: array [n_micro, mb, ...] (the global batch split into
         microbatches; may be sharded over dp on the mb dim).
+      schedule: 'gpipe' or 'vpp' (the 1F1B train path is
+        `spmd_pipeline_1f1b`, which also produces gradients).
+      n_virtual: chunks per rank for 'vpp'.
     Returns:
-      [n_micro, mb, ...] outputs of the final stage, replicated over pp.
+      [n_micro, mb, ...] outputs of the final (virtual) stage, replicated
+      over pp.
     """
+    if schedule == "vpp":
+        return _spmd_pipeline_vpp(stage_fn, stage_params, microbatches,
+                                  n_microbatches=n_microbatches, mesh=mesh,
+                                  axis=axis, remat=remat, n_virtual=n_virtual)
+    if schedule != "gpipe":
+        raise ValueError(f"unknown schedule {schedule!r} "
+                         "(use gpipe|vpp here, spmd_pipeline_1f1b for 1f1b)")
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def per_stage(params, x_mb):
@@ -78,6 +113,234 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
     return jax.shard_map(per_stage, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, axis_names={axis},
                          check_vma=False)(stage_params, microbatches)
+
+
+def _spmd_pipeline_vpp(stage_fn, stage_params, microbatches, *,
+                       n_microbatches, mesh, axis, remat, n_virtual):
+    """Interleaved virtual-pipeline forward (see module docstring)."""
+    M, v = n_microbatches, n_virtual
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def per_stage(params, x_mb):
+        # params leaves: [v, 1, ...] (chunk dim, pp slice) -> drop pp dim
+        params = jax.tree_util.tree_map(lambda a: a[:, 0] if a.ndim >= 2 else a,
+                                        params)
+        S = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        T = M * v + S - 1
+        state = jnp.zeros_like(x_mb[0])
+        outputs = jnp.zeros_like(x_mb)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def step(carry, t):
+            a_in, outs = carry
+            q = t - idx
+            valid = q >= 0
+            r = jnp.where(valid, q % S, 0)
+            qq = jnp.where(valid, q // S, 0)
+            c = qq % v             # chunk index on this rank
+            g = qq // v            # microbatch group
+            m = g * S + r
+            active = valid & (m < M) & (g < (M + S - 1) // S)
+
+            chunk_params = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                params)
+            is_first_vs = (idx == 0) & (c == 0)
+            x_in = jnp.where(is_first_vs, x_mb[jnp.clip(m, 0, M - 1)], a_in)
+            y = fn(chunk_params, x_in)
+
+            is_last_vs = (idx == S - 1) & (c == v - 1)
+            mi = jnp.clip(m, 0, M - 1)
+            outs = outs.at[mi].set(
+                jnp.where(active & is_last_vs, y, outs[mi]))
+            a_next = jax.lax.ppermute(jnp.where(active, y, jnp.zeros_like(y)),
+                                      axis, perm)
+            return (a_next, outs), None
+
+        (_, outputs), _ = jax.lax.scan(step, (state, outputs), jnp.arange(T))
+        outputs = jax.lax.psum(
+            jnp.where((idx == S - 1), outputs, jnp.zeros_like(outputs)), axis)
+        return outputs
+
+    pp = mesh.shape[axis]
+    if M % pp != 0:
+        raise ValueError(f"vpp requires n_microbatches % pp == 0, "
+                         f"got {M} % {pp}")
+    in_specs = (jax.tree_util.tree_map(
+        lambda _: jax.sharding.PartitionSpec(None, axis), stage_params),
+        jax.sharding.PartitionSpec())
+    return jax.shard_map(per_stage, mesh=mesh, in_specs=in_specs,
+                         out_specs=jax.sharding.PartitionSpec(),
+                         axis_names={axis}, check_vma=False)(
+        stage_params, microbatches)
+
+
+def spmd_pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
+                       head_params, x_mb, labels_mb, *, n_microbatches: int,
+                       mesh, axis: str = PP_AXIS, remat: bool = True):
+    """One-program 1F1B training pipeline: loss AND gradients in one scan.
+
+    Unlike `spmd_pipeline` (whose backward is AD of the forward scan), this
+    interleaves forward and backward microbatch units on the 1F1B clock, so
+    at most min(S, M) stage inputs are stashed per stage (ring buffer) — the
+    1F1B activation bound (pipeline_parallel.py:387 semantics). Backward
+    units recompute the stage vjp from the stashed input.
+
+    Args:
+      stage_fn(params, x) -> y           per-stage computation
+      loss_fn(head_params, y, labels) -> scalar  last-stage head + loss for
+        ONE microbatch (mean-reduced over the microbatch)
+      stage_params: pytree, leaves [pp, ...] sharded over `axis`
+      head_params:  pytree, replicated over `axis`
+      x_mb: [M, mb, ...] microbatched pipeline input (replicated over pp)
+      labels_mb: [M, ...] microbatched labels
+    Returns:
+      (loss_mean, grads_stage, grads_head, dx_mb) — grads of loss_mean;
+      grads_stage leaves [pp, ...] sharded like stage_params; dx_mb is the
+      cotangent of x_mb (feed it to the embedding's vjp).
+    """
+    M = n_microbatches
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def per_stage(params, head, x_all, labels):
+        S = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        T = 2 * (M + S - 1)
+        stash_n = min(S, M)
+        down = [(i, (i + 1) % S) for i in range(S)]
+        up = [(i, (i - 1) % S) for i in range(S)]
+
+        a0 = jnp.zeros_like(x_all[0])
+        carry0 = dict(
+            a_in=a0,
+            g_in=a0,
+            x_stash=jnp.zeros((stash_n,) + x_all.shape[1:], x_all.dtype),
+            g_stage=jax.tree_util.tree_map(jnp.zeros_like, params),
+            g_head=jax.tree_util.tree_map(jnp.zeros_like, head),
+            loss=jnp.zeros((), jnp.float32),
+            dx=jnp.zeros_like(x_all),
+        )
+
+        def tick(carry, t):
+            # ---- schedule clock ----
+            d = t - idx
+            fill = (d >= 0) & (d < jnp.minimum(S - idx, M))
+            m_st = d // 2
+            steady = (d >= 0) & (d % 2 == 0) & (m_st >= S - idx) & (m_st < M)
+            do_fwd = fill | steady
+            m_f = jnp.clip(jnp.where(fill, d, m_st), 0, M - 1)
+
+            e = t - (2 * S - 1 - idx)
+            do_bwd = (e >= 0) & (e % 2 == 0) & (e // 2 < M)
+            m_b = jnp.clip(e // 2, 0, M - 1)
+
+            # ---- arrival: stash the activation sent last tick ----
+            # Sender (stage idx-1) forwarded microbatch m_arr at tick t-1;
+            # its clock value is d' = (t-1)-(idx-1) = d, so the receiver
+            # derives m_arr from its own d. Stashing on ARRIVAL (not on
+            # consumption) matters at the fill->steady boundary, where the
+            # memory throttle makes this stage consume up to S-idx ticks
+            # later than the activation lands.
+            arr_fill = (d >= 0) & (d < jnp.minimum(S - idx + 1, M))
+            arr_steady = ((d >= 0) & (d % 2 == 0)
+                          & (d // 2 >= S - idx + 1) & (d // 2 < M))
+            do_arr = (arr_fill | arr_steady) & (idx > 0)
+            m_arr = jnp.clip(jnp.where(arr_fill, d, d // 2), 0, M - 1)
+            slot_a = m_arr % stash_n
+            x_stash = carry["x_stash"].at[slot_a].set(
+                jnp.where(do_arr, carry["a_in"], carry["x_stash"][slot_a]))
+
+            # ---- forward unit ----
+            x_in = jnp.where(idx == 0, x_all[m_f], x_stash[m_f % stash_n])
+            y = fn(params, x_in)
+
+            # ---- backward unit (vjp recomputed from the stashed input) ----
+            x_saved = jnp.where(idx == 0, x_all[m_b],
+                                x_stash[m_b % stash_n])
+            is_last = idx == S - 1
+            y2, stage_vjp = jax.vjp(fn, params, x_saved)
+
+            # Head/loss vjp only exists on the last stage; lax.cond skips the
+            # (often large: lm-head matmul) computation on the other S-1
+            # ranks. The predicate varies only over pp, so any GSPMD
+            # collectives inside loss_fn (e.g. tp-sharded head) stay
+            # consistent within their mp groups.
+            def _with_loss(args):
+                hp, yy, lab = args
+                loss_val, loss_vjp = jax.vjp(
+                    lambda h_, y_: loss_fn(h_, y_, lab), hp, yy)
+                d_head, dy_last = loss_vjp(
+                    jnp.ones((), loss_val.dtype) / M)
+                return loss_val.astype(jnp.float32), d_head, dy_last
+
+            def _no_loss(args):
+                hp, yy, _ = args
+                return (jnp.zeros((), jnp.float32),
+                        jax.tree_util.tree_map(jnp.zeros_like, hp),
+                        jnp.zeros_like(yy))
+
+            loss_val, d_head, dy_last = jax.lax.cond(
+                is_last, _with_loss, _no_loss, (head, y2, labels[m_b]))
+            dy = jnp.where(is_last, dy_last, carry["g_in"])
+            d_params, dx = stage_vjp(dy)
+
+            zero = lambda g: jnp.zeros_like(g)
+            g_stage = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(do_bwd, g, zero(g)),
+                carry["g_stage"], d_params)
+            g_head = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(do_bwd & is_last, g, zero(g)),
+                carry["g_head"], d_head)
+            loss = carry["loss"] + jnp.where(
+                do_bwd & is_last, loss_val / M, 0.0)
+            dx_all = carry["dx"].at[m_b].set(
+                jnp.where(do_bwd & (idx == 0), dx, carry["dx"][m_b]))
+
+            # ---- stage hand-off (activations down, cotangents up) ----
+            a_next = jax.lax.ppermute(
+                jnp.where(do_fwd, y, jnp.zeros_like(y)), axis, down)
+            g_next = jax.lax.ppermute(
+                jnp.where(do_bwd, dx, jnp.zeros_like(dx)), axis, up)
+            return dict(a_in=a_next, g_in=g_next, x_stash=x_stash,
+                        g_stage=g_stage, g_head=g_head, loss=loss,
+                        dx=dx_all), None
+
+        carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+
+        # replicate last-stage scalars / stage-0 dx across pp
+        loss = jax.lax.psum(jnp.where(idx == S - 1, carry["loss"], 0.0), axis)
+        g_head = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(
+                jnp.where(idx == S - 1, g, jnp.zeros_like(g)), axis),
+            carry["g_head"])
+        dx = jax.lax.psum(
+            jnp.where(idx == 0, carry["dx"], jnp.zeros_like(carry["dx"])),
+            axis)
+        return loss, carry["g_stage"], g_head, dx
+
+    P = jax.sharding.PartitionSpec
+    stage_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    head_spec = jax.tree_util.tree_map(lambda _: P(), head_params)
+    in_specs = (stage_spec, head_spec, P(), P())
+    out_specs = (P(), stage_spec, head_spec, P())
+    return jax.shard_map(per_stage, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, axis_names={axis},
+                         check_vma=False)(stage_params, head_params, x_mb,
+                                          labels_mb)
+
+
+def activation_stash_microbatches(schedule: str, pp: int, n_microbatches: int,
+                                  n_virtual: int = 1) -> int:
+    """Peak number of stashed microbatch activations per stage, by
+    construction of each schedule (the 1F1B-vs-GPipe memory assertion)."""
+    if schedule == "1f1b":
+        return min(pp, n_microbatches)
+    if schedule == "gpipe":
+        return n_microbatches + pp - 1   # scan-carry residuals over T ticks
+    if schedule == "vpp":
+        return n_microbatches * n_virtual + pp - 1
+    raise ValueError(schedule)
 
 
 def stack_stage_params(param_list):

@@ -24,16 +24,34 @@ _load_error: str | None = None
 
 
 def _build() -> str | None:
-    """(Re)build the shared library if missing or stale. Returns error or None."""
+    """(Re)build the shared library if missing or stale. Returns error or None.
+
+    Concurrent-safe: N worker processes may import simultaneously (the launch
+    path), so each compiles to a private mkstemp path and publishes with an
+    atomic os.replace — never a shared fixed temp file that racers could
+    truncate mid-compile."""
+    import tempfile
     try:
         if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
             return None
-        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-               "-fvisibility=hidden", _SRC, "-o", _SO + ".tmp"]
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
-        if proc.returncode != 0:
-            return proc.stderr[-2000:]
-        os.replace(_SO + ".tmp", _SO)
+        fd, tmp = tempfile.mkstemp(suffix=".so", prefix=".rt_build_",
+                                   dir=_CSRC)
+        os.close(fd)
+        try:
+            cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                   "-fvisibility=hidden", _SRC, "-o", tmp]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=300)
+            if proc.returncode != 0:
+                return proc.stderr[-2000:]
+            ctypes.CDLL(tmp)  # verify before publishing
+            os.replace(tmp, _SO)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
         return None
     except Exception as e:  # toolchain missing etc. — callers fall back to Python
         return str(e)
@@ -95,7 +113,13 @@ def get_lib():
         try:
             _lib = _bind(ctypes.CDLL(_SO))
         except OSError as e:
+            # A corrupt artifact must not be cached forever: remove it so a
+            # later process (or retry) rebuilds from source.
             _load_error = str(e)
+            try:
+                os.unlink(_SO)
+            except OSError:
+                pass
             return None
         return _lib
 

@@ -174,3 +174,106 @@ def test_retain_graph_double_backward():
     y.backward(retain_graph=True)
     y.backward()
     np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+# ---- higher-order autograd (VERDICT r1 item 4) ----
+# Analog of the reference's double-grad tests + incubate/autograd/functional.py.
+
+def test_double_grad_cubic():
+    x = P.to_tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    y = (x ** 3).sum()
+    (g1,) = P.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), 3 * np.array([4.0, 9.0]), rtol=1e-6)
+    (g2,) = P.grad(g1.sum(), [x])
+    np.testing.assert_allclose(g2.numpy(), 6 * np.array([2.0, 3.0]), rtol=1e-6)
+
+
+def test_triple_grad():
+    x = P.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x ** 4
+    (g1,) = P.grad(y, [x], create_graph=True)            # 4x^3 = 32
+    (g2,) = P.grad(g1, [x], create_graph=True)           # 12x^2 = 48
+    (g3,) = P.grad(g2, [x])                              # 24x = 48
+    np.testing.assert_allclose(g1.numpy(), [32.0], rtol=1e-5)
+    np.testing.assert_allclose(g2.numpy(), [48.0], rtol=1e-5)
+    np.testing.assert_allclose(g3.numpy(), [48.0], rtol=1e-5)
+
+
+def test_double_grad_mlp():
+    """Grad-of-grad through a small MLP (matmul + tanh + reduction)."""
+    rng = np.random.RandomState(0)
+    w1 = P.to_tensor(rng.randn(4, 8).astype(np.float32) * 0.3, stop_gradient=False)
+    w2 = P.to_tensor(rng.randn(8, 1).astype(np.float32) * 0.3, stop_gradient=False)
+    x = P.to_tensor(rng.randn(5, 4).astype(np.float32), stop_gradient=False)
+
+    y = (P.tanh(x @ w1) @ w2).sum()
+    (gx,) = P.grad(y, [x], create_graph=True)
+    # gradient-penalty style second backward: d/dw1 of ||gx||^2
+    penalty = (gx * gx).sum()
+    (gw1,) = P.grad(penalty, [w1])
+    assert gw1.shape == [4, 8]
+    assert np.isfinite(gw1.numpy()).all()
+
+    # numeric check of d(penalty)/dw1 via finite differences
+    def penalty_np(w1v):
+        import jax
+        import jax.numpy as jnp
+
+        def f(xv):
+            return jnp.sum(jnp.tanh(xv @ w1v) @ w2.numpy())
+
+        g = jax.grad(f)(jnp.asarray(x.numpy()))
+        return float(jnp.sum(g * g))
+
+    eps = 1e-3
+    w1np = w1.numpy()
+    num = np.zeros_like(w1np)
+    for i in range(2):          # spot-check a few entries
+        for j in range(3):
+            dp = w1np.copy(); dp[i, j] += eps
+            dm = w1np.copy(); dm[i, j] -= eps
+            num[i, j] = (penalty_np(dp) - penalty_np(dm)) / (2 * eps)
+    np.testing.assert_allclose(gw1.numpy()[:2, :3], num[:2, :3], rtol=2e-2, atol=1e-4)
+
+
+def test_double_grad_compiled():
+    """Double grad inside a jitted (compiled) function — tape over tracers."""
+    import jax
+
+    def f(xv):
+        x = P.Tensor(xv, stop_gradient=False)
+        y = (x ** 3).sum()
+        (g1,) = P.grad(y, [x], create_graph=True)
+        (g2,) = P.grad(g1.sum(), [x])
+        return g2._value
+
+    out = jax.jit(f)(np.array([2.0, 3.0], np.float32))
+    np.testing.assert_allclose(np.asarray(out), 6 * np.array([2.0, 3.0]), rtol=1e-6)
+
+
+def test_functional_jvp_vjp():
+    from paddle_tpu.autograd import jvp, vjp
+
+    x = P.to_tensor(np.array([1.0, 2.0], np.float32))
+    out, tang = jvp(lambda t: t * t, x, P.to_tensor(np.array([1.0, 0.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [1.0, 4.0])
+    np.testing.assert_allclose(tang.numpy(), [2.0, 0.0])
+
+    out, g = vjp(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+
+def test_functional_jacobian_hessian():
+    from paddle_tpu.autograd import Hessian, Jacobian, hessian, jacobian
+
+    x = P.to_tensor(np.array([1.0, 2.0], np.float32))
+    j = jacobian(lambda t: t * t, x)
+    np.testing.assert_allclose(j.numpy(), np.diag([2.0, 4.0]))
+
+    h = hessian(lambda t: (t ** 3).sum(), x)
+    np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]))
+
+    H = Hessian(lambda t: (t ** 3).sum(), x)
+    np.testing.assert_allclose(np.asarray(H[0, 0]), 6.0)
+    J = Jacobian(lambda t: t * t, x)
+    assert J.shape == [2, 2]
