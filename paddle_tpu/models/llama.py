@@ -76,10 +76,12 @@ class LlamaConfig:
 
 def _rope(q, k, theta, position_offset=0):
     """Rotary embeddings on [B, S, H, D] (fp32 trig, matches reference
-    fused_rotary_position_embedding semantics)."""
+    fused_rotary_position_embedding semantics). position_offset may be a
+    traced scalar (the KV-cache decode path)."""
     b, s, h, d = q.shape
     inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    pos = jnp.arange(position_offset, position_offset + s, dtype=jnp.float32)
+    pos = jnp.arange(s, dtype=jnp.float32) + jnp.asarray(
+        position_offset, jnp.float32)
     freqs = jnp.outer(pos, inv)  # [S, D/2]
     cos = jnp.cos(freqs)[None, :, None, :]
     sin = jnp.sin(freqs)[None, :, None, :]
@@ -110,19 +112,51 @@ class LlamaAttention(Layer):
                                            gather_output=False)
         self.o_proj = RowParallelLinear(h, h, has_bias=False, input_is_parallel=True)
 
-    def forward(self, x, position_offset=0):
+    def forward(self, x, position_offset=0, kv_cache=None):
         b, s = x.shape[0], x.shape[1]
         q = manip.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = manip.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         v = manip.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
-        out = apply(lambda qq, kk: _rope(qq, kk, self.config.rope_theta,
-                                         position_offset),
+        off = position_offset._value if isinstance(position_offset, Tensor) \
+            else position_offset
+        out = apply(lambda qq, kk: _rope(qq, kk, self.config.rope_theta, off),
                     q, k, op_name="rope")
         q, k = out[0], out[1]
         # heads sharded over mp
         q = shard_constraint_t(q, None, None, "mp", None)
         k = shard_constraint_t(k, None, None, "mp", None)
         v = shard_constraint_t(v, None, None, "mp", None)
+        if kv_cache is not None:
+            # Decode path (FusedMultiTransformer / masked_multihead_attention
+            # analog, incubate/nn/layer/fused_transformer.py:1021): write the
+            # new K/V into the static-length cache at position_offset and
+            # attend over the cache under a length mask — one compiled
+            # program per (prefill, decode) shape, O(S) per new token.
+            k_cache, v_cache = kv_cache
+
+            def upd(kc, vc, kn, vn):
+                z = jnp.asarray(0, jnp.int32)
+                start = (z, jnp.asarray(off, jnp.int32), z, z)
+                return (jax.lax.dynamic_update_slice(kc, kn.astype(kc.dtype),
+                                                     start),
+                        jax.lax.dynamic_update_slice(vc, vn.astype(vc.dtype),
+                                                     start))
+
+            kv_out = apply(upd, k_cache, v_cache, k, v, op_name="kv_cache_upd")
+            k_cache, v_cache = kv_out[0], kv_out[1]
+            s_max = k_cache.shape[1]
+
+            def mk_mask(_shape_ref):
+                j = jnp.arange(s_max)[None, :]
+                i = jnp.arange(s)[:, None] + jnp.asarray(off, jnp.int32)
+                allowed = j <= i
+                return jnp.where(allowed, 0.0, -1e30)[None, None]  # [1,1,s,S]
+
+            mask = apply(mk_mask, q, op_name="decode_mask")
+            attn = F.scaled_dot_product_attention(q, k_cache, v_cache,
+                                                  attn_mask=mask)
+            attn = manip.reshape(attn, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(attn), (k_cache, v_cache)
         cp = self.config.context_parallel
         if cp:
             from ..parallel.context_parallel import sdpa_context_parallel
@@ -155,9 +189,16 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMLP(config)
         self._seq_parallel = config.sequence_parallel
 
-    def forward(self, x):
+    def forward(self, x, position_offset=0, kv_cache=None):
         if self._seq_parallel:
             x = shard_constraint_t(x, None, "mp", None)  # Megatron-SP resident
+        if kv_cache is not None:
+            attn, new_cache = self.self_attn(self.input_layernorm(x),
+                                             position_offset=position_offset,
+                                             kv_cache=kv_cache)
+            h = x + attn
+            out = h + self.mlp(self.post_attention_layernorm(h))
+            return out, new_cache
         h = x + self.self_attn(self.input_layernorm(x))
         out = h + self.mlp(self.post_attention_layernorm(h))
         if self._seq_parallel:
@@ -175,11 +216,18 @@ class LlamaModel(Layer):
                                  for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, position_offset=0):
         x = self.embed_tokens(input_ids)
         # context parallel: activations sequence-sharded over 'sep' model-wide
         seq_axis = "sep" if self.config.context_parallel else None
         x = shard_constraint_t(x, "dp", seq_axis, None)
+        if caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                x, nc = layer(x, position_offset=position_offset,
+                              kv_cache=cache)
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         for i, layer in enumerate(self.layers):
             if self.config.recompute:
                 from ..distributed.fleet.recompute import recompute
@@ -197,7 +245,11 @@ class LlamaForCausalLM(Layer):
         self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
                                             has_bias=False, gather_output=True)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, position_offset=0):
+        if caches is not None:
+            h, new_caches = self.llama(input_ids, caches=caches,
+                                       position_offset=position_offset)
+            return self.lm_head(h), new_caches
         h = self.llama(input_ids)
         return self.lm_head(h)
 
@@ -206,23 +258,81 @@ class LlamaForCausalLM(Layer):
         loss = F.cross_entropy(logits, labels, reduction="mean")
         return loss
 
+    def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):
+        """Per-layer (k, v) caches [B, S_max, H_kv, D] with static length."""
+        cfg = self.config
+        d = cfg.hidden_size // cfg.num_attention_heads
+        dt = dtype or self.lm_head.weight.dtype
+        shape = (batch_size, max_len, cfg.num_key_value_heads, d)
+        return [(Tensor(jnp.zeros(shape, dt)), Tensor(jnp.zeros(shape, dt)))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def _build_cached_step(self):
+        """One jitted fn serving both prefill ([B,P]) and decode ([B,1]) —
+        jax retraces per input shape; the KV caches are donated so decode
+        updates in place. Params are runtime args (small HLO)."""
+        model = self
+        plist = list(model.parameters())
+
+        def step(param_vals, tok, caches, off):
+            saved = [p._value for p in plist]
+            try:
+                for p, v in zip(plist, param_vals):
+                    p._value = v
+                with no_grad():
+                    logits, new_caches = model.forward(
+                        Tensor(tok),
+                        caches=[(Tensor(kc), Tensor(vc)) for kc, vc in caches],
+                        position_offset=off)
+                return (logits._value[:, -1, :],
+                        [(kc._value, vc._value) for kc, vc in new_caches])
+            finally:
+                # never leak tracers into the eager Parameters
+                for p, v in zip(plist, saved):
+                    p._value = v
+
+        return jax.jit(step, donate_argnums=(2,))
+
     @no_grad()
-    def generate(self, input_ids, max_new_tokens=16, temperature=0.0):
-        """Greedy / temperature sampling (full-recompute decode; KV cache is a
-        round-2 optimization)."""
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0,
+                 use_cache=True):
+        """Greedy / temperature sampling.
+
+        use_cache=True (default) runs the compiled KV-cache decode: prefill
+        once, then one O(S_max)-attention step per token (the reference's
+        FusedMultiTransformer decode path). use_cache=False keeps the naive
+        full-recompute loop (useful as a parity oracle)."""
         ids = input_ids
+        if use_cache:
+            b, p_len = ids.shape[0], ids.shape[1]
+            s_max = p_len + max_new_tokens
+            caches = [(kc._value, vc._value)
+                      for kc, vc in self.init_kv_caches(b, s_max)]
+            params = [p._value for p in self.parameters()]
+            step = self._build_cached_step()
+            last, caches = step(params, ids._value, caches,
+                                jnp.asarray(0, jnp.int32))
+            for t in range(max_new_tokens):
+                nxt = self._sample(Tensor(last), temperature)
+                ids = manip.concat([ids, nxt.astype(ids.dtype)], axis=1)
+                if t == max_new_tokens - 1:
+                    break
+                last, caches = step(params, nxt._value, caches,
+                                    jnp.asarray(p_len + t, jnp.int32))
+            return ids
         for _ in range(max_new_tokens):
             logits = self.forward(ids)
-            last = logits[:, -1, :]
-            if temperature and temperature > 0.0:
-                probs = F.softmax(last / temperature, axis=-1)
-                from ..ops.random import multinomial
-                nxt = multinomial(probs, 1)
-            else:
-                from ..ops.math import argmax
-                nxt = manip.unsqueeze(argmax(last, axis=-1), -1)
+            nxt = self._sample(logits[:, -1, :], temperature)
             ids = manip.concat([ids, nxt.astype(ids.dtype)], axis=1)
         return ids
+
+    def _sample(self, last, temperature):
+        if temperature and temperature > 0.0:
+            probs = F.softmax(last / temperature, axis=-1)
+            from ..ops.random import multinomial
+            return multinomial(probs, 1)
+        from ..ops.math import argmax
+        return manip.unsqueeze(argmax(last, axis=-1), -1)
 
 
 # ---------------------------------------------------------------------------
