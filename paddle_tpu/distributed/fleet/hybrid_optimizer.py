@@ -24,6 +24,19 @@ class HybridParallelOptimizer:
             stage = strategy.sharding_configs.get("stage", 1)
             optimizer._shard_stage = stage
             optimizer._shard_axis = "sharding"
+        # gradient merge / accumulation (gradient_merge_optimizer.py analog):
+        # tag the optimizer so compiled steps (build_hybrid_train_step /
+        # compile_train_step) scan over micro-steps before one update
+        if strategy is not None:
+            k = 1
+            if getattr(strategy, "gradient_merge", False):
+                k = int(strategy.gradient_merge_configs.get("k_steps", 1))
+            pk = int(getattr(strategy, "pipeline_configs",
+                             {}).get("accumulate_steps", 1) or 1) \
+                if getattr(strategy, "pipeline", False) else 1
+            k = max(k, pk)
+            if k > 1:
+                optimizer._accumulate_steps = k
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)

@@ -362,7 +362,8 @@ def _call_with_params(layer, names, vals, fn):
 def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                             n_microbatches: int = 1, remat: bool = True,
                             amp: bool = False, schedule: str = "gpipe",
-                            n_virtual: int = 1):
+                            n_virtual: int = 1,
+                            accumulate_steps: Optional[int] = None):
     """Build a fully-compiled hybrid train step.
 
     The decoder blocks' params are stacked on a leading dim of size L and
@@ -374,6 +375,14 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
       'vpp' (interleaved virtual stages, n_virtual chunks per pp rank —
       PipelineParallelWithInterleave:1016 analog).
     Embedding / final norm / lm head run outside the pipeline in GSPMD.
+
+    accumulate_steps > 1 enables gradient merge (reference
+    fleet/meta_optimizers/gradient_merge_optimizer.py semantics): the batch is
+    split into that many micro-steps, grads accumulate across a lax.scan
+    (one live grad buffer), and the optimizer applies the averaged grad once.
+    Defaults to the optimizer's `_accumulate_steps` tag, set by
+    fleet.distributed_optimizer from DistributedStrategy.gradient_merge /
+    pipeline_configs["accumulate_steps"].
     Returns step(batch_dict) -> loss Tensor.
     """
     mesh = mesh if mesh is not None else mesh_mod.get_mesh()
@@ -601,6 +610,8 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
     base_opt = optimizer
     while hasattr(base_opt, "inner_opt"):
         base_opt = base_opt.inner_opt
+    if accumulate_steps is None:
+        accumulate_steps = int(getattr(base_opt, "_accumulate_steps", 1) or 1)
     _, opt_update = base_opt.functional_update()
 
     def init_state(tree):
@@ -634,11 +645,38 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
         opt_state = (shard_states(opt_state[0], outer_sh),
                      shard_states(opt_state[1], stacked_sh))
 
-    def pure_step(param_vals, opt_st, batch, lr, step, rng):
+    def loss_and_grads(param_vals, batch, rng):
         if schedule == "1f1b" and pp > 1:
-            loss, grads = loss_and_grads_1f1b(param_vals, batch, rng)
+            return loss_and_grads_1f1b(param_vals, batch, rng)
+        return jax.value_and_grad(loss_fn)(param_vals, batch, rng)
+
+    def pure_step(param_vals, opt_st, batch, lr, step, rng):
+        if accumulate_steps > 1:
+            k = accumulate_steps
+            micro = jax.tree_util.tree_map(
+                lambda v: v.reshape(k, v.shape[0] // k, *v.shape[1:]), batch)
+            if mesh is not None and mesh.shape.get("dp", 1) > 1:
+                micro = jax.tree_util.tree_map(
+                    lambda v: jax.lax.with_sharding_constraint(
+                        v, NamedSharding(mesh, PartitionSpec(
+                            None, "dp", *([None] * (v.ndim - 2))))), micro)
+
+            def body(acc, inp):
+                mb, i = inp
+                l, g = loss_and_grads(param_vals, mb,
+                                      jax.random.fold_in(rng, i))
+                acc_l, acc_g = acc
+                new_g = jax.tree_util.tree_map(lambda a, b: a + b, acc_g, g)
+                return (acc_l + l, new_g), None
+
+            zero_g = jax.tree_util.tree_map(jnp.zeros_like, param_vals)
+            (tot_l, tot_g), _ = jax.lax.scan(
+                body, (jnp.asarray(0.0, jnp.float32), zero_g),
+                (micro, jnp.arange(k)))
+            loss = tot_l / k
+            grads = jax.tree_util.tree_map(lambda g: g / k, tot_g)
         else:
-            loss, grads = jax.value_and_grad(loss_fn)(param_vals, batch, rng)
+            loss, grads = loss_and_grads(param_vals, batch, rng)
         clip = getattr(base_opt, "_grad_clip", None)
         if clip is not None:
             from ..nn.clip import ClipGradByGlobalNorm

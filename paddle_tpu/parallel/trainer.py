@@ -59,7 +59,8 @@ class TrainStep:
     """Callable train step holding device-side param/opt-state pytrees."""
 
     def __init__(self, model, loss_fn: Callable, optimizer, mesh=None,
-                 batch_spec=("dp",), loss_has_aux=False, remat: bool = False):
+                 batch_spec=("dp",), loss_has_aux=False, remat: bool = False,
+                 accumulate_steps: Optional[int] = None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -129,6 +130,12 @@ class TrainStep:
                 zip(self._param_shardings, self._opt_state)] \
                 if mesh is not None else None
 
+        if accumulate_steps is None:
+            accumulate_steps = int(getattr(base_opt, "_accumulate_steps", 1)
+                                   or getattr(optimizer, "_accumulate_steps", 1)
+                                   or 1)
+        self._accumulate_steps = max(int(accumulate_steps), 1)
+
         self._jitted = None
         self._grad_clip = getattr(base_opt, "_grad_clip", None)
         self._loss_scale = 1.0
@@ -141,15 +148,18 @@ class TrainStep:
         loss_fn = self.loss_fn
         clip = self._grad_clip
 
+        acc = self._accumulate_steps
+        mesh = self.mesh
+
         def pure_step(param_vals, opt_state, batch, lr, step, rng):
-            def loss_of(pv):
+            def loss_of(pv, mb, r):
                 saved = [p._value for p in params]
                 savedb = [b._value for b in buffers]
                 try:
                     for p, v in zip(params, pv):
                         p._value = v
-                    with gen.key_override(rng), no_grad():
-                        loss = loss_fn(model, batch)
+                    with gen.key_override(r), no_grad():
+                        loss = loss_fn(model, mb)
                 finally:
                     for p, v in zip(params, saved):
                         p._value = v
@@ -157,7 +167,28 @@ class TrainStep:
                         b._value = v
                 return loss._value if isinstance(loss, Tensor) else loss
 
-            loss_val, grads = jax.value_and_grad(loss_of)(param_vals)
+            if acc > 1:
+                # gradient merge: scan over micro-steps, one live grad buffer
+                micro = jax.tree_util.tree_map(
+                    lambda v: v.reshape(acc, v.shape[0] // acc, *v.shape[1:]),
+                    batch)
+
+                def body(carry, inp):
+                    mb, i = inp
+                    l, g = jax.value_and_grad(loss_of)(
+                        param_vals, mb, jax.random.fold_in(rng, i))
+                    cl, cg = carry
+                    return (cl + l, [a + b for a, b in zip(cg, g)]), None
+
+                zero_g = [jnp.zeros_like(v) for v in param_vals]
+                (tl, tg), _ = jax.lax.scan(
+                    body, (jnp.asarray(0.0, jnp.float32), zero_g),
+                    (micro, jnp.arange(acc)))
+                loss_val = tl / acc
+                grads = [g / acc for g in tg]
+            else:
+                loss_val, grads = jax.value_and_grad(loss_of)(
+                    param_vals, batch, rng)
 
             if clip is not None:
                 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue

@@ -1,0 +1,99 @@
+"""Gradient accumulation / merge (VERDICT r1 item 7).
+
+Reference semantics: fleet/meta_optimizers/gradient_merge_optimizer.py —
+accumulate k micro-step grads, apply the averaged grad once. Parity law:
+one update from batch B must equal one update from the same B split into k
+micro-steps (mean of equal-size means == global mean).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, build_hybrid_train_step
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.parallel.trainer import compile_train_step
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _data(cfg, batch=8, seq=8, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
+    return ids[:, :-1], ids[:, 1:]
+
+
+def _run_hybrid(cfg, ids, labels, acc, n_steps=3, mesh_shape=None):
+    mesh_mod.set_mesh(None)
+    P.seed(7)
+    model = LlamaForCausalLM(cfg)
+    if mesh_shape:
+        mesh_mod.init_mesh(mesh_shape)
+    opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = build_hybrid_train_step(model, opt, accumulate_steps=acc)
+    batch = {"input_ids": P.to_tensor(ids), "labels": P.to_tensor(labels)}
+    losses = [float(step(batch).numpy()) for _ in range(n_steps)]
+    import jax
+    leaf = np.asarray(jax.tree_util.tree_leaves(step.state["params"])[0])
+    return losses, leaf
+
+
+def test_hybrid_step_accumulation_parity():
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=2, inter=64)
+    ids, labels = _data(cfg, batch=8)
+    l1, p1 = _run_hybrid(cfg, ids, labels, acc=1)
+    l4, p4 = _run_hybrid(cfg, ids, labels, acc=4)
+    np.testing.assert_allclose(l4, l1, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(p4, p1, rtol=1e-4, atol=1e-5)
+
+
+def test_hybrid_step_accumulation_under_dp_mesh():
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=2, inter=64)
+    ids, labels = _data(cfg, batch=16)
+    l1, _ = _run_hybrid(cfg, ids, labels, acc=1, mesh_shape={"dp": 4})
+    l2, _ = _run_hybrid(cfg, ids, labels, acc=2, mesh_shape={"dp": 4})
+    np.testing.assert_allclose(l2, l1, rtol=1e-4, atol=1e-5)
+
+
+def test_compile_train_step_accumulation_parity():
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=2, inter=64)
+    ids, labels = _data(cfg, batch=8)
+
+    def run(acc):
+        mesh_mod.set_mesh(None)
+        P.seed(11)
+        model = LlamaForCausalLM(cfg)
+        opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        step = compile_train_step(
+            model, lambda m, b: m.compute_loss(b["input_ids"], b["labels"]),
+            opt, accumulate_steps=acc)
+        batch = {"input_ids": P.to_tensor(ids), "labels": P.to_tensor(labels)}
+        return [float(step(batch).numpy()) for _ in range(3)]
+
+    np.testing.assert_allclose(run(4), run(1), rtol=1e-4, atol=1e-5)
+
+
+def test_strategy_accumulate_steps_is_load_bearing():
+    """DistributedStrategy.gradient_merge flows through distributed_optimizer
+    into the compiled step (the dead-config finding from VERDICT r1)."""
+    from paddle_tpu.distributed.fleet.distributed_strategy import DistributedStrategy
+    from paddle_tpu.distributed.fleet.hybrid_optimizer import HybridParallelOptimizer
+
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=2, inter=64)
+    model = LlamaForCausalLM(cfg)
+    s = DistributedStrategy()
+    s.gradient_merge = True
+    s.gradient_merge_configs["k_steps"] = 4
+    opt = HybridParallelOptimizer(
+        P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters()),
+        hcg=None, strategy=s)
+    assert opt.inner_opt._accumulate_steps == 4
+
+    # and build_hybrid_train_step picks the tag up as its default
+    ids, labels = _data(cfg, batch=8)
+    step = build_hybrid_train_step(model, opt)
+    batch = {"input_ids": P.to_tensor(ids), "labels": P.to_tensor(labels)}
+    assert np.isfinite(float(step(batch).numpy()))
