@@ -14,16 +14,29 @@ from ...nn.clip import ClipGradByGlobalNorm
 from ...optimizer.optimizer import Optimizer
 
 
+def _strategy_stage(strategy):
+    """The ZeRO stage a DistributedStrategy requests (0 = sharding off)."""
+    if strategy is None or not getattr(strategy, "sharding", False):
+        return 0
+    return int(strategy.sharding_configs.get("stage", 1))
+
+
 class HybridParallelOptimizer:
     def __init__(self, optimizer: Optimizer, hcg, strategy):
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
+        # tags live on the BASE optimizer: `optimizer` may itself be a
+        # sharding wrapper, and both step() here and the compiled trainers
+        # unwrap before reading
+        base = optimizer
+        while hasattr(base, "inner_opt"):
+            base = base.inner_opt
         # reference moves the clip up to hybrid scope; global view: keep as-is
-        if strategy is not None and getattr(strategy, "sharding", False):
-            stage = strategy.sharding_configs.get("stage", 1)
-            optimizer._shard_stage = stage
-            optimizer._shard_axis = "sharding"
+        stage = _strategy_stage(strategy)
+        if stage:
+            base._shard_stage = stage
+            base._shard_axis = "sharding"
         # gradient merge / accumulation (gradient_merge_optimizer.py analog):
         # tag the optimizer so compiled steps (build_hybrid_train_step /
         # compile_train_step) scan over micro-steps before one update
@@ -36,16 +49,38 @@ class HybridParallelOptimizer:
                 if getattr(strategy, "pipeline", False) else 1
             k = max(k, pk)
             if k > 1:
-                optimizer._accumulate_steps = k
+                base._accumulate_steps = k
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
 
     def step(self):
-        self._inner_opt.step()
+        # eager ZeRO on the primary fleet path: honor the sharding stage the
+        # strategy tagged (stage 1: shard opt states; stage 2: scatter grads,
+        # shard states, re-gather params). Compiled steps read the same tags.
+        inner = self._inner_opt
+        base = inner
+        while hasattr(base, "inner_opt"):
+            base = base.inner_opt
+        stage = getattr(base, "_shard_stage", 0)
+        from .meta_parallel.sharding_optimizer import (
+            _mesh_with_axis, _shard_opt_states, _stage2_eager_step)
+        if stage == 2 and _mesh_with_axis() is not None:
+            _stage2_eager_step(base)
+            return
+        inner.step()
+        if stage == 1:
+            mesh = _mesh_with_axis()
+            if mesh is not None:
+                _shard_opt_states(base, mesh)
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
-        return self._inner_opt.minimize(loss)
+        from ...static import framework as _static_fw
+        if _static_fw.in_static_mode():
+            return self._inner_opt.minimize(loss)
+        loss.backward()
+        self.step()  # keeps the eager ZeRO path on the minimize entry point
+        self.clear_grad()
 
     def clear_grad(self, *a, **k):
         self._inner_opt.clear_grad(*a, **k)

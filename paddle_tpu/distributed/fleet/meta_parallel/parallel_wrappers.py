@@ -37,7 +37,16 @@ class TensorParallel(MetaParallelBase):
 
 
 class ShardingParallel(MetaParallelBase):
-    pass
+    """sharding wrapper (sharding_parallel.py analog): stage 3 shards the
+    param buffers themselves at wrap time; stages 1/2 act through the
+    optimizer wrapper (opt-state/grad resharding in sharding_optimizer.py)."""
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        from ..hybrid_optimizer import _strategy_stage
+        if _strategy_stage(strategy) >= 3:
+            from .sharding_optimizer import shard_layer_params
+            shard_layer_params(layers)
 
 
 class SegmentParallel(MetaParallelBase):

@@ -7,13 +7,18 @@ Analogs:
 - facade:  group_sharded_parallel (distributed/sharding/group_sharded.py:37)
 
 TPU-native mapping: the reference manually partitions params/grads/opt-states
-across ranks and re-gathers with broadcasts/hooks. Under GSPMD the same memory
-win is a SHARDING SPEC: stage 1/2 shard optimizer state (and grads) over the
-'sharding' axis, stage 3 shards the parameters themselves (≈FSDP). The
-compiled train step (parallel/trainer.py) reads `optimizer._shard_stage` and
-annotates the corresponding pytrees; XLA inserts the reduce-scatter /
-all-gather pairs the reference implements as reduce-to-owner + broadcast.
-The eager wrapper keeps the reference API shape for porting.
+across ranks and re-gathers with broadcasts/hooks. Here the same memory win is
+a SHARDING SPEC, honored in BOTH worlds:
+
+- compiled train steps (parallel/trainer.py, models/llama.py) read
+  `optimizer._shard_stage` and annotate the param/grad/opt-state pytrees so
+  XLA inserts the reduce-scatter / all-gather pairs;
+- eager mode REALLY shards device buffers (VERDICT r1 item 6): stage 1/2
+  `jax.device_put` optimizer states (and, for stage 2, grads) with a spec
+  over the 'sharding' axis so each device holds 1/n of the state; stage 3
+  device_puts the parameters themselves at wrap time (≈FSDP) — per-op GSPMD
+  re-gathers on access, which is the XLA analog of the reference's fwd
+  pre/post all-gather hooks (group_sharded_stage3.py:59).
 """
 from __future__ import annotations
 
@@ -24,8 +29,76 @@ from ....optimizer.optimizer import Optimizer
 SHARDING_AXIS = "sharding"
 
 
+def _mesh_with_axis(axis=SHARDING_AXIS):
+    """The active mesh, if it has a non-trivial sharding axis; else None."""
+    from ....parallel import mesh as mesh_mod
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return None
+    return mesh
+
+
+def _sharded_put(value, mesh, axis=SHARDING_AXIS, base_spec=None):
+    """device_put `value` sharded over `axis` along its largest divisible dim
+    (on top of any existing TP spec in base_spec). Returns value unchanged if
+    nothing is divisible."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from ....parallel.trainer import _zero_state_spec
+    spec = _zero_state_spec(base_spec or PartitionSpec(), value.shape, axis, mesh)
+    if not any(s is not None for s in spec):
+        return value
+    return jax.device_put(value, NamedSharding(mesh, spec))
+
+
+def _replicated_put(p, mesh):
+    """Re-gather a param to its at-rest layout: its TP spec (if any), with the
+    sharding axis dropped — the eager analog of the reference's
+    broadcast-params-back (dygraph_sharding_optimizer.py:283,320)."""
+    import jax
+    from jax.sharding import NamedSharding
+    from ....parallel.trainer import _param_sharding_spec
+    return jax.device_put(p._value, NamedSharding(mesh, _param_sharding_spec(p, mesh)))
+
+
+def _shard_opt_states(optim: Optimizer, mesh):
+    """Reshard every optimizer-state leaf over the sharding axis in place,
+    preserving each param's own TP spec (states follow their param layout,
+    matching the compiled path's base spec, trainer.py:120)."""
+    from ....parallel.trainer import _param_sharding_spec
+    by_id = {id(p): p for p in optim._params}
+    for pid, state in optim._states.items():
+        p = by_id.get(pid)
+        base = _param_sharding_spec(p, mesh) if p is not None else None
+        optim._states[pid] = {
+            k: (_sharded_put(v, mesh, base_spec=base)
+                if hasattr(v, "ndim") and v.ndim >= 1 else v)
+            for k, v in state.items()}
+
+
+def _stage2_eager_step(optim: Optimizer):
+    """One eager stage-2 step: scatter grads over the sharding axis (the
+    eager analog of reduce-to-owner, group_sharded_stage2.py:46), update,
+    shard the states, re-gather params to their at-rest layout."""
+    from ....parallel.trainer import _param_sharding_spec
+    mesh = _mesh_with_axis()
+    if mesh is not None:
+        for p in optim._params:
+            if p.grad is not None and p.grad._value.ndim >= 1:
+                p.grad._value = _sharded_put(
+                    p.grad._value, mesh, base_spec=_param_sharding_spec(p, mesh))
+    optim.step()
+    if mesh is not None:
+        _shard_opt_states(optim, mesh)
+        for p in optim._params:
+            if not p.stop_gradient and p._value.ndim >= 1:
+                p._value = _replicated_put(p, mesh)
+
+
 class DygraphShardingOptimizer:
-    """Stage-1 wrapper: optimizer states sharded over the sharding axis."""
+    """Stage-1 wrapper: optimizer states sharded over the sharding axis —
+    in the compiled step via state specs, in eager by resharding the state
+    buffers after each update."""
 
     def __init__(self, optimizer: Optimizer, hcg=None):
         self._inner_opt = optimizer
@@ -37,6 +110,14 @@ class DygraphShardingOptimizer:
 
     def step(self):
         self._inner_opt.step()
+        mesh = _mesh_with_axis()
+        if mesh is not None:
+            _shard_opt_states(self._inner_opt, mesh)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
 
     def clear_grad(self, *a, **k):
         self._inner_opt.clear_grad(*a, **k)
@@ -47,6 +128,10 @@ class DygraphShardingOptimizer:
 
 
 class GroupShardedOptimizerStage2:
+    """Stage-2 optimizer: grads reduce-scattered (eager: resharded) over the
+    sharding axis before the update; opt states live sharded; params are
+    re-gathered to their at-rest layout after the update."""
+
     def __init__(self, params, optim: Optimizer, group=None, offload=False,
                  device="tpu", **kw):
         self._optim = optim
@@ -57,7 +142,19 @@ class GroupShardedOptimizerStage2:
         return getattr(self._optim, item)
 
     def step(self):
-        self._optim.step()
+        _stage2_eager_step(self._optim)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self, *a, **k):
+        self._optim.clear_grad(*a, **k)
+
+    @property
+    def inner_opt(self):
+        return self._optim
 
 
 class GroupShardedStage2:
@@ -79,10 +176,41 @@ class GroupShardedStage2:
         return self._layer(*args, **kwargs)
 
 
+def shard_layer_params(layer):
+    """Annotate every trainable param for FSDP-style sharding along its
+    largest dim, then (if a sharding mesh is live) device_put the buffers so
+    each device holds 1/n param bytes."""
+    mesh0 = _mesh_with_axis()
+    n = mesh0.shape[SHARDING_AXIS] if mesh0 is not None else 1
+    for p in layer.parameters():
+        if p._sharding is None and p.ndim >= 1:
+            dims = list(p.shape)
+            # largest dim divisible by the axis size (spec application also
+            # re-checks divisibility, so a later mesh of another size is safe)
+            cand = [i for i in range(len(dims)) if dims[i] % n == 0]
+            if not cand:
+                continue
+            big = int(max(cand, key=lambda i: dims[i]))
+            spec = [None] * len(dims)
+            spec[big] = SHARDING_AXIS
+            p._sharding = tuple(spec)
+    if mesh0 is not None:
+        import jax
+        from jax.sharding import NamedSharding
+        from ....parallel.trainer import _param_sharding_spec
+        for p in layer.parameters():
+            if p.ndim >= 1 and not isinstance(p._value, jax.core.Tracer):
+                spec = _param_sharding_spec(p, mesh0)
+                if any(s is not None for s in spec):
+                    p._value = jax.device_put(
+                        p._value, NamedSharding(mesh0, spec))
+
+
 class GroupShardedStage3:
-    """Stage-3 (FSDP): parameters themselves sharded; re-gather at use is the
-    all-gather XLA inserts from the param spec (replaces fwd pre/post hooks,
-    group_sharded_stage3.py:59)."""
+    """Stage-3 (FSDP): parameters themselves sharded. At wrap time each param
+    buffer is device_put with its spec, so eager steps hold 1/n param bytes;
+    re-gather at use is the all-gather GSPMD inserts from the spec (replaces
+    the fwd pre/post hooks, group_sharded_stage3.py:59)."""
 
     def __init__(self, layer, optimizer, group=None, sync_buffers=False,
                  device="tpu", segment_size=2 ** 20, offload=False, **kw):
@@ -90,15 +218,7 @@ class GroupShardedStage3:
         self._optimizer = optimizer
         optimizer._shard_stage = 3
         optimizer._shard_axis = SHARDING_AXIS
-        # annotate every trainable param for FSDP-style sharding along its
-        # largest dim
-        for p in layer.parameters():
-            if p._sharding is None and p.ndim >= 1:
-                dims = list(p.shape)
-                big = int(max(range(len(dims)), key=lambda i: dims[i]))
-                spec = [None] * len(dims)
-                spec[big] = SHARDING_AXIS
-                p._sharding = tuple(spec)
+        shard_layer_params(layer)
 
     def __call__(self, *args, **kwargs):
         return self._layer(*args, **kwargs)

@@ -28,16 +28,41 @@ def _param_sharding_spec(p, mesh):
     spec = getattr(p, "_sharding", None)
     if spec is None:
         return PartitionSpec()
+    shape = getattr(p, "shape", None) or [None] * len(spec)
     clean = []
-    for s in spec:
+    for i, s in enumerate(spec):
+        dim = shape[i] if i < len(shape) else None
+
+        def fits(axes):
+            n = 1
+            for a in axes:
+                n *= mesh.shape[a]
+            return dim is None or (dim % n == 0)
+
         if s is None:
             clean.append(None)
         elif isinstance(s, tuple):
             kept = tuple(a for a in s if a in mesh.axis_names and mesh.shape[a] > 1)
-            clean.append(kept if kept else None)
+            if kept and not fits(kept):
+                _warn_dropped_spec(p, s, dim)
+            clean.append(kept if (kept and fits(kept)) else None)
         else:
-            clean.append(s if (s in mesh.axis_names and mesh.shape[s] > 1) else None)
+            live = s in mesh.axis_names and mesh.shape[s] > 1
+            if live and not fits((s,)):
+                _warn_dropped_spec(p, s, dim)
+            clean.append(s if (live and fits((s,))) else None)
     return PartitionSpec(*clean)
+
+
+def _warn_dropped_spec(p, axis, dim):
+    """This jax rejects uneven device_put shardings, so a spec whose mesh
+    extent doesn't divide the dim is replicated instead of crashing — but
+    say so, since replication costs per-device memory."""
+    import logging
+    logging.getLogger("paddle_tpu").warning(
+        "sharding axis %r dropped for param of shape %s: dim %s not divisible "
+        "by the mesh axis extent; the param is replicated on that dim",
+        axis, tuple(getattr(p, "shape", ())), dim)
 
 
 def _zero_state_spec(param_spec: PartitionSpec, shape, axis, mesh):

@@ -1,0 +1,192 @@
+"""Eager-mode ZeRO stages really shard device buffers (VERDICT r1 item 6).
+
+The reference's memory win (group_sharded_optimizer_stage2.py:53,
+group_sharded_stage3.py:59) is measured here directly: after wrapping, the
+max per-device buffer bytes must shrink ~n× for the sharded pytrees, and
+training must still converge with loss parity vs the unwrapped run.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.parallel import mesh as mesh_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _mlp(seed=0, d=64):
+    P.seed(seed)
+    return nn.Sequential(nn.Linear(d, 4 * d), nn.GELU(), nn.Linear(4 * d, d))
+
+
+def _per_device_bytes(arr):
+    by_dev = {}
+    for s in arr.addressable_shards:
+        by_dev[s.device] = by_dev.get(s.device, 0) + s.data.nbytes
+    return max(by_dev.values())
+
+
+def _train(model, opt, steps=5, d=64, seed=3):
+    rng = np.random.RandomState(seed)
+    x = P.to_tensor(rng.randn(16, d).astype("float32"))
+    y = P.to_tensor(rng.randn(16, d).astype("float32"))
+    losses = []
+    for _ in range(steps):
+        loss = nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def test_stage3_params_actually_sharded():
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding_optimizer import (
+        GroupShardedStage3)
+    d = 64
+    model_ref = _mlp(seed=1, d=d)
+    opt_ref = P.optimizer.SGD(learning_rate=0.05, parameters=model_ref.parameters())
+    ref_losses = _train(model_ref, opt_ref, d=d)
+
+    mesh_mod.init_mesh({"sharding": 8})
+    model = _mlp(seed=1, d=d)
+    full_bytes = {id(p): p._value.nbytes for p in model.parameters()}
+    opt = P.optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
+    wrapped = GroupShardedStage3(model, opt)
+
+    # weight matrices hold 1/8 of their bytes per device after wrapping
+    for p in model.parameters():
+        if p.ndim == 2:
+            assert _per_device_bytes(p._value) * 8 <= full_bytes[id(p)] + 1, p.shape
+
+    losses = _train(wrapped, opt, d=d)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+    # params STAY sharded across update steps
+    for p in model.parameters():
+        if p.ndim == 2:
+            assert _per_device_bytes(p._value) * 8 <= full_bytes[id(p)] + 1
+
+
+def test_stage1_opt_states_sharded():
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding_optimizer import (
+        DygraphShardingOptimizer)
+    mesh_mod.init_mesh({"sharding": 8})
+    model = _mlp(seed=2)
+    opt = DygraphShardingOptimizer(
+        P.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters()))
+    losses = _train(model, opt)
+    assert losses[-1] < losses[0]
+    inner = opt.inner_opt
+    checked = 0
+    for state in inner._states.values():
+        for v in state.values():
+            if hasattr(v, "ndim") and v.ndim == 2:
+                assert _per_device_bytes(v) * 8 <= v.nbytes + 1
+                checked += 1
+    assert checked > 0
+
+
+def test_stage3_non_divisible_dims_stay_replicated():
+    """A (63, 63) weight is not divisible by 8: wrap must not crash, the
+    param just stays replicated (reference pads; we keep it whole)."""
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding_optimizer import (
+        GroupShardedStage3)
+    mesh_mod.init_mesh({"sharding": 8})
+    P.seed(5)
+    model = nn.Sequential(nn.Linear(63, 63), nn.GELU(), nn.Linear(63, 63))
+    opt = P.optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
+    wrapped = GroupShardedStage3(model, opt)
+    losses = _train(wrapped, opt, d=63)
+    assert losses[-1] < losses[0]
+
+
+def test_stage1_states_keep_tp_spec_on_hybrid_mesh():
+    """On a {'sharding': 4, 'mp': 2} mesh, an mp-sharded weight's opt state
+    must stay mp-sharded after the eager stage-1 reshard (review finding:
+    base_spec was dropped, replicating states across mp)."""
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding_optimizer import (
+        DygraphShardingOptimizer)
+    mesh_mod.init_mesh({"sharding": 4, "mp": 2})
+    P.seed(6)
+    model = _mlp(seed=6)
+    # hand-annotate a TP spec on the first weight (column-parallel style)
+    w = list(model.parameters())[0]
+    w._sharding = (None, "mp")
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = mesh_mod.get_mesh()
+    w._value = jax.device_put(w._value, NamedSharding(mesh, PartitionSpec(None, "mp")))
+    opt = DygraphShardingOptimizer(
+        P.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters()))
+    _train(model, opt)
+    st = opt.inner_opt._states[id(w)]
+    m_spec = str(next(v for v in st.values()
+                      if hasattr(v, "ndim") and v.ndim == 2).sharding.spec)
+    assert "mp" in m_spec, m_spec
+    # and per-device bytes shrink by BOTH axes (mp × sharding = 8)
+    v = next(v for v in st.values() if hasattr(v, "ndim") and v.ndim == 2)
+    assert _per_device_bytes(v) * 8 <= v.nbytes + 1
+
+
+def test_fleet_path_stage2_shards_eagerly():
+    """strategy.sharding stage 2 through fleet.distributed_optimizer (the
+    primary API path) must shard opt states in eager mode."""
+    from paddle_tpu.distributed.fleet.distributed_strategy import DistributedStrategy
+    from paddle_tpu.distributed.fleet.hybrid_optimizer import HybridParallelOptimizer
+    mesh_mod.init_mesh({"sharding": 8})
+    model = _mlp(seed=7)
+    s = DistributedStrategy()
+    s.sharding = True
+    s.sharding_configs = {"stage": 2, "degree": 8}
+    opt = HybridParallelOptimizer(
+        P.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters()),
+        hcg=None, strategy=s)
+    losses = _train(model, opt)
+    assert losses[-1] < losses[0]
+    inner = opt.inner_opt
+    checked = 0
+    for state in inner._states.values():
+        for v in state.values():
+            if hasattr(v, "ndim") and v.ndim == 2:
+                assert _per_device_bytes(v) * 8 <= v.nbytes + 1
+                checked += 1
+    assert checked > 0
+    # params full at rest
+    for p in model.parameters():
+        if p.ndim == 2:
+            assert _per_device_bytes(p._value) == p._value.nbytes
+
+
+def test_stage2_grads_and_states_sharded_params_full():
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding_optimizer import (
+        group_sharded_parallel)
+    d = 64
+    model_ref = _mlp(seed=4, d=d)
+    opt_ref = P.optimizer.AdamW(learning_rate=1e-3,
+                                parameters=model_ref.parameters())
+    ref_losses = _train(model_ref, opt_ref, d=d)
+
+    mesh_mod.init_mesh({"sharding": 8})
+    model = _mlp(seed=4, d=d)
+    opt = P.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    model2, opt2, _ = group_sharded_parallel(model, opt, level="os_g")
+    losses = _train(model2, opt2, d=d)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+
+    inner = opt2.inner_opt
+    checked = 0
+    for state in inner._states.values():
+        for v in state.values():
+            if hasattr(v, "ndim") and v.ndim == 2:
+                assert _per_device_bytes(v) * 8 <= v.nbytes + 1
+                checked += 1
+    assert checked > 0
+    # stage-2 params remain FULL per device (replicated at rest)
+    for p in model.parameters():
+        if p.ndim == 2:
+            assert _per_device_bytes(p._value) == p._value.nbytes
