@@ -1,3 +1,5 @@
-"""paddle_tpu.incubate — incubating APIs (asp 2:4 sparsity, nn fused ops
-re-exports)."""
+"""paddle_tpu.incubate — incubating APIs: asp (2:4 sparsity) and nn (fused
+transformer layers/functionals, incl. fused_rotary_position_embedding and
+masked_multihead_attention decode)."""
 from . import asp  # noqa: F401
+from . import nn  # noqa: F401

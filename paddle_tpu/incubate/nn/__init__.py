@@ -1,0 +1,19 @@
+"""paddle_tpu.incubate.nn — fused transformer layers + functionals
+(reference: python/paddle/incubate/nn/)."""
+from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = [
+    "functional",
+    "FusedBiasDropoutResidualLayerNorm",
+    "FusedFeedForward",
+    "FusedMultiHeadAttention",
+    "FusedMultiTransformer",
+    "FusedTransformerEncoderLayer",
+]

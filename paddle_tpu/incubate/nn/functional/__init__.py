@@ -1,0 +1,41 @@
+"""paddle_tpu.incubate.nn.functional — fused transformer functionals.
+
+Analog of python/paddle/incubate/nn/functional/ (fused_transformer.py:32
+fused_feedforward, :465 fused_multi_head_attention, :873
+fused_multi_transformer; fused_rotary_position_embedding;
+masked_multihead_attention). On TPU "fused" means ONE traced jax function per
+op — XLA fuses the elementwise chain into the matmuls, and the attention core
+rides the same Pallas/XLA path as nn.functional.scaled_dot_product_attention.
+"""
+from .fused_transformer import (
+    fused_bias_dropout_residual_layer_norm,
+    fused_dropout_add,
+    fused_feedforward,
+    fused_layer_norm,
+    fused_linear,
+    fused_linear_activation,
+    fused_matmul_bias,
+    fused_multi_head_attention,
+    fused_multi_transformer,
+    fused_rms_norm,
+)
+from .fused_rotary_position_embedding import fused_rotary_position_embedding
+from .masked_multihead_attention import masked_multihead_attention
+
+fused_attention = fused_multi_head_attention
+
+__all__ = [
+    "fused_attention",
+    "fused_bias_dropout_residual_layer_norm",
+    "fused_dropout_add",
+    "fused_feedforward",
+    "fused_layer_norm",
+    "fused_linear",
+    "fused_linear_activation",
+    "fused_matmul_bias",
+    "fused_multi_head_attention",
+    "fused_multi_transformer",
+    "fused_rms_norm",
+    "fused_rotary_position_embedding",
+    "masked_multihead_attention",
+]

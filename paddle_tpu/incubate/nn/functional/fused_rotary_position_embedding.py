@@ -1,0 +1,113 @@
+"""fused_rotary_position_embedding (reference:
+python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py —
+the exported-op form of the rope the llama family fuses inline).
+
+Layout [B, S, H, D]. use_neox_rotary_style=True rotates half-blocks
+(x[..., :D/2], x[..., D/2:]); False interleaves even/odd lanes (GPT-J style,
+what models/llama.py uses). sin/cos default to the 10000-theta schedule;
+position_ids gathers per-example positions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.dispatch import apply
+
+__all__ = ["fused_rotary_position_embedding"]
+
+
+def _default_sincos(s, d, dtype, theta=10000.0):
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    pos = jnp.arange(s, dtype=jnp.float32)
+    freqs = jnp.outer(pos, inv)  # [S, D/2]
+    return jnp.sin(freqs), jnp.cos(freqs)
+
+
+def _rot_one(x, sin, cos, neox):
+    # x [B,S,H,D]; sin/cos [S, D/2] fp32
+    sin = sin[None, :, None, :]
+    cos = cos[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    if neox:
+        d2 = x.shape[-1] // 2
+        x1, x2 = xf[..., :d2], xf[..., d2:]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.concatenate([o1, o2], axis=-1)
+    else:
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    rotary_theta=10000.0):
+    """Apply rotary embeddings to q (and k, v when given). Returns a tuple
+    (q, k, v) with None for absent inputs, matching the reference API."""
+    have = [t for t in (q, k, v) if t is not None]
+    n_k = 1 + (k is not None) + (v is not None)
+    has_sin = sin is not None
+    has_cos = cos is not None
+    has_pos = position_ids is not None
+
+    def f(*args):
+        ts = list(args[:len(have)])
+        rest = list(args[len(have):])
+        s_ = rest.pop(0) if has_sin else None
+        c_ = rest.pop(0) if has_cos else None
+        pids = rest.pop(0) if has_pos else None
+        S, D = ts[0].shape[1], ts[0].shape[-1]
+        if s_ is None or c_ is None:
+            s_, c_ = _default_sincos(S, D, ts[0].dtype, rotary_theta)
+        else:
+            s_ = jnp.asarray(s_, jnp.float32).reshape(-1, D)[..., : D // 2] \
+                if s_.shape[-1] == D else jnp.asarray(s_, jnp.float32).reshape(-1, D // 2)
+            c_ = jnp.asarray(c_, jnp.float32).reshape(-1, D)[..., : D // 2] \
+                if c_.shape[-1] == D else jnp.asarray(c_, jnp.float32).reshape(-1, D // 2)
+        if pids is not None:
+            if has_sin and has_cos:
+                # user table: gather rows -> [B, S, D/2]
+                s_b = s_[pids]
+                c_b = c_[pids]
+            else:
+                # no table: compute angles directly from the positions, so
+                # any position value works (decode steps past S included)
+                inv = 1.0 / (rotary_theta ** (
+                    jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+                ang = pids.astype(jnp.float32)[..., None] * inv  # [B,S,D/2]
+                s_b = jnp.sin(ang)
+                c_b = jnp.cos(ang)
+            outs = []
+            for t in ts:
+                xf = t.astype(jnp.float32)
+                sb = s_b[:, :, None, :]
+                cb = c_b[:, :, None, :]
+                if use_neox_rotary_style:
+                    d2 = t.shape[-1] // 2
+                    x1, x2 = xf[..., :d2], xf[..., d2:]
+                    out = jnp.concatenate([x1 * cb - x2 * sb,
+                                           x2 * cb + x1 * sb], axis=-1)
+                else:
+                    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+                    out = jnp.stack([x1 * cb - x2 * sb, x2 * cb + x1 * sb],
+                                    axis=-1).reshape(t.shape)
+                outs.append(out.astype(t.dtype))
+            return tuple(outs) if len(outs) > 1 else outs[0]
+        outs = [_rot_one(t, s_, c_, use_neox_rotary_style) for t in ts]
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    extra = [t for t in (sin, cos, position_ids) if t is not None]
+    res = apply(f, *have, *extra, op_name="fused_rotary_position_embedding")
+    if len(have) == 1:
+        res = [res]
+    out = []
+    it = iter(res)
+    for t in (q, k, v):
+        out.append(next(it) if t is not None else None)
+    return tuple(out)

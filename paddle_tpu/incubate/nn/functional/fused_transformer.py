@@ -1,0 +1,461 @@
+"""Fused transformer functionals (reference:
+python/paddle/incubate/nn/functional/fused_transformer.py).
+
+Each op is one `apply()`-traced jax function: the elementwise epilogue
+(bias, dropout, residual, norm) fuses into the matmul under XLA, which is
+the TPU analog of the reference's hand-fused CUDA kernels. All ops are
+differentiable through the eager tape and usable under to_static/jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core import generator as gen
+from ....ops.dispatch import apply
+
+_ACT = {
+    "relu": jax.nn.relu,
+    # exact (erf) gelu, matching nn.functional.gelu's default
+    "gelu": lambda v: jax.nn.gelu(v, approximate=False),
+    "silu": jax.nn.silu,
+}
+
+
+def _dropout(x, rate, key, training, mode="upscale_in_train"):
+    if rate == 0.0:
+        return x
+    if not training or key is None:
+        # downscale_in_infer: scale at INFERENCE (reference mode semantics)
+        if mode == "downscale_in_infer":
+            return x * (1.0 - rate)
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+    return jnp.where(keep, x, jnp.zeros_like(x))
+
+
+def _layer_norm(x, scale, bias, eps):
+    mu = jnp.mean(x.astype(jnp.float32), axis=-1, keepdims=True)
+    var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
+    y = (x.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _rms_norm(x, scale, eps):
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    y = x.astype(jnp.float32) * jax.lax.rsqrt(ms + eps)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     residual=None, bias=None, **kw):
+    """LayerNorm with optional pre-add of bias+residual (one fused op)."""
+    def f(v, *rest):
+        it = iter(rest)
+        w = next(it) if norm_weight is not None else None
+        b = next(it) if norm_bias is not None else None
+        r = next(it) if residual is not None else None
+        bb = next(it) if bias is not None else None
+        if bb is not None:
+            v = v + bb
+        if r is not None:
+            v = v + r
+        return _layer_norm(v, w, b, epsilon)
+    args = [a for a in (norm_weight, norm_bias, residual, bias) if a is not None]
+    return apply(f, x, *args, op_name="fused_layer_norm")
+
+
+def fused_rms_norm(x, norm_weight=None, epsilon=1e-6, residual=None, bias=None,
+                   **kw):
+    def f(v, *rest):
+        it = iter(rest)
+        w = next(it) if norm_weight is not None else None
+        r = next(it) if residual is not None else None
+        bb = next(it) if bias is not None else None
+        if bb is not None:
+            v = v + bb
+        if r is not None:
+            v = v + r
+        return _rms_norm(v, w, epsilon)
+    args = [a for a in (norm_weight, residual, bias) if a is not None]
+    return apply(f, x, *args, op_name="fused_rms_norm")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul + bias epilogue (reference fused_matmul_bias, cublasLt epilogue;
+    on TPU the MXU matmul absorbs the bias add via XLA fusion)."""
+    def f(a, b, *mb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if mb:
+            out = out + mb[0]
+        return out
+    if bias is not None:
+        return apply(f, x, y, bias, op_name="fused_matmul_bias")
+    return apply(f, x, y, op_name="fused_matmul_bias")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    act = _ACT.get(activation or "none", None)
+    out = fused_matmul_bias(x, y, bias, transpose_x=trans_x, transpose_y=trans_y)
+    if act is None:
+        return out
+    return apply(act, out, op_name=f"fused_{activation}")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one op (reference fused_dropout_add)."""
+    key = gen.next_key() if (training and p > 0.0) else None
+
+    def f(a, b):
+        return _dropout(a, p, key, training, mode) + b
+    return apply(f, x, y, op_name="fused_dropout_add")
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None, dropout_rate=0.5,
+        ln_epsilon=1e-5, training=True, mode="upscale_in_train", name=None):
+    """layer_norm(residual + dropout(x + bias))  (fused_transformer.py:275)."""
+    key = gen.next_key() if (training and dropout_rate > 0.0) else None
+
+    def f(v, r, *rest):
+        it = iter(rest)
+        bb = next(it) if bias is not None else None
+        w = next(it) if ln_scale is not None else None
+        b2 = next(it) if ln_bias is not None else None
+        if bb is not None:
+            v = v + bb
+        v = _dropout(v, dropout_rate, key, training, mode)
+        return _layer_norm(r + v, w, b2, ln_epsilon)
+    args = [a for a in (bias, ln_scale, ln_bias) if a is not None]
+    return apply(f, x, residual, *args, op_name="fused_bias_dropout_residual_ln")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, add_residual=True,
+                      name=None):
+    """residual + dropout2(linear2(dropout1(act(linear1(maybe_ln(x))))))
+    (fused_transformer.py:32 pseudo-code), post-LN when not pre_layer_norm."""
+    act = _ACT.get(activation, jax.nn.relu)
+    k1 = gen.next_key() if (training and dropout1_rate > 0.0) else None
+    k2 = gen.next_key() if (training and dropout2_rate > 0.0) else None
+
+    named = {"w1": linear1_weight, "w2": linear2_weight, "b1": linear1_bias,
+             "b2": linear2_bias, "ln1w": ln1_scale, "ln1b": ln1_bias,
+             "ln2w": ln2_scale, "ln2b": ln2_bias}
+    keys = [k for k, v in named.items() if v is not None]
+    vals = [named[k] for k in keys]
+
+    def f(v, *rest):
+        d = dict(zip(keys, rest))
+        residual = v
+        out = _layer_norm(v, d.get("ln1w"), d.get("ln1b"), ln1_epsilon) \
+            if pre_layer_norm else v
+        out = out @ d["w1"]
+        if "b1" in d:
+            out = out + d["b1"]
+        out = act(out)
+        out = _dropout(out, dropout1_rate, k1, training, mode)
+        out = out @ d["w2"]
+        if "b2" in d:
+            out = out + d["b2"]
+        out = _dropout(out, dropout2_rate, k2, training, mode)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            out = _layer_norm(out, d.get("ln2w"), d.get("ln2b"), ln2_epsilon)
+        return out
+    return apply(f, x, *vals, op_name="fused_feedforward")
+
+
+def _rope_bhsd(q, k, sincos, pos):
+    """Rotate q/k [B,H,S,D] with sincos [2, S_max, D/2] starting at pos
+    (interleaved GPT-J lanes, matching fused_rotary_position_embedding's
+    use_neox_rotary_style=False)."""
+    d2 = q.shape[-1] // 2
+    idx = jnp.arange(q.shape[2]) + jnp.asarray(pos, jnp.int32)
+    sin = sincos[0][idx][None, None, :, :d2].astype(jnp.float32)
+    cos = sincos[1][idx][None, None, :, :d2].astype(jnp.float32)
+
+    def rot(t):
+        tf = t.astype(jnp.float32)
+        x1, x2 = tf[..., 0::2], tf[..., 1::2]
+        return jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                         axis=-1).reshape(t.shape).astype(t.dtype)
+    return rot(q), rot(k)
+
+
+def _pad_mask_to(mask, klen):
+    """Zero-pad a [..., q, S] additive mask on the key dim up to klen (the
+    extra cache columns are governed by the causal length mask)."""
+    if mask.shape[-1] == klen:
+        return mask
+    pad = [(0, 0)] * (mask.ndim - 1) + [(0, klen - mask.shape[-1])]
+    return jnp.pad(mask, pad)
+
+
+def _mha_core(x, d, num_heads, pre_layer_norm, pre_ln_epsilon, ln_epsilon,
+              attn_mask, attn_dropout_rate, dropout_rate, add_residual,
+              training, mode, ka, kd, cache_kv=None, time_step=None,
+              rotary_sincos=None, seq_lens=None):
+    """Shared fused-MHA body. qkv_weight [3, H, D, E]; returns
+    (out, new_cache). cache layout [2, B, H, S_max, D]. seq_lens [B] gives
+    per-example cache write positions (decode, q_len == 1)."""
+    residual = x
+    out = _layer_norm(x, d.get("pre_ln_w"), d.get("pre_ln_b"), pre_ln_epsilon) \
+        if pre_layer_norm else x
+    # qkv projection: [B,S,E] x [3,H,D,E] -> [3,B,H,S,D]
+    qkv = jnp.einsum("bse,thde->tbhsd", out, d["qkv_w"])
+    if "qkv_b" in d:
+        qkv = qkv + d["qkv_b"][:, None, :, None, :]
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    pos0 = jnp.asarray(0 if time_step is None else time_step, jnp.int32)
+    if rotary_sincos is not None:
+        if seq_lens is not None:
+            q, k = jax.vmap(lambda qq, kk, p: _rope_bhsd(
+                qq[None], kk[None], rotary_sincos, p),
+                in_axes=(0, 0, 0))(q, k, seq_lens.astype(jnp.int32))
+            q, k = q[:, 0], k[:, 0]
+        else:
+            q, k = _rope_bhsd(q, k, rotary_sincos, pos0)
+    new_cache = None
+    if cache_kv is not None:
+        kc, vc = cache_kv[0], cache_kv[1]
+        z = jnp.asarray(0, jnp.int32)
+        if seq_lens is not None:
+            if q.shape[2] != 1:
+                raise NotImplementedError(
+                    "per-example seq_lens requires single-token decode "
+                    "(q_len == 1)")
+            posb = seq_lens.astype(jnp.int32)
+
+            def write(c, new, p):
+                return jax.lax.dynamic_update_slice(
+                    c, new.astype(c.dtype), (z, p, z))
+            kc = jax.vmap(write)(kc, k, posb)
+            vc = jax.vmap(write)(vc, v, posb)
+            s_max = kc.shape[2]
+            jj = jnp.arange(s_max)[None, None, None, :]
+            lm = jnp.where(jj <= posb[:, None, None, None], 0.0, -1e30)
+        else:
+            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                              (z, z, pos0, z))
+            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                              (z, z, pos0, z))
+            s_max = kc.shape[2]
+            j = jnp.arange(s_max)[None, :]
+            i = jnp.arange(q.shape[2])[:, None] + pos0
+            lm = jnp.where(j <= i, 0.0, -1e30)[None, None]
+        new_cache = jnp.stack([kc, vc])
+        k, v = kc, vc
+        attn_mask = lm if attn_mask is None \
+            else _pad_mask_to(attn_mask, s_max) + lm
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k)
+    if attn_mask is not None:
+        logits = logits + attn_mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+    probs = _dropout(probs, attn_dropout_rate, ka, training, mode)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    b, h, s, hd = ctx.shape
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(b, s, h * hd)
+    out = ctx @ d["lin_w"]
+    if "lin_b" in d:
+        out = out + d["lin_b"]
+    out = _dropout(out, dropout_rate, kd, training, mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = _layer_norm(out, d.get("ln_w"), d.get("ln_b"), ln_epsilon)
+    return out, new_cache
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False, pre_ln_scale=None,
+        pre_ln_bias=None, ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+        qkv_bias=None, linear_bias=None, cache_kv=None, attn_mask=None,
+        dropout_rate=0.5, attn_dropout_rate=0.5, ln_epsilon=1e-5,
+        training=True, mode="upscale_in_train", ring_id=-1, add_residual=True,
+        num_heads=-1, transpose_qkv_wb=False, name=None):
+    """Fused self-attention block (fused_transformer.py:465 pseudo-code).
+    qkv_weight [3, num_heads, head_dim, embed_dim] (trans_qkv_wb layout);
+    cache_kv [2, B, H, S_max, D] turns on the decode path (written at step 0
+    here; use fused_multi_transformer/masked_multihead_attention for stepped
+    decode)."""
+    if transpose_qkv_wb and num_heads <= 0:
+        raise ValueError(
+            "num_heads must be given when transpose_qkv_wb=True (the flat "
+            "[E, 3*E] weight layout cannot imply the head count)")
+    ka = gen.next_key() if (training and attn_dropout_rate > 0.0) else None
+    kd = gen.next_key() if (training and dropout_rate > 0.0) else None
+    nh = num_heads
+
+    named = {"qkv_w": qkv_weight, "lin_w": linear_weight, "qkv_b": qkv_bias,
+             "lin_b": linear_bias, "pre_ln_w": pre_ln_scale,
+             "pre_ln_b": pre_ln_bias, "ln_w": ln_scale, "ln_b": ln_bias}
+    keys = [k for k, v in named.items() if v is not None]
+    vals = [named[k] for k in keys]
+    extra = []
+    if attn_mask is not None:
+        extra.append(attn_mask)
+    if cache_kv is not None:
+        extra.append(cache_kv)
+
+    def f(v, *rest):
+        d = dict(zip(keys, rest[:len(keys)]))
+        rem = list(rest[len(keys):])
+        m = rem.pop(0) if attn_mask is not None else None
+        ck = rem.pop(0) if cache_kv is not None else None
+        w = d["qkv_w"]
+        if transpose_qkv_wb:
+            e = v.shape[-1]
+            hd = e // nh
+            w = w.reshape(e, 3, nh, hd).transpose(1, 2, 3, 0)
+            if "qkv_b" in d:
+                d = dict(d)
+                d["qkv_b"] = d["qkv_b"].reshape(3, nh, hd)
+        out, nc = _mha_core(v, d, w.shape[1], pre_layer_norm, pre_ln_epsilon,
+                            ln_epsilon, m, attn_dropout_rate, dropout_rate,
+                            add_residual, training, mode, ka, kd, cache_kv=ck)
+        if nc is not None:
+            return out, nc
+        return out
+
+    res = apply(f, x, *vals, *extra, op_name="fused_multi_head_attention")
+    if cache_kv is not None:
+        return res[0], res[1]
+    return res
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights, ffn1_biases,
+        ffn2_weights, ffn2_biases, pre_layer_norm=True, epsilon=1e-5,
+        cache_kvs=None, pre_caches=None, seq_lens=None, rotary_embs=None,
+        time_step=None, attn_mask=None, dropout_rate=0.0, rotary_emb_dims=0,
+        activation="gelu", training=False, mode="upscale_in_train",
+        trans_qkvw=True, ring_id=-1, name=None):
+    """Stacked fused transformer layers with optional KV caches
+    (fused_transformer.py:873 / FusedMultiTransformer:1021). cache_kvs is a
+    list of [2, B, H, S_max, D] per layer; time_step (int) switches to the
+    single-token decode step at that position. Returns out, or
+    (out, cache_kvs) when caches are given."""
+    n_layers = len(qkv_weights)
+    if not trans_qkvw:
+        raise ValueError(
+            "trans_qkvw=False ([E, 3*H*D] weight layout) is not supported; "
+            "pass weights as [3, num_heads, head_dim, embed_dim]")
+    if pre_caches is not None:
+        raise NotImplementedError("pre_caches is not supported")
+
+    def opt(lst, i):
+        if lst is None:
+            return None
+        v = lst[i]
+        return v
+
+    out = x
+    new_caches = [] if cache_kvs is not None else None
+    for i in range(n_layers):
+        ck = cache_kvs[i] if cache_kvs is not None else None
+        if ck is not None:
+            # cache path: k/v written at time_step (or per-example seq_lens;
+            # 0 during prefill), causal length mask over the cache — the
+            # masked_multihead_attention decode pattern
+            out_i, nc = _attn_with_step(
+                out, qkv_weights[i], linear_weights[i], opt(ln_scales, i),
+                opt(ln_biases, i), opt(qkv_biases, i), opt(linear_biases, i),
+                ck, time_step, epsilon, pre_layer_norm, dropout_rate,
+                training, mode, attn_mask=attn_mask,
+                rotary_embs=rotary_embs if rotary_emb_dims > 0 else None,
+                seq_lens=seq_lens)
+            new_caches.append(nc)
+        elif rotary_embs is not None and rotary_emb_dims > 0:
+            out_i, _ = _attn_with_step(
+                out, qkv_weights[i], linear_weights[i], opt(ln_scales, i),
+                opt(ln_biases, i), opt(qkv_biases, i), opt(linear_biases, i),
+                None, time_step, epsilon, pre_layer_norm, dropout_rate,
+                training, mode, attn_mask=attn_mask, rotary_embs=rotary_embs)
+        else:
+            out_i = fused_multi_head_attention(
+                out, qkv_weights[i], linear_weights[i],
+                pre_layer_norm=pre_layer_norm,
+                pre_ln_scale=opt(ln_scales, i), pre_ln_bias=opt(ln_biases, i),
+                ln_scale=opt(ln_scales, i), ln_bias=opt(ln_biases, i),
+                pre_ln_epsilon=epsilon, qkv_bias=opt(qkv_biases, i),
+                linear_bias=opt(linear_biases, i), attn_mask=attn_mask,
+                dropout_rate=dropout_rate, attn_dropout_rate=dropout_rate,
+                ln_epsilon=epsilon, training=training, mode=mode)
+        out = fused_feedforward(
+            out_i, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=opt(ffn1_biases, i), linear2_bias=opt(ffn2_biases, i),
+            ln1_scale=opt(ffn_ln_scales, i), ln1_bias=opt(ffn_ln_biases, i),
+            ln2_scale=opt(ffn_ln_scales, i), ln2_bias=opt(ffn_ln_biases, i),
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, ln1_epsilon=epsilon, ln2_epsilon=epsilon,
+            pre_layer_norm=pre_layer_norm, training=training, mode=mode)
+    if new_caches is not None:
+        return out, new_caches
+    return out
+
+
+def _attn_with_step(x, qkv_w, lin_w, ln_w, ln_b, qkv_b, lin_b, cache_kv,
+                    time_step, epsilon, pre_layer_norm, dropout_rate,
+                    training, mode, attn_mask=None, rotary_embs=None,
+                    seq_lens=None):
+    """Attention sub-block with optional cache write at time_step (or at
+    per-example seq_lens), rotary embedding, and user attn_mask."""
+    named = {"qkv_w": qkv_w, "lin_w": lin_w, "pre_ln_w": ln_w, "pre_ln_b": ln_b,
+             "qkv_b": qkv_b, "lin_b": lin_b, "ln_w": ln_w, "ln_b": ln_b}
+    named = {k: v for k, v in named.items() if v is not None}
+    keys = list(named)
+    vals = [named[k] for k in keys]
+    ts = 0 if time_step is None else time_step
+    has_cache = cache_kv is not None
+    has_mask = attn_mask is not None
+    has_rope = rotary_embs is not None
+    has_seq = seq_lens is not None
+    kd = gen.next_key() if (training and dropout_rate > 0.0) else None
+
+    def f(v, *rest):
+        it = iter(rest)
+        ck = next(it) if has_cache else None
+        m = next(it) if has_mask else None
+        rt = next(it) if has_rope else None
+        sl = next(it) if has_seq else None
+        d = dict(zip(keys, it))
+        out, nc = _mha_core(v, d, d["qkv_w"].shape[1], pre_layer_norm, epsilon,
+                            epsilon, m, dropout_rate, dropout_rate, True,
+                            training, mode, kd, kd, cache_kv=ck, time_step=ts,
+                            rotary_sincos=rt, seq_lens=sl)
+        return (out, nc) if has_cache else out
+
+    extra = [t for t, want in ((cache_kv, has_cache), (attn_mask, has_mask),
+                               (rotary_embs, has_rope), (seq_lens, has_seq))
+             if want]
+    res = apply(f, x, *extra, *vals, op_name="fused_mha_decode")
+    if has_cache:
+        return res[0], res[1]
+    return res, None

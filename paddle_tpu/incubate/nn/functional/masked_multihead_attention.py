@@ -1,0 +1,100 @@
+"""masked_multihead_attention — single-token decode attention with KV cache
+(reference: python/paddle/incubate/nn/functional/masked_multihead_attention.py,
+the CUDA decode kernel behind FusedMultiTransformer generation).
+
+TPU design: one jitted update — dynamic_update_slice into the static-length
+cache + length-masked attention over it (O(S_max) per token, MXU-friendly
+batched matmuls)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.dispatch import apply
+
+__all__ = ["masked_multihead_attention"]
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """x: [B, 3*H*D] (one token's fused qkv), cache_kv: [2, B, H, S_max, D],
+    sequence_lengths: [B] current lengths (write position). Returns
+    (out [B, H*D], new_cache_kv)."""
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    has_bias = bias is not None
+    has_mask = src_mask is not None
+    has_seq = sequence_lengths is not None
+    has_rope = rotary_tensor is not None
+
+    def f(xv, ck, *rest):
+        it = iter(rest)
+        b_ = next(it) if has_bias else None
+        m_ = next(it) if has_mask else None
+        sl = next(it) if has_seq else None
+        rt = next(it) if has_rope else None
+        B = xv.shape[0]
+        H, S_max, D = ck.shape[2], ck.shape[3], ck.shape[4]
+        qkv = xv.reshape(B, 3, H, D)
+        if b_ is not None:
+            qkv = qkv + b_.reshape(1, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B,H,D]
+        pos = (sl.astype(jnp.int32) if sl is not None
+               else jnp.zeros((B,), jnp.int32))  # per-example write position
+        if rt is not None and rotary_emb_dims > 0:
+            # rotary_tensor: [B, 1, 1, S_max, D] cos/sin packed as the
+            # reference does, or [S_max, D/2] sin/cos pair; support the simple
+            # [2, S_max, D/2] layout (sin, cos)
+            sin = rt[0]
+            cos = rt[1]
+            sin_p = sin[pos]  # [B, D/2]
+            cos_p = cos[pos]
+
+            def rot(t):
+                tf = t.astype(jnp.float32)
+                if use_neox_rotary_style:
+                    d2 = D // 2
+                    x1, x2 = tf[..., :d2], tf[..., d2:]
+                    return jnp.concatenate(
+                        [x1 * cos_p[:, None] - x2 * sin_p[:, None],
+                         x2 * cos_p[:, None] + x1 * sin_p[:, None]],
+                        axis=-1).astype(t.dtype)
+                x1, x2 = tf[..., 0::2], tf[..., 1::2]
+                return jnp.stack(
+                    [x1 * cos_p[:, None] - x2 * sin_p[:, None],
+                     x2 * cos_p[:, None] + x1 * sin_p[:, None]],
+                    axis=-1).reshape(t.shape).astype(t.dtype)
+            q, k = rot(q), rot(k)
+        # write k/v at per-example positions (vmap over batch)
+        kc, vc = ck[0], ck[1]  # [B,H,S_max,D]
+
+        def write(c, new, p):
+            return jax.lax.dynamic_update_slice(
+                c, new[:, None, :].astype(c.dtype),
+                (jnp.asarray(0, jnp.int32), p, jnp.asarray(0, jnp.int32)))
+
+        kc = jax.vmap(write)(kc, k, pos)
+        vc = jax.vmap(write)(vc, v, pos)
+        # attend over cache up to pos (inclusive)
+        scale = 1.0 / (D ** 0.5)
+        logits = jnp.einsum("bhd,bhsd->bhs", q * scale, kc)
+        idx = jnp.arange(S_max)[None, None, :]
+        allowed = idx <= pos[:, None, None]
+        logits = jnp.where(allowed, logits, -1e30)
+        if m_ is not None:
+            logits = logits + m_.reshape(B, 1, -1)[..., :S_max]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(xv.dtype)
+        out = jnp.einsum("bhs,bhsd->bhd", probs, vc)
+        return out.reshape(B, H * D), jnp.stack([kc, vc])
+
+    extra = [t for t in (bias, src_mask, sequence_lengths, rotary_tensor)
+             if t is not None]
+    out, new_cache = apply(f, x, cache_kv, *extra,
+                           op_name="masked_multihead_attention")
+    return out, new_cache

@@ -1,0 +1,15 @@
+from .fused_transformer import (
+    FusedBiasDropoutResidualLayerNorm,
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = [
+    "FusedBiasDropoutResidualLayerNorm",
+    "FusedFeedForward",
+    "FusedMultiHeadAttention",
+    "FusedMultiTransformer",
+    "FusedTransformerEncoderLayer",
+]

@@ -42,7 +42,12 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None,
             key=None):
-    if not training or p == 0.0:
+    if p == 0.0:
+        return x
+    if not training:
+        # downscale_in_infer scales at INFERENCE time (reference semantics)
+        if mode == "downscale_in_infer":
+            return x * (1.0 - p)
         return x
     k = key if key is not None else gen.next_key()
 

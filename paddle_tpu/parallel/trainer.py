@@ -54,15 +54,22 @@ def _param_sharding_spec(p, mesh):
     return PartitionSpec(*clean)
 
 
+_warned_specs = set()
+
+
 def _warn_dropped_spec(p, axis, dim):
     """This jax rejects uneven device_put shardings, so a spec whose mesh
     extent doesn't divide the dim is replicated instead of crashing — but
-    say so, since replication costs per-device memory."""
+    say so (once per shape/axis), since replication costs per-device memory."""
+    key = (tuple(getattr(p, "shape", ())), str(axis), dim)
+    if key in _warned_specs:
+        return
+    _warned_specs.add(key)
     import logging
     logging.getLogger("paddle_tpu").warning(
         "sharding axis %r dropped for param of shape %s: dim %s not divisible "
         "by the mesh axis extent; the param is replicated on that dim",
-        axis, tuple(getattr(p, "shape", ())), dim)
+        axis, key[0], dim)
 
 
 def _zero_state_spec(param_spec: PartitionSpec, shape, axis, mesh):

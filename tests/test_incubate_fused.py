@@ -1,0 +1,299 @@
+"""Fused incubate op surface (VERDICT r1 missing #9).
+
+Each fused op is checked against an unfused composition of the public ops
+(the reference's own contract: the fused kernels are numerically the
+pseudo-code in fused_transformer.py docstrings), plus grad flow and a
+KV-cache decode parity run for FusedMultiTransformer.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate.nn import functional as IF
+
+
+def _t(a):
+    return P.to_tensor(np.asarray(a, dtype=np.float32))
+
+
+def test_fused_matmul_bias_and_linear():
+    rng = np.random.RandomState(0)
+    x, w, b = rng.randn(4, 8), rng.randn(8, 16), rng.randn(16)
+    out = IF.fused_matmul_bias(_t(x), _t(w), _t(b))
+    np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5, atol=1e-5)
+    out2 = IF.fused_linear(_t(x), _t(w), _t(b))
+    np.testing.assert_allclose(out2.numpy(), x @ w + b, rtol=1e-5, atol=1e-5)
+    out3 = IF.fused_linear_activation(_t(x), _t(w), _t(b), activation="relu")
+    np.testing.assert_allclose(out3.numpy(), np.maximum(x @ w + b, 0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_norms():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 6, 16).astype(np.float32)
+    w = rng.rand(16).astype(np.float32) + 0.5
+    b = rng.randn(16).astype(np.float32)
+    got = IF.fused_layer_norm(_t(x), _t(w), _t(b), epsilon=1e-5).numpy()
+    ref = F.layer_norm(_t(x), [16], _t(w), _t(b), 1e-5).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    got = IF.fused_rms_norm(_t(x), _t(w), epsilon=1e-6).numpy()
+    ref = F.rms_norm(_t(x), _t(w), 1e-6).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # residual fusion
+    r = rng.randn(2, 6, 16).astype(np.float32)
+    got = IF.fused_layer_norm(_t(x), _t(w), _t(b), residual=_t(r)).numpy()
+    ref = F.layer_norm(_t(x + r), [16], _t(w), _t(b), 1e-5).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_feedforward_matches_unfused():
+    rng = np.random.RandomState(2)
+    d, ff = 16, 32
+    x = rng.randn(2, 5, d).astype(np.float32)
+    w1, b1 = rng.randn(d, ff).astype(np.float32), rng.randn(ff).astype(np.float32)
+    w2, b2 = rng.randn(ff, d).astype(np.float32), rng.randn(d).astype(np.float32)
+    lw = np.ones(d, np.float32)
+    lb = np.zeros(d, np.float32)
+    got = IF.fused_feedforward(
+        _t(x), _t(w1), _t(w2), _t(b1), _t(b2), ln1_scale=_t(lw), ln1_bias=_t(lb),
+        ln2_scale=_t(lw), ln2_bias=_t(lb), dropout1_rate=0.0, dropout2_rate=0.0,
+        activation="gelu", pre_layer_norm=True).numpy()
+    h = F.layer_norm(_t(x), [d], _t(lw), _t(lb), 1e-5).numpy()
+    ref = x + (np.asarray(F.gelu(_t(h @ w1 + b1)).numpy()) @ w2 + b2)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_attention_matches_unfused():
+    rng = np.random.RandomState(3)
+    B, S, H, D = 2, 6, 4, 8
+    E = H * D
+    x = rng.randn(B, S, E).astype(np.float32)
+    qkv_w = (rng.randn(3, H, D, E) * 0.1).astype(np.float32)
+    qkv_b = np.zeros((3, H, D), np.float32)
+    lin_w = (rng.randn(E, E) * 0.1).astype(np.float32)
+    lin_b = np.zeros(E, np.float32)
+    got = IF.fused_multi_head_attention(
+        _t(x), _t(qkv_w), _t(lin_w), pre_layer_norm=True,
+        pre_ln_scale=_t(np.ones(E, np.float32)),
+        pre_ln_bias=_t(np.zeros(E, np.float32)),
+        ln_scale=_t(np.ones(E, np.float32)),
+        ln_bias=_t(np.zeros(E, np.float32)),
+        qkv_bias=_t(qkv_b), linear_bias=_t(lin_b),
+        dropout_rate=0.0, attn_dropout_rate=0.0).numpy()
+
+    # unfused reference
+    h = F.layer_norm(_t(x), [E], None, None, 1e-5).numpy()
+    qkv = np.einsum("bse,thde->tbhsd", h, qkv_w)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    logits = np.einsum("bhqd,bhkd->bhqk", q / np.sqrt(D), k)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    ctx = np.einsum("bhqk,bhkd->bhqd", probs, v)
+    ctx = np.transpose(ctx, (0, 2, 1, 3)).reshape(B, S, E)
+    ref = x + ctx @ lin_w
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_fused_attention_grad_flows():
+    rng = np.random.RandomState(4)
+    B, S, H, D = 2, 4, 2, 4
+    E = H * D
+    x = P.to_tensor(rng.randn(B, S, E).astype(np.float32))
+    qkv_w = P.to_tensor((rng.randn(3, H, D, E) * 0.1).astype(np.float32))
+    qkv_w.stop_gradient = False
+    lin_w = P.to_tensor((rng.randn(E, E) * 0.1).astype(np.float32))
+    lin_w.stop_gradient = False
+    out = IF.fused_multi_head_attention(
+        x, qkv_w, lin_w, dropout_rate=0.0, attn_dropout_rate=0.0)
+    loss = out.sum()
+    loss.backward()
+    assert qkv_w.grad is not None and np.isfinite(qkv_w.grad.numpy()).all()
+    assert lin_w.grad is not None and np.isfinite(lin_w.grad.numpy()).all()
+
+
+def test_fused_rope_matches_llama_inline():
+    from paddle_tpu.models.llama import _rope
+    rng = np.random.RandomState(5)
+    B, S, H, D = 2, 8, 4, 16
+    q = rng.randn(B, S, H, D).astype(np.float32)
+    k = rng.randn(B, S, H, D).astype(np.float32)
+    qo, ko, _ = IF.fused_rotary_position_embedding(
+        _t(q), _t(k), use_neox_rotary_style=False)
+    qr, kr = _rope(q, k, 10000.0)
+    np.testing.assert_allclose(qo.numpy(), np.asarray(qr), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(ko.numpy(), np.asarray(kr), rtol=1e-5, atol=1e-5)
+    # neox (half-block) style differs from interleaved
+    qn, _, _ = IF.fused_rotary_position_embedding(
+        _t(q), use_neox_rotary_style=True)
+    assert not np.allclose(qn.numpy(), qo.numpy())
+    # position_ids path: shifting positions changes the result
+    pids = np.tile(np.arange(2, S + 2), (B, 1))
+    qp, _, _ = IF.fused_rotary_position_embedding(
+        _t(q), position_ids=P.to_tensor(pids), use_neox_rotary_style=False)
+    assert not np.allclose(qp.numpy(), qo.numpy())
+
+
+def test_masked_multihead_attention_decode():
+    """Stepped decode with per-example write positions equals full attention
+    over the written prefix."""
+    rng = np.random.RandomState(6)
+    B, H, D, S_max = 2, 2, 4, 8
+    cache = np.zeros((2, B, H, S_max, D), np.float32)
+    # pre-fill 3 positions with known k/v
+    ks = rng.randn(B, H, 3, D).astype(np.float32)
+    vs = rng.randn(B, H, 3, D).astype(np.float32)
+    cache[0, :, :, :3] = ks
+    cache[1, :, :, :3] = vs
+    x = rng.randn(B, 3 * H * D).astype(np.float32)
+    out, new_cache = IF.masked_multihead_attention(
+        _t(x), _t(cache), sequence_lengths=P.to_tensor(np.full(B, 3)))
+    q = x.reshape(B, 3, H, D)[:, 0]
+    k_new = x.reshape(B, 3, H, D)[:, 1]
+    v_new = x.reshape(B, 3, H, D)[:, 2]
+    k_all = np.concatenate([ks, k_new[:, :, None]], axis=2)
+    v_all = np.concatenate([vs, v_new[:, :, None]], axis=2)
+    logits = np.einsum("bhd,bhsd->bhs", q / np.sqrt(D), k_all)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhs,bhsd->bhd", p, v_all).reshape(B, H * D)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+    # cache was written at position 3
+    np.testing.assert_allclose(new_cache.numpy()[0][:, :, 3], k_new,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_fused_multi_transformer_cache_decode_parity():
+    """Prefill+decode through caches emits the same logits as running the
+    full sequence without caches (the FusedMultiTransformer decode contract)."""
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    P.seed(7)
+    E, H, FFN, L = 16, 2, 32, 2
+    m = FusedMultiTransformer(E, H, FFN, num_layers=L, dropout_rate=0.0)
+    m.eval()
+    rng = np.random.RandomState(8)
+    x = rng.randn(1, 5, E).astype(np.float32)
+
+    # no-cache full run (causal mask)
+    S = x.shape[1]
+    causal = np.where(np.tril(np.ones((S, S), bool)), 0.0, -1e30)[None, None]
+    full = m(_t(x), attn_mask=P.to_tensor(causal.astype(np.float32))).numpy()
+
+    # prefill 4 tokens, decode the 5th
+    caches = m.init_caches(1, 8)
+    out_p = m(_t(x[:, :4]), caches=caches, time_step=None)
+    out_p, caches = out_p if isinstance(out_p, tuple) else (out_p, caches)
+    out_d = m(_t(x[:, 4:5]), caches=caches, time_step=4)
+    out_d, _ = out_d if isinstance(out_d, tuple) else (out_d, None)
+    np.testing.assert_allclose(out_d.numpy()[:, 0], full[:, 4],
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_cache_path_honors_attn_mask():
+    """Padding mask must apply in the cache branch too (review finding): mask
+    a prefill position and the decode output must change."""
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    P.seed(11)
+    m = FusedMultiTransformer(16, 2, 32, num_layers=1, dropout_rate=0.0)
+    m.eval()
+    rng = np.random.RandomState(12)
+    x = rng.randn(1, 4, 16).astype(np.float32)
+    S = 4
+    causal = np.where(np.tril(np.ones((S, S), bool)), 0.0, -1e30)[None, None]
+    caches = m.init_caches(1, 8)
+    out_a, caches_a = m(_t(x), caches=caches,
+                        attn_mask=P.to_tensor(causal.astype(np.float32)))
+    # same but also mask out position 1 entirely: prefill outputs must differ
+    pad = causal.copy()
+    pad[..., 1] = -1e30
+    caches = m.init_caches(1, 8)
+    out_b, _ = m(_t(x), caches=caches,
+                 attn_mask=P.to_tensor(pad.astype(np.float32)))
+    assert not np.allclose(out_a.numpy()[:, 2:], out_b.numpy()[:, 2:])
+    # decode step: masking a cached column must change the decode output
+    xn = rng.randn(1, 1, 16).astype(np.float32)
+    dm = np.zeros((1, 1, 1, 8), np.float32)
+    dm[..., 1] = -1e30
+    out_d0, _ = m(_t(xn), caches=caches_a, time_step=4)
+    out_d1, _ = m(_t(xn), caches=caches_a, time_step=4,
+                  attn_mask=P.to_tensor(dm))
+    assert not np.allclose(out_d0.numpy(), out_d1.numpy())
+
+
+def test_multi_transformer_rotary_is_applied():
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    P.seed(13)
+    m = FusedMultiTransformer(16, 2, 32, num_layers=1, dropout_rate=0.0)
+    m.eval()
+    rng = np.random.RandomState(14)
+    x = rng.randn(1, 4, 16).astype(np.float32)
+    D = 8  # head_dim
+    pos = np.arange(16, dtype=np.float32)
+    inv = 1.0 / (10000.0 ** (np.arange(0, D, 2) / D))
+    ang = np.outer(pos, inv).astype(np.float32)
+    sincos = P.to_tensor(np.stack([np.sin(ang), np.cos(ang)]))
+    a = m(_t(x)).numpy()
+    b = m(_t(x), rotary_embs=sincos, rotary_emb_dims=1).numpy()
+    assert not np.allclose(a, b)
+
+
+def test_masked_mha_per_example_lengths():
+    """Different per-example write positions (ragged batch decode)."""
+    rng = np.random.RandomState(15)
+    B, H, D, S_max = 2, 2, 4, 8
+    cache = np.zeros((2, B, H, S_max, D), np.float32)
+    cache[0, 0, :, :2] = rng.randn(H, 2, D)
+    cache[1, 0, :, :2] = rng.randn(H, 2, D)
+    cache[0, 1, :, :5] = rng.randn(H, 5, D)
+    cache[1, 1, :, :5] = rng.randn(H, 5, D)
+    x = rng.randn(B, 3 * H * D).astype(np.float32)
+    out, nc = IF.masked_multihead_attention(
+        _t(x), _t(cache), sequence_lengths=P.to_tensor(np.array([2, 5])))
+    k_new = x.reshape(B, 3, H, D)[:, 1]
+    np.testing.assert_allclose(nc.numpy()[0][0, :, 2], k_new[0], rtol=1e-6)
+    np.testing.assert_allclose(nc.numpy()[0][1, :, 5], k_new[1], rtol=1e-6)
+
+
+def test_dropout_downscale_in_infer():
+    x = _t(np.ones((4, 4)))
+    out = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), 0.5 * np.ones((4, 4)), rtol=1e-6)
+    out2 = IF.fused_dropout_add(x, x, p=0.5, training=False,
+                                mode="downscale_in_infer")
+    np.testing.assert_allclose(out2.numpy(), 1.5 * np.ones((4, 4)), rtol=1e-6)
+
+
+def test_rope_decode_position_beyond_seq():
+    """position_ids larger than the current q length must still rotate with
+    the true angle (review finding: table was built only up to S)."""
+    rng = np.random.RandomState(16)
+    q = rng.randn(1, 1, 2, 8).astype(np.float32)
+    q7, _, _ = IF.fused_rotary_position_embedding(
+        _t(q), position_ids=P.to_tensor(np.array([[7]])),
+        use_neox_rotary_style=False)
+    # oracle: rotate a length-8 sequence and take row 7
+    qfull = np.tile(q, (1, 8, 1, 1))
+    qf, _, _ = IF.fused_rotary_position_embedding(
+        _t(qfull), use_neox_rotary_style=False)
+    np.testing.assert_allclose(q7.numpy()[0, 0], qf.numpy()[0, 7],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_encoder_layer_trains():
+    P.seed(9)
+    layer = P.incubate.nn.FusedTransformerEncoderLayer(
+        16, 2, 32, dropout_rate=0.0)
+    opt = P.optimizer.AdamW(learning_rate=1e-3,
+                            parameters=layer.parameters())
+    rng = np.random.RandomState(10)
+    x = P.to_tensor(rng.randn(2, 6, 16).astype(np.float32))
+    y = P.to_tensor(rng.randn(2, 6, 16).astype(np.float32))
+    losses = []
+    for _ in range(8):
+        loss = F.mse_loss(layer(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
