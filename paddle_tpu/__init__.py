@@ -74,7 +74,8 @@ def __getattr__(name):
         from .static import CompiledProgram
         return CompiledProgram
     if name in ("profiler", "distribution", "sparse", "quantization", "audio",
-                "geometric", "text", "incubate", "inference", "models", "fft"):
+                "geometric", "text", "incubate", "inference", "models", "fft",
+                "signal", "onnx"):
         import importlib
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -19,9 +19,31 @@ _PLATFORM_ALIASES = {
 }
 
 
+_portable_trace = False  # ONNX export: force backend-neutral lowerings
+
+
 def is_tpu_backend() -> bool:
-    """True when the default jax backend is the TPU (incl. tunneled 'axon')."""
+    """True when the default jax backend is the TPU (incl. tunneled 'axon').
+    False while a portable trace (ONNX export) is active, so ops pick their
+    backend-neutral form instead of Pallas kernels."""
+    if _portable_trace:
+        return False
     return jax.default_backend() in _PLATFORM_ALIASES["tpu"]
+
+
+class portable_trace:
+    """Context manager: trace with backend-neutral op lowerings."""
+
+    def __enter__(self):
+        global _portable_trace
+        self._prev = _portable_trace
+        _portable_trace = True
+        return self
+
+    def __exit__(self, *exc):
+        global _portable_trace
+        _portable_trace = self._prev
+        return False
 
 
 def _platform_devices(platform: str):

@@ -21,6 +21,8 @@ from .utils import global_scatter, global_gather  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .store import TCPStore  # noqa: F401
+from . import rpc  # noqa: F401
+from . import auto_tuner  # noqa: F401
 
 from ..parallel.mesh import init_mesh, get_mesh  # noqa: F401
 

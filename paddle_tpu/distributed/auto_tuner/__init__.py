@@ -1,0 +1,10 @@
+"""paddle_tpu.distributed.auto_tuner — parallel-config search
+(reference: python/paddle/distributed/auto_tuner/)."""
+from .prune import prune, register_prune, same_cfgs_beside  # noqa: F401
+from .recorder import HistoryRecorder  # noqa: F401
+from .search import GridSearch, SearchAlgo, candidate_space  # noqa: F401
+from .tuner import AutoTuner, measure_llama_step  # noqa: F401
+
+__all__ = ["AutoTuner", "GridSearch", "HistoryRecorder", "SearchAlgo",
+           "candidate_space", "measure_llama_step", "prune", "register_prune",
+           "same_cfgs_beside"]

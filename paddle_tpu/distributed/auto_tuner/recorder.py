@@ -1,0 +1,47 @@
+"""History recorder (reference: auto_tuner/recorder.py — sorts measured
+configs by the metric and persists the history)."""
+from __future__ import annotations
+
+import csv
+import json
+from typing import Optional
+
+
+class HistoryRecorder:
+    def __init__(self, metric_name="ips", direction="max"):
+        self.history = []
+        self.metric_name = metric_name
+        self.direction = direction
+
+    def add_cfg(self, cfg, metric=None, error=None):
+        self.history.append({"cfg": dict(cfg), "metric": metric,
+                             "error": error})
+
+    def sort_metric(self):
+        ok = [h for h in self.history if h["metric"] is not None]
+        ok.sort(key=lambda h: h["metric"],
+                reverse=(self.direction == "max"))
+        return ok
+
+    def get_best(self) -> Optional[dict]:
+        ok = self.sort_metric()
+        return ok[0] if ok else None
+
+    def store_history(self, path):
+        if path.endswith(".csv"):
+            with open(path, "w", newline="") as f:
+                if not self.history:
+                    return
+                keys = sorted({k for h in self.history for k in h["cfg"]})
+                w = csv.writer(f)
+                w.writerow(keys + ["metric", "error"])
+                for h in self.history:
+                    w.writerow([h["cfg"].get(k) for k in keys]
+                               + [h["metric"], h["error"]])
+            return
+        with open(path, "w") as f:
+            json.dump(self.history, f, indent=1)
+
+    def load_history(self, path):
+        with open(path) as f:
+            self.history = json.load(f)

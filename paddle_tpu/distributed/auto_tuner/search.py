@@ -1,0 +1,62 @@
+"""Search algorithms (reference: auto_tuner/search.py — SearchAlgo:22,
+GridSearch:38)."""
+from __future__ import annotations
+
+import itertools
+from abc import ABC, abstractmethod
+
+from .prune import prune
+
+
+class SearchAlgo(ABC):
+    def __init__(self, tuner_cfg):
+        self.tuner_cfg = tuner_cfg
+
+    @abstractmethod
+    def search_once(self, history):
+        ...
+
+
+def candidate_space(tuner_cfg):
+    """Cartesian product over the tunable axes."""
+    n = tuner_cfg.get("num_devices", 1)
+
+    def divisors(k):
+        return [d for d in range(1, k + 1) if k % d == 0]
+
+    space = {
+        "dp_degree": tuner_cfg.get("dp_degree", "auto"),
+        "mp_degree": tuner_cfg.get("mp_degree", "auto"),
+        "pp_degree": tuner_cfg.get("pp_degree", "auto"),
+        "sharding_degree": tuner_cfg.get("sharding_degree", [1]),
+        "micro_batches": tuner_cfg.get("micro_batches", [1]),
+        "use_recompute": tuner_cfg.get("use_recompute", [True]),
+        "amp": tuner_cfg.get("amp", [True]),
+        "schedule": tuner_cfg.get("schedule", ["gpipe"]),
+    }
+    for k, v in space.items():
+        if v == "auto":
+            space[k] = divisors(n)
+        elif not isinstance(v, (list, tuple)):
+            space[k] = [v]
+    keys = list(space)
+    for combo in itertools.product(*[space[k] for k in keys]):
+        yield dict(zip(keys, combo))
+
+
+class GridSearch(SearchAlgo):
+    """Pruned exhaustive grid (GridSearch:38)."""
+
+    def __init__(self, tuner_cfg):
+        super().__init__(tuner_cfg)
+        self._iter = candidate_space(tuner_cfg)
+
+    def search_once(self, history):
+        tried = {tuple(sorted(h["cfg"].items())) for h in history}
+        for cand in self._iter:
+            if tuple(sorted(cand.items())) in tried:
+                continue
+            if prune(self.tuner_cfg, cand, history):
+                continue
+            return cand
+        return None

@@ -1,0 +1,110 @@
+"""AutoTuner (reference: python/paddle/distributed/auto_tuner/tuner.py:19).
+
+Searches hybrid-parallel configs (dp/mp/pp/sharding degrees, micro-batch
+count, remat, amp, pipeline schedule) with prune rules, measures each
+candidate with a user metric function, and records history. On TPU the
+natural measurement is the timed compiled train step — `tune()` drives the
+whole loop; `measure_llama_step` is the built-in metric for the flagship
+model (throughput of build_hybrid_train_step on the active mesh)."""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from .recorder import HistoryRecorder
+from .search import GridSearch
+
+
+class AutoTuner:
+    def __init__(self, tuner_cfg: dict):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.algo = GridSearch(self.tuner_cfg)
+        self.recorder = HistoryRecorder(
+            metric_name=tuner_cfg.get("metric", "ips"),
+            direction=tuner_cfg.get("direction", "max"))
+        self.cur_task_id = 0
+
+    def search_once(self) -> Optional[dict]:
+        """Next un-pruned candidate, or None when the space is exhausted."""
+        cand = self.algo.search_once(self.recorder.history)
+        if cand is not None:
+            self.cur_task_id += 1
+        return cand
+
+    def record(self, cfg, metric=None, error=None):
+        self.recorder.add_cfg(cfg, metric=metric, error=error)
+
+    def get_best(self):
+        return self.recorder.get_best()
+
+    def tune(self, run_fn: Callable[[dict], float], max_trials=None,
+             history_path=None):
+        """Full loop: run_fn(cfg) -> metric (raise to mark a failed config;
+        raise MemoryError / 'RESOURCE_EXHAUSTED' for OOM-aware pruning)."""
+        trials = 0
+        while True:
+            if max_trials is not None and trials >= max_trials:
+                break
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            trials += 1
+            try:
+                metric = run_fn(cfg)
+                self.record(cfg, metric=metric)
+            except Exception as e:  # noqa: BLE001 — a failed cfg is data
+                kind = "oom" if ("RESOURCE_EXHAUSTED" in str(e)
+                                 or isinstance(e, MemoryError)) else "error"
+                self.record(cfg, error=kind)
+        if history_path:
+            self.recorder.store_history(history_path)
+        return self.get_best()
+
+
+def measure_llama_step(model_cfg, global_batch_size, seq_len, n_steps=4,
+                       warmup=2):
+    """Returns run_fn(cfg) -> tokens/sec measuring the compiled hybrid step
+    for a LlamaConfig-like model on the active device set. Builds a fresh
+    mesh per config (dp x pp x mp x sharding over the available devices)."""
+    import numpy as np
+
+    def run_fn(cfg):
+        import paddle_tpu as P
+        from paddle_tpu.models import LlamaForCausalLM, build_hybrid_train_step
+        from paddle_tpu.parallel import mesh as mesh_mod
+
+        mesh_mod.set_mesh(None)
+        shape = {}
+        for axis, key in (("dp", "dp_degree"), ("pp", "pp_degree"),
+                          ("mp", "mp_degree"), ("sharding", "sharding_degree")):
+            if cfg.get(key, 1) > 1:
+                shape[axis] = cfg[key]
+        if shape:
+            mesh_mod.init_mesh(shape)
+        P.seed(0)
+        model = LlamaForCausalLM(model_cfg)
+        opt = P.optimizer.AdamW(learning_rate=1e-4,
+                                parameters=model.parameters())
+        if cfg.get("sharding_degree", 1) > 1:
+            from paddle_tpu.distributed.fleet.meta_parallel.sharding_optimizer \
+                import DygraphShardingOptimizer
+            opt = DygraphShardingOptimizer(opt)
+        step = build_hybrid_train_step(
+            model, opt, n_microbatches=cfg.get("micro_batches", 1),
+            remat=cfg.get("use_recompute", True), amp=cfg.get("amp", True),
+            schedule=cfg.get("schedule", "gpipe"))
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, model_cfg.vocab_size,
+                          (global_batch_size, seq_len + 1))
+        batch = {"input_ids": P.to_tensor(ids[:, :-1]),
+                 "labels": P.to_tensor(ids[:, 1:])}
+        for _ in range(warmup):
+            step(batch)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            loss = step(batch)
+        float(loss.numpy())  # sync
+        dt = (time.perf_counter() - t0) / n_steps
+        mesh_mod.set_mesh(None)
+        return global_batch_size * seq_len / dt
+    return run_fn

@@ -30,6 +30,12 @@ class Context:
     devices: Optional[str] = None
     max_restart: int = 3
     envs: dict = field(default_factory=dict)
+    # elastic: nnodes given as 'min:max' turns on membership-based scaling
+    np_max: int = 0
+
+    @property
+    def elastic(self) -> bool:
+        return self.np_max > 0
 
     @classmethod
     def from_args(cls, argv=None) -> "Context":
@@ -53,16 +59,24 @@ class Context:
         p.add_argument("script", type=str)
         p.add_argument("script_args", nargs=argparse.REMAINDER)
         a = p.parse_args(argv)
-        nnodes = int(str(a.nnodes).split(":")[0])
+        parts = str(a.nnodes).split(":")
+        nnodes = int(parts[0])
+        np_max = int(parts[1]) if len(parts) > 1 else 0
+        if np_max and np_max < nnodes:
+            raise SystemExit(f"--nnodes {a.nnodes}: max must be >= min")
         master = a.master
-        if master is None and nnodes > 1:
-            raise SystemExit("--master host:port is required for nnodes > 1")
+        if master is None and (nnodes > 1 or np_max > 0):
+            # elastic with min=1 still needs a discoverable store endpoint,
+            # or joining nodes could never find the rendezvous
+            raise SystemExit("--master host:port is required for nnodes > 1 "
+                             "and for elastic ranges ('min:max')")
         if master is None:
             master = f"127.0.0.1:{_free_port()}"
         return cls(script=a.script, script_args=a.script_args, nnodes=nnodes,
                    node_rank=a.node_rank, nproc_per_node=a.nproc_per_node,
                    master=master, job_id=a.job_id, log_dir=a.log_dir,
-                   devices=a.devices, max_restart=a.max_restart)
+                   devices=a.devices, max_restart=a.max_restart,
+                   np_max=np_max)
 
     @property
     def world_size(self) -> int:

@@ -48,6 +48,17 @@ class CollectiveController:
     # ---- pod ----
     def build_pod(self):
         self.store = self._connect_store()
+        self.elastic = None
+        if self.ctx.elastic:
+            from .elastic import ElasticManager
+            # collision-free identity (hostname:pid default): a joining node
+            # that keeps the default --node_rank must not alias an existing
+            # member's heartbeat key
+            self.elastic = ElasticManager(
+                self.store, np_range=(self.ctx.nnodes, self.ctx.np_max))
+            # hold until the minimum membership is present, then pin ranks
+            self.elastic.wait_for_np(self.ctx.nnodes)
+            self.elastic.commit_roster()
         # the jax.distributed coordination service needs its OWN port (the
         # rendezvous store keeps serving on ctx.master's port); node 0 picks
         # it and publishes it through the store
@@ -158,8 +169,30 @@ class CollectiveController:
         self.pod_restarts = getattr(self, "pod_restarts", 0)
         seen_gen = self._restart_generation()
         while True:
-            # peer-initiated pod restart?
-            if self.ctx.nnodes > 1:
+            # elastic membership change? (scale up/down — re-rank + relaunch,
+            # fleet/elastic/manager.py:253-266 semantics)
+            if getattr(self, "elastic", None) is not None:
+                from .elastic import ElasticStatus
+                status = self.elastic.watch_once()
+                if status == ElasticStatus.EXIT:
+                    sys.stderr.write("[launch] node scaled out; stopping pod\n")
+                    self.stop(signal.SIGTERM)
+                    return 0
+                if status == ElasticStatus.RESTART:
+                    roster = self.elastic.commit_roster()
+                    new_rank = self.elastic.rank_of(roster)
+                    sys.stderr.write(
+                        f"[launch] membership changed -> {roster}; "
+                        f"re-ranked to {new_rank}/{len(roster)}\n")
+                    self.ctx.nnodes = len(roster)
+                    self.ctx.node_rank = new_rank
+                    self.pod_restarts += 1
+                    seen_gen = int(self.store.add("restart_gen", 1))
+                    seen_gen = self._restart_all(seen_gen, "scale event")
+                    continue
+            # peer-initiated pod restart? (elastic single-node-min jobs must
+            # follow generations too — peers exist even when nnodes == 1)
+            if self.ctx.nnodes > 1 or getattr(self, "elastic", None) is not None:
                 gen = self._restart_generation()
                 if gen > seen_gen:
                     self.pod_restarts += 1
@@ -182,7 +215,8 @@ class CollectiveController:
                         f"[launch] worker rank={failed.rank} exited {code}; "
                         f"restart {self.pod_restarts}/{self.ctx.max_restart} "
                         f"(log: {failed.log_path})\n")
-                    if self.ctx.nnodes > 1:
+                    if self.ctx.nnodes > 1 or \
+                            getattr(self, "elastic", None) is not None:
                         seen_gen = int(self.store.add("restart_gen", 1))
                     seen_gen = self._restart_all(seen_gen,
                                                  f"rank {failed.rank} failed")

@@ -1,0 +1,141 @@
+"""Elastic membership manager (reference: fleet/elastic/manager.py:126 —
+ETCD leases/watches :253-266, scale up/down detection, re-rank + relaunch).
+
+TPU-native mapping: the ETCD lease is a heartbeat SEQUENCE in the TCPStore —
+each node's daemon thread bumps `elastic/hb/{node_id}` every interval; a
+node is alive while its sequence keeps advancing (measured on the local
+clock, so cross-host clock skew is irrelevant). The member registry is an
+append-only join log (`elastic/njoined` + `elastic/join/{i}`), since the
+store is a KV without key listing. A scale event is any change of the alive
+set within the [np_min, np_max] window; ranks are recomputed by sorting the
+alive node ids, and the launcher relaunches the pod with the new roster
+(the reference's whole-job restart on membership change).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+ELASTIC_TIMEOUT = float(os.environ.get("PADDLE_ELASTIC_TIMEOUT", 5.0))
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"          # waiting for np_min members
+    RESTART = "restart"    # membership changed: relaunch with new ranks
+    EXIT = "exit"          # this node was scaled out
+
+
+class ElasticManager:
+    def __init__(self, store, node_id: Optional[str] = None,
+                 np_range: Tuple[int, int] = (1, 1),
+                 heartbeat_interval: float = 0.5,
+                 timeout: float = ELASTIC_TIMEOUT):
+        self.store = store
+        self.node_id = node_id or f"{os.uname().nodename}:{os.getpid()}"
+        self.np_min, self.np_max = np_range
+        self.interval = heartbeat_interval
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._seq = 0
+        self._last_seen: Dict[str, Tuple[int, float]] = {}  # id -> (seq, t)
+        self._members_cache: List[str] = []
+        self._join()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    # ---- lease analog ----
+    def _join(self):
+        i = self.store.add("elastic/njoined", 1) - 1
+        self.store.set(f"elastic/join/{i}", self.node_id.encode())
+        self.store.set(f"elastic/hb/{self.node_id}", b"0")
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            self._seq += 1
+            try:
+                self.store.set(f"elastic/hb/{self.node_id}",
+                               str(self._seq).encode())
+            except Exception:  # noqa: BLE001 — store gone: stop quietly
+                return
+            self._stop.wait(self.interval)
+
+    def leave(self):
+        """Graceful scale-down: stop heartbeating and mark the node gone."""
+        self._stop.set()
+        try:
+            self.store.set(f"elastic/hb/{self.node_id}", b"gone")
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ---- membership ----
+    def _registered(self) -> List[str]:
+        n = int(self.store.add("elastic/njoined", 0))
+        ids = []
+        for i in range(n):
+            nid = bytes(self.store.get(f"elastic/join/{i}")).decode()
+            if nid not in ids:
+                ids.append(nid)
+        return ids
+
+    def alive_members(self) -> List[str]:
+        """Nodes whose heartbeat sequence advanced within `timeout` seconds
+        (local-clock measurement; no cross-host clock sync needed)."""
+        now = time.monotonic()
+        alive = []
+        for nid in self._registered():
+            try:
+                raw = bytes(self.store.get(f"elastic/hb/{nid}")).decode()
+            except Exception:  # noqa: BLE001
+                continue
+            if raw == "gone":
+                self._last_seen.pop(nid, None)
+                continue
+            seq = int(raw)
+            last = self._last_seen.get(nid)
+            if last is None or seq != last[0]:
+                self._last_seen[nid] = (seq, now)
+                alive.append(nid)
+            elif now - last[1] <= self.timeout:
+                alive.append(nid)
+        return sorted(alive)
+
+    def rank_of(self, members: Optional[List[str]] = None) -> int:
+        """Deterministic re-rank: position in the sorted alive set."""
+        members = members if members is not None else self.alive_members()
+        return members.index(self.node_id) if self.node_id in members else -1
+
+    # ---- watch ----
+    def wait_for_np(self, n: int, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.alive_members()) >= n:
+                return True
+            time.sleep(self.interval)
+        return False
+
+    def watch_once(self) -> str:
+        """One membership poll against the roster this pod launched with."""
+        alive = self.alive_members()
+        if self.node_id not in alive:
+            return ElasticStatus.EXIT
+        if len(alive) < self.np_min:
+            return ElasticStatus.HOLD
+        if self._members_cache and alive != self._members_cache:
+            return ElasticStatus.RESTART
+        if not self._members_cache:
+            self._members_cache = alive
+        return ElasticStatus.COMPLETED
+
+    def commit_roster(self) -> List[str]:
+        """Accept the current alive set as the running roster (called after a
+        [re]launch); subsequent watch_once() diffs against it."""
+        self._members_cache = self.alive_members()
+        return self._members_cache
+
+    def stop(self):
+        self._stop.set()

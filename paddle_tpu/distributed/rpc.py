@@ -1,0 +1,224 @@
+"""paddle.distributed.rpc analog (reference:
+python/paddle/distributed/rpc/rpc.py — init_rpc:73, rpc_sync:141,
+rpc_async:179, shutdown:270, get_worker_info:299; C++ transport:
+paddle/fluid/distributed/rpc/).
+
+TPU-native design: the reference rides brpc; here each worker runs a small
+threaded TCP server executing pickled (fn, args, kwargs) requests, and the
+existing TCPStore (csrc/runtime.cc) provides the rendezvous that maps worker
+names to endpoints — the same role it plays for collective init. Function
+results (including Tensors via their numpy form) are pickled back; rpc_async
+returns a concurrent.futures.Future.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Optional
+
+from .store import TCPStore, create_master_store
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = 120.0
+
+_state = None
+
+
+class _RpcState:
+    def __init__(self, name, rank, world_size, store, server, pool):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self.server = server
+        self.pool = pool
+        self.workers = {}  # name -> WorkerInfo
+
+
+def _read_full(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    (n,) = struct.unpack("<Q", _read_full(sock, 8))
+    return _read_full(sock, n)
+
+
+class _RpcServer:
+    """Threaded executor server: each request is one length-prefixed pickle
+    of (fn, args, kwargs); the response is ('ok', result) or ('err', repr)."""
+
+    def __init__(self):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", 0))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.sock.settimeout(0.2)
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    req = _recv_msg(conn)
+                except (ConnectionError, OSError):
+                    break
+                try:
+                    fn, args, kwargs = pickle.loads(req)
+                    result = fn(*args, **kwargs)
+                    resp = pickle.dumps(("ok", result))
+                except Exception as e:  # noqa: BLE001 — marshal to caller
+                    resp = pickle.dumps(("err", repr(e)))
+                _send_msg(conn, resp)
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC agent and rendezvous with the others.
+
+    Defaults come from the launcher env (PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM, PADDLE_MASTER) like the reference."""
+    global _state
+    if _state is not None:
+        raise RuntimeError("rpc is already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    host, port = ep.rsplit(":", 1)
+    port = int(port)
+
+    server = _RpcServer()
+    if world_size == 1 and port == 0:
+        store = create_master_store(port=0, world_size=1)
+    else:
+        store = TCPStore(host, port, is_master=(rank == 0),
+                         world_size=world_size)
+    ip = "127.0.0.1" if host in ("127.0.0.1", "localhost", "0.0.0.0") \
+        else socket.gethostbyname(socket.gethostname())
+    store.set(f"rpc/{rank}",
+              pickle.dumps(WorkerInfo(name, rank, ip, server.port)))
+    workers = {}
+    for r in range(world_size):
+        info = pickle.loads(store.get(f"rpc/{r}"))
+        workers[info.name] = info
+
+    _state = _RpcState(name, rank, world_size, store, server,
+                       ThreadPoolExecutor(max_workers=8))
+    _state.workers = workers
+    return None
+
+
+def _require_state():
+    if _state is None:
+        raise RuntimeError("call init_rpc before using rpc APIs")
+    return _state
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    st = _require_state()
+    if to not in st.workers:
+        raise ValueError(f"unknown rpc worker {to!r}; "
+                         f"known: {sorted(st.workers)}")
+    info = st.workers[to]
+    sock = socket.create_connection((info.ip, info.port), timeout=timeout)
+    try:
+        _send_msg(sock, pickle.dumps((fn, tuple(args or ()), kwargs or {})))
+        sock.settimeout(timeout)
+        status, payload = pickle.loads(_recv_msg(sock))
+    finally:
+        sock.close()
+    if status == "err":
+        raise RuntimeError(f"rpc to {to!r} failed remotely: {payload}")
+    return payload
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking remote call; returns fn(*args, **kwargs) run on worker `to`."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None,
+              timeout=_DEFAULT_RPC_TIMEOUT) -> Future:
+    """Non-blocking remote call; returns a Future (wait()/result())."""
+    st = _require_state()
+    fut = st.pool.submit(_invoke, to, fn, args, kwargs, timeout)
+    if not hasattr(fut, "wait"):
+        fut.wait = fut.result  # reference API parity
+    return fut
+
+
+def shutdown():
+    """Barrier with all workers, then stop the agent (reference shutdown:270)."""
+    global _state
+    if _state is None:
+        return
+    st = _state
+    # simple store barrier so no one tears down while peers still call in
+    n = st.store.add("rpc/shutdown", 1)
+    import time
+    deadline = time.time() + _DEFAULT_RPC_TIMEOUT
+    while n < st.world_size and time.time() < deadline:
+        time.sleep(0.01)
+        n = st.store.add("rpc/shutdown", 0)
+    st.server.stop()
+    st.pool.shutdown(wait=False)
+    try:
+        st.store.stop()
+    except Exception:  # noqa: BLE001 — best-effort teardown
+        pass
+    _state = None
+
+
+def get_worker_info(name) -> WorkerInfo:
+    return _require_state().workers[name]
+
+
+def get_all_worker_infos():
+    return sorted(_require_state().workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    st = _require_state()
+    return st.workers[st.name]

@@ -1,0 +1,11 @@
+"""paddle_tpu.onnx — ONNX export (reference: python/paddle/onnx/,
+paddle.onnx.export via paddle2onnx).
+
+TPU-native: converts the traced jaxpr (the closed primitive set all framework
+ops lower to) into an ONNX ModelProto via ~35 primitive converters; the wire
+format comes from the bundled onnx.proto subset compiled with protoc.
+`run_model` is a numpy reference interpreter for validation/CPU serving."""
+from .export import export  # noqa: F401
+from .interp import run_model  # noqa: F401
+
+__all__ = ["export", "run_model"]

@@ -1,0 +1,267 @@
+"""Reference numpy interpreter for the ONNX subset the exporter emits.
+
+Used by tests to validate exported models end-to-end (run the .onnx file and
+compare against the framework's own forward), and usable as a minimal
+CPU deployment path when onnxruntime is unavailable.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .proto import pb
+
+_NP_DTYPE = {
+    pb.TensorProto.FLOAT: np.float32,
+    pb.TensorProto.DOUBLE: np.float64,
+    pb.TensorProto.FLOAT16: np.float16,
+    pb.TensorProto.INT64: np.int64,
+    pb.TensorProto.INT32: np.int32,
+    pb.TensorProto.INT16: np.int16,
+    pb.TensorProto.INT8: np.int8,
+    pb.TensorProto.UINT8: np.uint8,
+    pb.TensorProto.BOOL: np.bool_,
+}
+
+
+def _tensor_to_np(t):
+    if t.data_type == pb.TensorProto.BFLOAT16:
+        import jax.numpy as jnp
+        raw = np.frombuffer(t.raw_data, np.uint16).reshape(tuple(t.dims))
+        return np.asarray(jnp.asarray(raw).view(jnp.bfloat16),
+                          dtype=np.float32)
+    dt = _NP_DTYPE[t.data_type]
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dt).reshape(tuple(t.dims)).copy()
+    if t.float_data:
+        return np.asarray(t.float_data, dt).reshape(tuple(t.dims))
+    if t.int64_data:
+        return np.asarray(t.int64_data, dt).reshape(tuple(t.dims))
+    return np.zeros(tuple(t.dims), dt)
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == pb.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == pb.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == pb.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == pb.AttributeProto.INTS:
+            out[a.name] = list(a.ints)
+        elif a.type == pb.AttributeProto.FLOATS:
+            out[a.name] = list(a.floats)
+    return out
+
+
+def _pool2d(x, ks, strides, pads, kind):
+    n, c, h, w = x.shape
+    ph0, pw0, ph1, pw1 = (pads + [0, 0, 0, 0])[:4] if len(pads) == 4 \
+        else (pads[0], pads[1], pads[0], pads[1])
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                constant_values=-np.inf if kind == "max" else 0)
+    kh, kw = ks
+    sh, sw = strides
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    out = np.empty((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            out[:, :, i, j] = win.max((2, 3)) if kind == "max" \
+                else win.mean((2, 3))
+    return out
+
+
+def _conv2d(x, w, b, strides, pads, dil, groups):
+    n, cin, h, wd = x.shape
+    cout, cin_g, kh, kw = w.shape
+    ph0, pw0, ph1, pw1 = (pads + [0, 0, 0, 0])[:4]
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    kh_e = (kh - 1) * dil[0] + 1
+    kw_e = (kw - 1) * dil[1] + 1
+    oh = (xp.shape[2] - kh_e) // strides[0] + 1
+    ow = (xp.shape[3] - kw_e) // strides[1] + 1
+    out = np.zeros((n, cout, oh, ow), np.result_type(x, w))
+    cpg_out = cout // groups
+    for g in range(groups):
+        xs = xp[:, g * cin_g:(g + 1) * cin_g]
+        ws = w[g * cpg_out:(g + 1) * cpg_out]
+        for i in range(oh):
+            for j in range(ow):
+                win = xs[:, :,
+                         i * strides[0]:i * strides[0] + kh_e:dil[0],
+                         j * strides[1]:j * strides[1] + kw_e:dil[1]]
+                out[:, g * cpg_out:(g + 1) * cpg_out, i, j] = np.einsum(
+                    "nchw,ochw->no", win, ws)
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def run_model(model_bytes_or_path, inputs: dict):
+    """Execute the model on numpy inputs; returns list of output arrays."""
+    if isinstance(model_bytes_or_path, (str, bytes)) and \
+            not isinstance(model_bytes_or_path, bytes):
+        with open(model_bytes_or_path, "rb") as f:
+            blob = f.read()
+    else:
+        blob = model_bytes_or_path
+    model = pb.ModelProto.FromString(blob)
+    g = model.graph
+    env = {t.name: _tensor_to_np(t) for t in g.initializer}
+    for vi in g.input:
+        if vi.name not in inputs:
+            raise ValueError(f"missing input {vi.name!r}")
+        env[vi.name] = np.asarray(inputs[vi.name])
+
+    for node in g.node:
+        a = _attrs(node)
+        x = [env[i] for i in node.input if i]
+        op = node.op_type
+        if op == "Add":
+            r = x[0] + x[1]
+        elif op == "Sub":
+            r = x[0] - x[1]
+        elif op == "Mul":
+            r = x[0] * x[1]
+        elif op == "Div":
+            r = x[0] / x[1]
+        elif op == "Max":
+            r = np.maximum(x[0], x[1])
+        elif op == "Min":
+            r = np.minimum(x[0], x[1])
+        elif op == "Pow":
+            r = np.power(x[0], x[1])
+        elif op == "Mod":
+            r = np.mod(x[0], x[1])
+        elif op == "MatMul":
+            r = np.matmul(x[0], x[1])
+        elif op == "Neg":
+            r = -x[0]
+        elif op == "Abs":
+            r = np.abs(x[0])
+        elif op == "Exp":
+            r = np.exp(x[0])
+        elif op == "Log":
+            r = np.log(x[0])
+        elif op == "Tanh":
+            r = np.tanh(x[0])
+        elif op == "Sigmoid":
+            r = 1.0 / (1.0 + np.exp(-x[0]))
+        elif op == "Erf":
+            from scipy.special import erf
+            r = erf(x[0]).astype(x[0].dtype)
+        elif op == "Sqrt":
+            r = np.sqrt(x[0])
+        elif op == "Reciprocal":
+            r = 1.0 / x[0]
+        elif op == "Sign":
+            r = np.sign(x[0])
+        elif op == "Floor":
+            r = np.floor(x[0])
+        elif op == "Ceil":
+            r = np.ceil(x[0])
+        elif op == "Round":
+            r = np.round(x[0])
+        elif op == "Not":
+            r = ~x[0].astype(bool)
+        elif op == "Sin":
+            r = np.sin(x[0])
+        elif op == "Cos":
+            r = np.cos(x[0])
+        elif op == "IsInf":
+            r = np.isinf(x[0])
+        elif op == "IsNaN":
+            r = np.isnan(x[0])
+        elif op == "And":
+            r = x[0] & x[1]
+        elif op == "Or":
+            r = x[0] | x[1]
+        elif op == "Xor":
+            r = x[0] ^ x[1]
+        elif op == "Equal":
+            r = x[0] == x[1]
+        elif op == "Less":
+            r = x[0] < x[1]
+        elif op == "LessOrEqual":
+            r = x[0] <= x[1]
+        elif op == "Greater":
+            r = x[0] > x[1]
+        elif op == "GreaterOrEqual":
+            r = x[0] >= x[1]
+        elif op == "Identity":
+            r = x[0]
+        elif op == "Cast":
+            to = a["to"]
+            if to == pb.TensorProto.BFLOAT16:
+                r = x[0].astype(np.float32)
+            else:
+                r = x[0].astype(_NP_DTYPE[to])
+        elif op == "Reshape":
+            r = x[0].reshape(tuple(int(d) for d in x[1]))
+        elif op == "Transpose":
+            r = np.transpose(x[0], a.get("perm"))
+        elif op == "Expand":
+            r = np.broadcast_to(x[0], tuple(int(d) for d in x[1])).copy()
+        elif op == "ReduceSum":
+            axes = tuple(int(d) for d in x[1]) if len(x) > 1 else None
+            r = x[0].sum(axis=axes, keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ReduceMax":
+            r = x[0].max(axis=tuple(a["axes"]),
+                         keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ReduceMin":
+            r = x[0].min(axis=tuple(a["axes"]),
+                         keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ReduceProd":
+            r = x[0].prod(axis=tuple(a["axes"]),
+                          keepdims=bool(a.get("keepdims", 1)))
+        elif op == "Concat":
+            r = np.concatenate(x, axis=a["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (x[1], x[2], x[3], x[4])
+            idx = [slice(None)] * x[0].ndim
+            big = np.iinfo(np.int64).max
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                e = int(e)
+                s = int(s)
+                st = int(st)
+                if st < 0 and e <= -big:
+                    e = None
+                idx[int(ax)] = slice(s, e, st)
+            r = x[0][tuple(idx)]
+        elif op == "Where":
+            r = np.where(x[0], x[1], x[2])
+        elif op == "Gather":
+            r = np.take(x[0], x[1].astype(np.int64), axis=a.get("axis", 0))
+        elif op == "Pad":
+            pads = x[1]
+            n = x[0].ndim
+            pw = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+            cv = float(x[2]) if len(x) > 2 else 0.0
+            r = np.pad(x[0], pw, constant_values=cv)
+        elif op == "Conv":
+            b = x[2] if len(x) > 2 else None
+            r = _conv2d(x[0], x[1], b, a.get("strides", [1, 1]),
+                        a.get("pads", [0, 0, 0, 0]),
+                        a.get("dilations", [1, 1]), a.get("group", 1))
+        elif op == "MaxPool":
+            r = _pool2d(x[0], a["kernel_shape"], a.get("strides", [1, 1]),
+                        a.get("pads", [0, 0, 0, 0]), "max")
+        elif op == "AveragePool":
+            r = _pool2d(x[0], a["kernel_shape"], a.get("strides", [1, 1]),
+                        a.get("pads", [0, 0, 0, 0]), "avg")
+        elif op == "ArgMax":
+            r = np.argmax(x[0], axis=a.get("axis", 0))
+            if a.get("keepdims", 1):
+                r = np.expand_dims(r, a.get("axis", 0))
+        elif op == "ArgMin":
+            r = np.argmin(x[0], axis=a.get("axis", 0))
+            if a.get("keepdims", 1):
+                r = np.expand_dims(r, a.get("axis", 0))
+        else:
+            raise NotImplementedError(f"interp: op {op}")
+        env[node.output[0]] = np.asarray(r)
+
+    return [env[o.name] for o in g.output]

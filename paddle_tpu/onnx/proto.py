@@ -1,0 +1,43 @@
+"""Compile the bundled onnx.proto subset with protoc and import the generated
+module (cached next to the package). protobuf runtime ships in the image;
+the generated file is rebuilt whenever onnx.proto changes."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_PROTO = os.path.join(_DIR, "onnx.proto")
+_GEN = os.path.join(_DIR, "_gen")
+_PB2 = os.path.join(_GEN, "onnx_pb2.py")
+
+
+def _ensure_compiled():
+    if os.path.exists(_PB2) and \
+            os.path.getmtime(_PB2) >= os.path.getmtime(_PROTO):
+        return
+    os.makedirs(_GEN, exist_ok=True)
+    tmp = os.path.join(_GEN, "onnx_pb2.py.tmp.%d" % os.getpid())
+    subprocess.run(
+        ["protoc", f"--proto_path={_DIR}", f"--python_out={_GEN}",
+         "onnx.proto"], check=True, capture_output=True)
+    # protoc writes onnx_pb2.py directly; make the publish atomic for
+    # concurrent importers
+    produced = os.path.join(_GEN, "onnx_pb2.py")
+    if produced != _PB2:
+        os.replace(produced, _PB2)
+    open(os.path.join(_GEN, "__init__.py"), "a").close()
+
+
+def load_pb2():
+    _ensure_compiled()
+    spec = importlib.util.spec_from_file_location("paddle_tpu_onnx_pb2", _PB2)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("paddle_tpu_onnx_pb2", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+pb = load_pb2()

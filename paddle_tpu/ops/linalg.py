@@ -11,7 +11,8 @@ from .dispatch import apply
 __all__ = [
     "norm", "cond", "matrix_power", "det", "slogdet", "inv", "pinv", "solve",
     "triangular_solve", "cholesky", "cholesky_solve", "qr", "svd", "eig", "eigh",
-    "eigvals", "eigvalsh", "lu", "matrix_rank", "multi_dot", "lstsq", "corrcoef",
+    "eigvals", "eigvalsh", "lu", "lu_unpack", "matrix_rank", "multi_dot",
+    "lstsq", "corrcoef",
     "cov", "householder_product", "pca_lowrank",
 ]
 
@@ -180,4 +181,37 @@ def pca_lowrank(x, q=None, center=True, niter=2):
         u, s, vt = jnp.linalg.svd(vv, full_matrices=False)
         return u[..., :qq], s[..., :qq], jnp.swapaxes(vt, -1, -2)[..., :qq]
     out = apply(f, x, op_name="pca_lowrank")
+    return out[0], out[1], out[2]
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """Unpack jax.scipy lu_factor output into (P, L, U) (paddle.linalg.lu_unpack;
+    pivots are 1-based as produced by paddle_tpu.linalg.lu)."""
+    def f(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots (1-based sequential row swaps) -> permutation matrix
+        piv0 = piv.astype(jnp.int32) - 1
+
+        def perm_of(pv):
+            perm = jnp.arange(m)
+
+            def body(i, p):
+                j = pv[i]
+                pi, pj = p[i], p[j]
+                return p.at[i].set(pj).at[j].set(pi)
+            return jax.lax.fori_loop(0, pv.shape[0], body, perm)
+
+        if piv0.ndim == 1:
+            perm = perm_of(piv0)
+            P = jnp.eye(m, dtype=lu_.dtype)[:, perm]
+        else:
+            batch = piv0.reshape(-1, piv0.shape[-1])
+            perms = jax.vmap(perm_of)(batch)
+            P = jax.vmap(lambda p: jnp.eye(m, dtype=lu_.dtype)[:, p])(perms)
+            P = P.reshape(*piv0.shape[:-1], m, m)
+        return P, L, U
+    out = apply(f, lu_data, lu_pivots, op_name="lu_unpack")
     return out[0], out[1], out[2]

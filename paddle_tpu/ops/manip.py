@@ -525,3 +525,189 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False):
     dt = jnp.int32 if out_int32 else jnp.int64
     return apply(lambda s, v: jnp.searchsorted(s, v, side=side).astype(dt),
                  sorted_sequence, values, op_name="searchsorted")
+
+
+# ---------- round-2 breadth sweep (VERDICT r1 item 8) ----------
+# python/paddle/tensor/manipulation.py indexing/view/split analogs
+
+@_export
+def index_add(x, index, axis, value):
+    def f(v, i, val):
+        i = i.astype(jnp.int32)
+        ax = axis % v.ndim
+        import builtins
+        idx = tuple(i if d == ax else builtins.slice(None)
+                    for d in range(v.ndim))
+        return v.at[idx].add(val.astype(v.dtype))
+    return apply(f, x, index, value, op_name="index_add")
+
+
+@_export
+def index_fill(x, index, axis, value):
+    def f(v, i, *rest):
+        val = rest[0] if rest else value
+        i = i.astype(jnp.int32)
+        ax = axis % v.ndim
+        import builtins
+        idx = tuple(i if d == ax else builtins.slice(None)
+                    for d in range(v.ndim))
+        return v.at[idx].set(jnp.asarray(val, v.dtype))
+    if hasattr(value, "shape") or isinstance(value, Tensor):
+        return apply(f, x, index, value, op_name="index_fill")
+    return apply(f, x, index, op_name="index_fill")
+
+
+@_export
+def index_put(x, indices, value, accumulate=False):
+    def f(v, val, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer)
+                    else i for i in idx)
+        if accumulate:
+            return v.at[idx].add(val.astype(v.dtype))
+        return v.at[idx].set(val.astype(v.dtype))
+    return apply(f, x, value, *indices, op_name="index_put")
+
+
+@_export
+def masked_scatter(x, mask, value):
+    """Fill masked positions of x with consecutive elements of value."""
+    def f(v, m, val):
+        m = m.astype(bool)
+        flatm = jnp.broadcast_to(m, v.shape).reshape(-1)
+        # k-th True position takes value.flat[k]
+        order = jnp.cumsum(flatm) - 1
+        src = val.reshape(-1)
+        gath = src[jnp.clip(order, 0, src.size - 1)]
+        return jnp.where(flatm, gath, v.reshape(-1)).reshape(v.shape).astype(v.dtype)
+    return apply(f, x, mask, value, op_name="masked_scatter")
+
+
+def _split_equal(name, axis):
+    def fn(x, num_or_indices):
+        def f(v):
+            if isinstance(num_or_indices, int):
+                return tuple(jnp.split(v, num_or_indices, axis=axis))
+            return tuple(jnp.split(v, list(num_or_indices), axis=axis))
+        return apply(f, x, op_name=name)
+    fn.__name__ = name
+    return _export(fn, name)
+
+
+vsplit = _split_equal("vsplit", 0)
+dsplit = _split_equal("dsplit", 2)
+
+
+@_export
+def hsplit(x, num_or_indices):
+    """Split on axis 1, or axis 0 for 1-D input (numpy hsplit semantics)."""
+    def f(v):
+        ax = 0 if v.ndim == 1 else 1
+        if isinstance(num_or_indices, int):
+            return tuple(jnp.split(v, num_or_indices, axis=ax))
+        return tuple(jnp.split(v, list(num_or_indices), axis=ax))
+    return apply(f, x, op_name="hsplit")
+
+
+@_export
+def tensor_split(x, num_or_indices, axis=0):
+    def f(v):
+        if isinstance(num_or_indices, int):
+            # uneven split allowed (numpy array_split semantics)
+            return tuple(jnp.array_split(v, num_or_indices, axis=axis))
+        return tuple(jnp.split(v, list(num_or_indices), axis=axis))
+    return apply(f, x, op_name="tensor_split")
+
+
+@_export
+def take(x, index, mode="raise"):
+    def f(v, i):
+        flat = v.reshape(-1)
+        i = i.astype(jnp.int32)
+        if mode == "wrap":
+            i = jnp.mod(i, flat.size)
+        elif mode == "clip":
+            i = jnp.clip(i, -flat.size, flat.size - 1)
+        i = jnp.where(i < 0, i + flat.size, i)
+        return flat[i]
+    return apply(f, x, index, op_name="take")
+
+
+@_export
+def unfold(x, axis, size, step):
+    """Sliding windows over `axis`: shape [..., n_windows, ..., size]
+    (window dim appended last, matching paddle.unfold/Tensor.unfold)."""
+    def f(v):
+        ax = axis % v.ndim
+        n = (v.shape[ax] - size) // step + 1
+        starts = jnp.arange(n) * step
+        def win(s):
+            return jax.lax.dynamic_slice_in_dim(v, s, size, axis=ax)
+        out = jax.vmap(win)(starts)  # [n, ..., size at ax, ...]
+        out = jnp.moveaxis(out, 0, ax)  # [..., n, size, ...] with size at ax+1
+        return jnp.moveaxis(out, ax + 1, -1)
+    return apply(f, x, op_name="unfold")
+
+
+@_export
+def unflatten(x, axis, shape):
+    shape = _static_ints(shape)
+
+    def f(v):
+        ax = axis % v.ndim
+        new = list(v.shape[:ax]) + list(shape) + list(v.shape[ax + 1:])
+        # one -1 allowed
+        return v.reshape(new)
+    return apply(f, x, op_name="unflatten")
+
+
+@_export
+def view(x, shape_or_dtype):
+    def f(v):
+        if isinstance(shape_or_dtype, (list, tuple)):
+            return v.reshape([int(s) for s in shape_or_dtype])
+        from ..core import dtype as _dt
+        return v.view(_dt.convert_dtype(shape_or_dtype))
+    return apply(f, x, op_name="view")
+
+
+@_export
+def view_as(x, other):
+    return apply(lambda v, o: v.reshape(o.shape), x, other, op_name="view_as")
+
+
+@_export
+def crop(x, shape=None, offsets=None):
+    def f(v):
+        shp = _static_ints(shape) if shape is not None else list(v.shape)
+        shp = [v.shape[i] if s == -1 else s for i, s in enumerate(shp)]
+        offs = _static_ints(offsets) if offsets is not None else [0] * v.ndim
+        import builtins
+        idx = tuple(builtins.slice(o, o + s) for o, s in zip(offs, shp))
+        return v[idx]
+    return apply(f, x, op_name="crop")
+
+
+@_export
+def as_complex(x):
+    """[..., 2] real pairs -> complex."""
+    return apply(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x,
+                 op_name="as_complex")
+
+
+@_export
+def as_real(x):
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x,
+                 op_name="as_real")
+
+
+@_export
+def polar(abs, angle):
+    def f(r, t):
+        return jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t))
+    return apply(f, abs, angle, op_name="polar")
+
+
+def tolist(x):
+    import numpy as _np
+    return _np.asarray(x._value if isinstance(x, Tensor) else x).tolist()
+_export(tolist)

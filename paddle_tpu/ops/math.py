@@ -427,3 +427,262 @@ _export("cast", cast)
 def astype(x, dtype):
     return cast(x, dtype)
 _export("astype", astype)
+
+
+# ---------- round-2 breadth sweep (VERDICT r1 item 8) ----------
+# python/paddle/tensor/math.py analogs
+
+def logcumsumexp(x, axis=None, dtype=None):
+    def f(v):
+        vv = v if axis is not None else v.reshape(-1)
+        ax = axis if axis is not None else 0
+        m = jnp.max(vv, axis=ax, keepdims=True)
+        m = jnp.where(jnp.isfinite(m), m, 0.0)
+        out = jnp.log(jnp.cumsum(jnp.exp(vv - m), axis=ax)) + m
+        return out
+    return apply(f, x, op_name="logcumsumexp")
+_export("logcumsumexp", logcumsumexp)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    args = [a for a in (prepend, append) if a is not None]
+
+    def f(v, *rest):
+        it = iter(rest)
+        pre = next(it) if prepend is not None else None
+        app = next(it) if append is not None else None
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+    return apply(f, x, *args, op_name="diff")
+_export("diff", diff)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    def f(yy, *rest):
+        xx = rest[0] if x is not None else None
+        d = 1.0 if dx is None else dx
+        if xx is not None:
+            return jnp.trapezoid(yy, xx, axis=axis)
+        return jnp.trapezoid(yy, dx=d, axis=axis)
+    if x is not None:
+        return apply(f, y, x, op_name="trapezoid")
+    return apply(f, y, op_name="trapezoid")
+_export("trapezoid", trapezoid)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    def f(yy, *rest):
+        xx = rest[0] if x is not None else None
+        d = 1.0 if dx is None else dx
+        yl = jax.numpy.moveaxis(yy, axis, -1)
+        if xx is not None:
+            xl = jax.numpy.moveaxis(jnp.broadcast_to(xx, yy.shape), axis, -1) \
+                if xx.ndim > 1 else xx
+            dxs = jnp.diff(xl, axis=-1)
+        else:
+            dxs = d
+        avg = (yl[..., 1:] + yl[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * dxs, axis=-1)
+        return jax.numpy.moveaxis(out, -1, axis)
+    if x is not None:
+        return apply(f, y, x, op_name="cumulative_trapezoid")
+    return apply(f, y, op_name="cumulative_trapezoid")
+_export("cumulative_trapezoid", cumulative_trapezoid)
+
+
+def frexp(x):
+    def f(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(jnp.int32)
+    return apply(f, x, op_name="frexp")
+_export("frexp", frexp)
+
+
+def ldexp(x, y):
+    return apply(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, y,
+                 op_name="ldexp")
+_export("ldexp", ldexp)
+
+
+def polygamma(x, n=1):
+    from jax.scipy.special import polygamma as _pg
+    return apply(lambda v: _pg(n, v), x, op_name="polygamma")
+_export("polygamma", polygamma)
+
+
+def gammaln(x):
+    return apply(jax.scipy.special.gammaln, x, op_name="gammaln")
+_export("gammaln", gammaln)
+
+
+def gammainc(x, y):
+    """Regularized lower incomplete gamma P(x, y) (paddle.gammainc)."""
+    return apply(jax.scipy.special.gammainc, x, y, op_name="gammainc")
+_export("gammainc", gammainc)
+
+
+def gammaincc(x, y):
+    return apply(jax.scipy.special.gammaincc, x, y, op_name="gammaincc")
+_export("gammaincc", gammaincc)
+
+
+def renorm(x, p, axis, max_norm):
+    """Renormalize slices along `axis` to at most max_norm in p-norm."""
+    def f(v):
+        perm_axis = axis % v.ndim
+        red = tuple(i for i in range(v.ndim) if i != perm_axis)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=red, keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * scale
+    return apply(f, x, op_name="renorm")
+_export("renorm", renorm)
+
+
+def add_n(inputs):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    def f(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+    return apply(f, *inputs, op_name="add_n")
+_export("add_n", add_n)
+
+
+def rank(x):
+    return apply(lambda v: jnp.asarray(v.ndim, jnp.int32), x, op_name="rank")
+_export("rank", rank)
+
+
+def shape(x):
+    return apply(lambda v: jnp.asarray(v.shape, jnp.int32), x, op_name="shape")
+_export("shape", shape)
+
+
+def is_complex(x):
+    return bool(jnp.issubdtype(_u(x).dtype, jnp.complexfloating))
+_export("is_complex", is_complex)
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(_u(x).dtype, jnp.floating))
+_export("is_floating_point", is_floating_point)
+
+
+def is_integer(x):
+    return bool(jnp.issubdtype(_u(x).dtype, jnp.integer))
+_export("is_integer", is_integer)
+
+
+def is_empty(x):
+    return apply(lambda v: jnp.asarray(v.size == 0), x, op_name="is_empty")
+_export("is_empty", is_empty)
+
+
+def inverse(x):
+    return apply(jnp.linalg.inv, x, op_name="inverse")
+_export("inverse", inverse)
+
+
+def dist(x, y, p=2.0):
+    def f(a, b):
+        d = (a - b).reshape(-1)
+        import math as _m
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if _m.isinf(p):
+            return jnp.max(jnp.abs(d)) if p > 0 else jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply(f, x, y, op_name="dist")
+_export("dist", dist)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    """Pairwise p-distance between row sets [..., P, M] and [..., R, M];
+    p=0 counts differing coordinates (hamming, matching paddle.cdist)."""
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        import math as _m
+        if p == 0:
+            return jnp.sum(d != 0, axis=-1).astype(a.dtype)
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1) + 0.0)
+        if _m.isinf(p):
+            return jnp.max(jnp.abs(d), axis=-1)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return apply(f, x, y, op_name="cdist")
+_export("cdist", cdist)
+
+
+def multiplex(inputs, index):
+    """Row-wise select among candidate tensors (paddle.multiplex)."""
+    import builtins
+    _all = builtins.slice(None)
+
+    def f(idx, *cands):
+        stacked = jnp.stack(cands, 0)  # [C, B, ...]
+        sel = idx.reshape(-1).astype(jnp.int32)
+        sel_ix = sel[(None, _all) + (None,) * (stacked.ndim - 2)]
+        return jnp.take_along_axis(stacked, sel_ix, axis=0)[0]
+    return apply(f, index, *inputs, op_name="multiplex")
+_export("multiplex", multiplex)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return apply(lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim),
+                 x, op_name="nanmedian")
+_export("nanmedian", nanmedian)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return apply(lambda v: jnp.nanquantile(v, q, axis=axis, keepdims=keepdim),
+                 x, op_name="nanquantile")
+_export("nanquantile", nanquantile)
+
+
+def isin(x, test_x, assume_unique=False, invert=False):
+    return apply(lambda a, b: jnp.isin(a, b, invert=invert), x, test_x,
+                 op_name="isin")
+_export("isin", isin)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    def f(v, seq):
+        side = "right" if right else "left"
+        out = jnp.searchsorted(seq, v, side=side)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply(f, x, sorted_sequence, op_name="bucketize")
+_export("bucketize", bucketize)
+
+
+def digitize(x, bins, right=False):
+    return apply(lambda v, b: jnp.digitize(v, b, right=right), x, bins,
+                 op_name="digitize")
+_export("digitize", digitize)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    def f(v, *w):
+        ww = w[0] if w else None
+        h, edges = jnp.histogramdd(v, bins=bins, range=ranges,
+                                   density=density, weights=ww)
+        return (h, *edges)
+    if weights is not None:
+        return apply(f, x, weights, op_name="histogramdd")
+    return apply(f, x, op_name="histogramdd")
+_export("histogramdd", histogramdd)
+
+
+def vander(x, n=None, increasing=False):
+    return apply(lambda v: jnp.vander(v, N=n, increasing=increasing), x,
+                 op_name="vander")
+_export("vander", vander)
+
+
+def tensordot(x, y, axes=2):
+    ax = axes
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y,
+                 op_name="tensordot")
+_export("tensordot", tensordot)

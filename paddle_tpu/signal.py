@@ -1,0 +1,150 @@
+"""paddle_tpu.signal — analog of python/paddle/signal.py (frame:30,
+overlap_add:145, stft:246, istft:425).
+
+All pure jnp: frame extraction is a strided gather, stft is frame → window →
+rfft/fft (XLA FFT HLO), istft the least-squares inverse with window
+normalization. Differentiable through the tape like every other op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops.dispatch import apply
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames: [..., seq] -> [..., frame_length, n]
+    (axis=-1) or [seq, ...] -> [n, frame_length, ...] (axis=0)."""
+    def f(v):
+        ax = axis % v.ndim
+        n = (v.shape[ax] - frame_length) // hop_length + 1
+        starts = jnp.arange(n) * hop_length
+
+        def win(s):
+            return jax.lax.dynamic_slice_in_dim(v, s, frame_length, axis=ax)
+        out = jax.vmap(win)(starts)  # [n, ..., frame_length, ...]
+        if axis in (-1, v.ndim - 1):
+            # -> [..., frame_length, n]
+            return jnp.moveaxis(out, 0, -1)
+        # axis == 0 -> [n, frame_length, ...]
+        return out
+    return apply(f, x, op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: [..., frame_length, n] -> [..., seq]."""
+    def f(v):
+        if axis in (-1, v.ndim - 1):
+            fl, n = v.shape[-2], v.shape[-1]
+            seq = (n - 1) * hop_length + fl
+            lead = v.shape[:-2]
+            out = jnp.zeros(lead + (seq,), v.dtype)
+
+            def body(i, acc):
+                sl = jax.lax.dynamic_slice_in_dim(v, i, 1, axis=-1)[..., 0]
+                return jax.lax.dynamic_update_slice_in_dim(
+                    acc, jax.lax.dynamic_slice_in_dim(
+                        acc, i * hop_length, fl, axis=-1) + sl,
+                    i * hop_length, axis=-1)
+            return jax.lax.fori_loop(0, n, body, out)
+        # axis == 0: [n, frame_length, ...]
+        n, fl = v.shape[0], v.shape[1]
+        seq = (n - 1) * hop_length + fl
+        out = jnp.zeros((seq,) + v.shape[2:], v.dtype)
+
+        def body(i, acc):
+            sl = v[i]
+            cur = jax.lax.dynamic_slice_in_dim(acc, i * hop_length, fl, axis=0)
+            return jax.lax.dynamic_update_slice_in_dim(
+                acc, cur + sl, i * hop_length, axis=0)
+        return jax.lax.fori_loop(0, n, body, out)
+    return apply(f, x, op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """[B?, seq] -> [B?, n_freq, n_frames] complex spectrogram."""
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+
+    def f(v, *w):
+        win = w[0] if w else jnp.ones((wl,), v.dtype)
+        pad = (n_fft - wl) // 2
+        win = jnp.pad(win, (pad, n_fft - wl - pad))
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if center:
+            v = jnp.pad(v, [(0, 0), (n_fft // 2, n_fft // 2)], mode=pad_mode)
+        n = (v.shape[-1] - n_fft) // hop + 1
+        starts = jnp.arange(n) * hop
+        frames = jax.vmap(
+            lambda s: jax.lax.dynamic_slice_in_dim(v, s, n_fft, axis=-1)
+        )(starts)  # [n, B, n_fft]
+        frames = jnp.moveaxis(frames, 0, 1) * win  # [B, n, n_fft]
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.swapaxes(spec, -1, -2)  # [B, n_freq, n_frames]
+        return spec[0] if squeeze else spec
+    if window is not None:
+        return apply(f, x, window, op_name="stft")
+    return apply(f, x, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with least-squares window normalization."""
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+
+    def f(v, *w):
+        win = w[0] if w else jnp.ones((wl,), jnp.float32)
+        pad = (n_fft - wl) // 2
+        win = jnp.pad(win, (pad, n_fft - wl - pad))
+        squeeze = v.ndim == 2
+        if squeeze:
+            v = v[None]
+        spec = jnp.swapaxes(v, -1, -2)  # [B, n, n_freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.real(jnp.fft.ifft(spec, axis=-1))
+        frames = frames * win  # [B, n, n_fft]
+        n = frames.shape[1]
+        seq = (n - 1) * hop + n_fft
+        out = jnp.zeros(frames.shape[:1] + (seq,), frames.dtype)
+        den = jnp.zeros((seq,), frames.dtype)
+        wsq = win * win
+
+        def body(i, carry):
+            acc, dd = carry
+            cur = jax.lax.dynamic_slice_in_dim(acc, i * hop, n_fft, axis=-1)
+            acc = jax.lax.dynamic_update_slice_in_dim(
+                acc, cur + frames[:, i], i * hop, axis=-1)
+            dcur = jax.lax.dynamic_slice_in_dim(dd, i * hop, n_fft, axis=-1)
+            dd = jax.lax.dynamic_update_slice_in_dim(
+                dd, dcur + wsq, i * hop, axis=-1)
+            return acc, dd
+        out, den = jax.lax.fori_loop(0, n, body, (out, den))
+        out = out / jnp.maximum(den, 1e-11)
+        if center:
+            out = out[:, n_fft // 2: seq - n_fft // 2]
+        if length is not None:
+            if out.shape[1] < length:  # torch/paddle pad short reconstructions
+                out = jnp.pad(out, [(0, 0), (0, length - out.shape[1])])
+            out = out[:, :length]
+        return out[0] if squeeze else out
+    if window is not None:
+        return apply(f, x, window, op_name="istft")
+    return apply(f, x, op_name="istft")

