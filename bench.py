@@ -3,14 +3,55 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference publishes no absolute numbers (BASELINE.md), so vs_baseline is
 reported against the driver-tracked north-star proxy: achieved model FLOPs
-utilization (MFU) fraction of the 40% target on this chip.
+utilization (MFU) as a fraction of the 40% target on this chip.
+
+Integrity (VERDICT r1 weak #5 / item 10):
+- peak TFLOP/s derived from the attached device kind (not hard-coded),
+- FLOP count includes attention (6*N*T + 12*L*B*S^2*H*D_head, causal x0.5),
+- the metric name carries the real parameter count,
+- the compiled step's HLO is inspected to report whether the Pallas flash
+  kernel (tpu_custom_call) or plain XLA attention actually ran.
 """
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
+
+# bf16 peak TFLOP/s per chip by device kind (public spec sheets)
+_PEAK_BF16_TFLOPS = {
+    "TPU v2": 46.0,
+    "TPU v3": 123.0,
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,        # v5p
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,   # v6e / Trillium
+    "TPU v6e": 918.0,
+    "TPU v7": 4614.0,
+}
+
+
+def _peak_tflops(device) -> tuple[float, str]:
+    kind = getattr(device, "device_kind", "") or ""
+    for key, val in sorted(_PEAK_BF16_TFLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.startswith(key):
+            return val, kind
+    return 197.0, f"{kind or 'unknown'} (assumed v5e peak)"
+
+
+def _attention_kernel_provenance(step, batch) -> str:
+    """Inspect the HLO of the EXACT benchmarked train step."""
+    try:
+        txt = step.lower_text(batch)
+    except Exception as e:  # noqa: BLE001 — provenance is best-effort
+        return f"lowering-failed({type(e).__name__})"
+    if "tpu_custom_call" in txt or "mosaic" in txt.lower():
+        return "pallas_flash_attention"
+    return "xla_dot_attention"
 
 
 def main():
@@ -19,10 +60,14 @@ def main():
     import paddle_tpu as P
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, build_hybrid_train_step
 
+    dev = jax.devices()[0]
+    peak, kind = _peak_tflops(dev)
+
     P.seed(0)
-    # a single-chip-sized LLaMA (fits v5e HBM with fp32 master params + Adam)
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2752,
-                      num_hidden_layers=8, num_attention_heads=16,
+    # sized to use the chip's HBM with fp32 master params + AdamW moments
+    # (~382M params -> ~5.4 GB states) while keeping compile time sane
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1536, intermediate_size=4128,
+                      num_hidden_layers=10, num_attention_heads=16,
                       max_position_embeddings=1024)
     seq = 1024
     batch = 16
@@ -36,7 +81,7 @@ def main():
     ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
     b = {"input_ids": P.to_tensor(ids[:, :-1]), "labels": P.to_tensor(ids[:, 1:])}
 
-    import jax as _jax
+    kernel = _attention_kernel_provenance(step, b)
 
     last = {}
 
@@ -49,36 +94,42 @@ def main():
         for _ in range(n):
             loss = step(b)
         last["loss"] = float(loss.numpy())
-        leaf = _jax.tree_util.tree_leaves(step.state["params"])[0]
+        leaf = jax.tree_util.tree_leaves(step.state["params"])[0]
         _ = float(leaf[(0,) * leaf.ndim])  # device-side index, tiny transfer
         return time.perf_counter() - t0
 
     # warmup (compile + steady state)
     run_blocked(3)
 
-    n_steps = 30
+    n_steps = 20
     dt = min(run_blocked(n_steps), run_blocked(n_steps)) / n_steps
 
     tokens_per_sec = batch * seq / dt
 
-    # param count & rough train FLOPs (6 * N * tokens, PaLM-style)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    flops_per_step = 6.0 * n_params * batch * seq
+    # 6ND matmul FLOPs + causal attention FLOPs:
+    # fwd attention = 4*B*S^2*H*Dh per layer (QK^T and PV), x3 for fwd+bwd,
+    # x0.5 causal sparsity
+    tokens = batch * seq
+    matmul_flops = 6.0 * n_params * tokens
+    attn_flops = (12.0 * cfg.num_hidden_layers * batch * seq * seq
+                  * cfg.hidden_size * 0.5)
+    flops_per_step = matmul_flops + attn_flops
     achieved_tflops = flops_per_step / dt / 1e12
-    # v5e peak ~197 TFLOP/s bf16, ~98 fp32; use bf16 peak as the MFU denom
-    mfu = achieved_tflops / 197.0
+    mfu = achieved_tflops / peak
     vs_baseline = mfu / 0.40  # fraction of the 40%-MFU north-star
 
     print(json.dumps({
-        "metric": "llama_1b-ish_train_tokens_per_sec_per_chip",
+        "metric": f"llama_{n_params/1e6:.0f}M_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(vs_baseline, 4),
     }))
     # extra context on stderr for humans
-    import sys
-    print(f"# params={n_params/1e6:.1f}M step={dt*1000:.1f}ms "
-          f"achieved={achieved_tflops:.1f}TFLOP/s mfu={mfu*100:.1f}% "
+    print(f"# device={kind} peak={peak}TFLOP/s params={n_params/1e6:.1f}M "
+          f"step={dt*1000:.1f}ms achieved={achieved_tflops:.1f}TFLOP/s "
+          f"(matmul {matmul_flops/dt/1e12:.1f} + attn {attn_flops/dt/1e12:.1f}) "
+          f"mfu={mfu*100:.1f}% attention_kernel={kernel} "
           f"loss={last['loss']:.3f}", file=sys.stderr)
 
 

@@ -722,7 +722,19 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
             state["params"], state["opt"], vals, lr, st, rng)
         return Tensor(loss)
 
+    def lower_text(batch):
+        """StableHLO of the EXACT compiled train step (for kernel-provenance
+        checks: e.g. grep tpu_custom_call to confirm the Pallas attention)."""
+        vals = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                for k, v in batch.items()}
+        lr = jnp.asarray(base_opt.get_lr(), jnp.float32)
+        st = jnp.asarray(1, jnp.int32)
+        rng = gen.next_key()
+        return jitted.lower(state["params"], state["opt"], vals, lr, st,
+                            rng).as_text()
+
     step.state = state
+    step.lower_text = lower_text
     step.write_back = lambda: _write_back(model, state["params"], outer_names,
                                           outer_params, block_names)
     return step

@@ -271,6 +271,10 @@ def _flash_fwd_rule(q, k, v, causal, scale):
 
 
 def _flash_bwd_rule(causal, scale, res, g):
+    return _flash_bwd_core(causal, scale, res, g, None)
+
+
+def _flash_bwd_core(causal, scale, res, g, g_lse):
     q, k, v, out, lse = res
     B, Sq, H, D = q.shape
     Hkv = k.shape[2]
@@ -289,6 +293,11 @@ def _flash_bwd_rule(causal, scale, res, g):
     outT = _pad_axis(_pad_axis(jnp.swapaxes(out, 1, 2), 2, BQ), 3, 128)
     delta = jnp.sum(doT.astype(jnp.float32) * outT.astype(jnp.float32), axis=-1,
                     keepdims=True)
+    if g_lse is not None:
+        # lse cotangent: d lse / d s = p, so it folds into ds = p*(dp - delta)
+        # as delta -= g_lse (see _bwd_*_kernel's ds computation)
+        gl = _pad_axis(g_lse.astype(jnp.float32)[..., None], 2, BQ)
+        delta = delta - gl
 
     Bp, Hp, Sqp, Dp = qT.shape
     Skp = kT.shape[2]
@@ -349,6 +358,32 @@ def _flash_bwd_rule(causal, scale, res, g):
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_lse(q, k, v, causal=False, scale=None):
+    """flash_attention that ALSO returns the per-row logsumexp [B, H, Sq]
+    (fp32) — the merge state needed to combine partial attentions across
+    K/V chunks (ring attention, two-pass decode). The custom VJP handles
+    cotangents for BOTH outputs, so a downstream logsumexp merge
+    differentiates exactly."""
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale)
+    sq = q.shape[1]
+    return out, lse[:, :, :sq, 0]
+
+
+def _flash_lse_fwd_rule(q, k, v, causal, scale):
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale)
+    sq = q.shape[1]
+    return (out, lse[:, :, :sq, 0]), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd_rule(causal, scale, res, g):
+    g_out, g_lse = g
+    return _flash_bwd_core(causal, scale, res, g_out, g_lse)
+
+
+flash_attention_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=None):
