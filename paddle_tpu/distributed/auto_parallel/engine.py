@@ -167,7 +167,13 @@ class Engine:
         self._build_train_step()
         return self
 
-    def dist_main_program(self, mode="train"):  # parity shim: XLA owns programs
+    def dist_main_program(self, mode="train"):
+        """API-parity shim that returns None BY DESIGN: the reference's
+        Engine materializes per-rank ProgramDescs (auto_parallel/static/
+        engine.py:55 ecosystem — Completer/Partitioner/Resharder); here the
+        partitioning is GSPMD inside one jitted XLA program, so there is no
+        per-rank Program object to hand out. Use `self._train_step` (the
+        compiled step) or jax lowering text for inspection instead."""
         return None
 
     def __call__(self, *batch):

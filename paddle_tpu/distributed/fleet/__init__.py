@@ -6,6 +6,9 @@ from .fleet import (  # noqa: F401
     is_first_worker, get_hybrid_communicate_group,
 )
 from .hybrid_optimizer import HybridParallelOptimizer  # noqa: F401
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker,
+)
 from .moe import MoELayer, NaiveGate, GShardGate, SwitchGate  # noqa: F401
 from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: F401
 from .topology import (  # noqa: F401

@@ -26,6 +26,7 @@ class _FleetState:
         self.strategy: Optional[DistributedStrategy] = None
         self.is_collective = True
         self.initialized = False
+        self.role_maker = None
 
 
 _state = _FleetState()
@@ -34,8 +35,12 @@ _state = _FleetState()
 def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
     if strategy is None:
         strategy = DistributedStrategy()
+    if role_maker is None:
+        from .role_maker import PaddleCloudRoleMaker
+        role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
     _state.strategy = strategy
     _state.is_collective = is_collective
+    _state.role_maker = role_maker
 
     # multi-host rendezvous (jax.distributed / coordination service) must run
     # BEFORE the mesh is built so jax.devices() covers the whole pod; the mesh
@@ -89,15 +94,33 @@ def distributed_optimizer(optimizer, strategy=None):
     return HybridParallelOptimizer(optimizer, hcg, _state.strategy)
 
 
-# introspection API parity
+# introspection API parity (role maker first, env fallback)
 def worker_num():
+    if _state.role_maker is not None:
+        return _state.role_maker._worker_num()
     from ..env import get_world_size
     return get_world_size()
 
 
 def worker_index():
+    if _state.role_maker is not None:
+        return _state.role_maker._worker_index()
     from ..env import get_rank
     return get_rank()
+
+
+def is_worker():
+    return _state.role_maker is None or _state.role_maker._is_worker()
+
+
+def is_server():
+    return _state.role_maker is not None and _state.role_maker._is_server()
+
+
+def worker_endpoints(to_string=False):
+    eps = _state.role_maker._get_trainer_endpoints() \
+        if _state.role_maker is not None else []
+    return ",".join(eps) if to_string else eps
 
 
 def is_first_worker():

@@ -183,3 +183,92 @@ def triu_indices(row, col=None, offset=0):
 
 def complex(real, imag):
     return apply(jax.lax.complex, real, imag, op_name="complex")
+
+
+# ---------- static-world creation helpers & TensorArray ----------
+# (python/paddle/tensor/creation.py fill_constant/create_*; tensor/array.py)
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    return full(shape, value, dtype=dtype)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    return Tensor(jnp.zeros((0,), dtypes.convert_dtype(dtype)))
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..core.tensor import Parameter
+    p = Parameter(jnp.full(_norm_shape(shape), value,
+                           dtypes.convert_dtype(dtype)))
+    p.persistable = persistable
+    return p
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.tensor import Parameter
+    from ..nn.initializer import Constant, XavierNormal
+    p = Parameter(jnp.zeros(_norm_shape(shape), dtypes.convert_dtype(dtype)))
+    init = default_initializer or (Constant(0.0) if is_bias else XavierNormal())
+    init(p)
+    return p
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    from ..ops import random as _random
+    out = _random.randn(_norm_shape(shape))
+    dt = dtypes.convert_dtype(dtype) if dtype else out.dtype
+    return (out * std + mean).astype(dt)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """TensorArray analog (python/paddle/tensor/array.py): a plain python
+    list of Tensors — works identically in eager and traced code (the trace
+    unrolls list ops, replacing the reference's LoDTensorArray variable)."""
+    arr = list(initialized_list) if initialized_list is not None else []
+    return [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+            for a in arr]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int64))
+
+
+def _idx_of(i):
+    if isinstance(i, Tensor):
+        return int(np.asarray(i._value))
+    return int(i)
+
+
+def array_read(array, i):
+    return array[_idx_of(i)]
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    i = _idx_of(i)
+    if i < len(array):
+        array[i] = x
+    else:
+        while len(array) < i:
+            array.append(Tensor(jnp.zeros_like(x._value)))
+        array.append(x)
+    return array
+
+
+def tensor_array_to_tensor(array, axis=0, use_stack=False, name=None):
+    from . import manip as _manip
+    if use_stack:
+        out = _manip.stack(array, axis=axis)
+    else:
+        out = _manip.concat(array, axis=axis)
+    sizes = np.asarray([a.shape[axis if not use_stack else 0] if not use_stack
+                        else 1 for a in array], np.int64)
+    return out, Tensor(jnp.asarray(sizes))
+
+
+__all__ += ["fill_constant", "create_tensor", "create_global_var",
+            "create_parameter", "gaussian", "create_array", "array_length",
+            "array_read", "array_write", "tensor_array_to_tensor"]

@@ -711,3 +711,36 @@ def tolist(x):
     import numpy as _np
     return _np.asarray(x._value if isinstance(x, Tensor) else x).tolist()
 _export(tolist)
+
+
+@_export
+def unstack(x, axis=0, num=None):
+    def f(v):
+        return tuple(jnp.moveaxis(v, axis, 0))
+    return apply(f, x, op_name="unstack")
+
+
+@_export
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """Write y into the (dim1, dim2) diagonal band of x (paddle
+    fill_diagonal_tensor)."""
+    def f(v, val):
+        d1, d2 = dim1 % v.ndim, dim2 % v.ndim
+        n = min(v.shape[d1], v.shape[d2] - offset) if offset >= 0 else \
+            min(v.shape[d1] + offset, v.shape[d2])
+        i = jnp.arange(n)
+        rows = i - min(offset, 0)
+        cols = i + max(offset, 0)
+        import builtins
+        idx = [builtins.slice(None)] * v.ndim
+        idx[d1], idx[d2] = rows, cols
+        return v.at[tuple(idx)].set(jnp.moveaxis(
+            val.astype(v.dtype), -1, d1 if d1 < d2 else d1 - 1)
+            if val.ndim == v.ndim - 1 else val.astype(v.dtype))
+    return apply(f, x, y, op_name="fill_diagonal_tensor")
+
+
+@_export
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

@@ -1,0 +1,125 @@
+"""Op-benchmark regression harness (reference: tools/ci_op_benchmark.sh —
+the per-op timing CI that gates PRs on relative regressions vs develop).
+
+Usage:
+    python tools/op_benchmark.py --save baseline.json          # record
+    python tools/op_benchmark.py --compare baseline.json       # gate (exit 1
+        on any op slower than --threshold, default 1.15x)
+    python tools/op_benchmark.py                               # print table
+
+Each case times the steady-state jitted op on the attached device (the
+device-kind is recorded so baselines aren't compared across chips).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _cases():
+    import numpy as np
+
+    import paddle_tpu as P
+
+    rng = np.random.RandomState(0)
+
+    def t(shape, dtype="float32"):
+        if dtype.startswith("int"):
+            return P.to_tensor(rng.randint(0, 1000, shape).astype(dtype))
+        return P.to_tensor(rng.randn(*shape).astype(dtype))
+
+    a1k = t((1024, 1024))
+    b1k = t((1024, 1024))
+    img = t((8, 64, 56, 56))
+    ker = t((64, 64, 3, 3))
+    seq = t((8, 512, 512))
+    ids = t((8, 512), "int32")
+    emb = t((32000, 512))
+    q = t((2, 512, 8, 64))
+    k = t((2, 512, 8, 64))
+    v = t((2, 512, 8, 64))
+
+    return [
+        ("matmul_1kx1k", lambda: P.matmul(a1k, b1k)),
+        ("add_1kx1k", lambda: a1k + b1k),
+        ("softmax_8x512x512", lambda: P.nn.functional.softmax(seq, axis=-1)),
+        ("layer_norm_8x512x512",
+         lambda: P.nn.functional.layer_norm(seq, [512])),
+        ("gelu_1kx1k", lambda: P.nn.functional.gelu(a1k)),
+        ("conv2d_8x64x56x56",
+         lambda: P.nn.functional.conv2d(img, ker, padding=1)),
+        ("embedding_8x512",
+         lambda: P.nn.functional.embedding(ids, emb)),
+        ("reduce_sum_8x512x512", lambda: seq.sum()),
+        ("transpose_8x512x512", lambda: P.transpose(seq, [0, 2, 1])),
+        ("sdpa_2x512x8x64",
+         lambda: P.nn.functional.scaled_dot_product_attention(
+             q, k, v, is_causal=True)),
+    ]
+
+
+def run(n_iters=20, warmup=3):
+    import jax
+
+    results = {"device": jax.devices()[0].device_kind, "ops": {}}
+    for name, fn in _cases():
+        for _ in range(warmup):
+            out = fn()
+        jax.block_until_ready(out._value)
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            out = fn()
+        jax.block_until_ready(out._value)
+        dt = (time.perf_counter() - t0) / n_iters
+        results["ops"][name] = dt * 1e6  # us
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--save", help="write results JSON to this path")
+    ap.add_argument("--compare", help="baseline JSON to gate against")
+    ap.add_argument("--threshold", type=float, default=1.15,
+                    help="max allowed slowdown ratio vs baseline")
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    res = run(n_iters=args.iters)
+    for name, us in res["ops"].items():
+        print(f"{name:28s} {us:10.1f} us")
+
+    if args.save:
+        with open(args.save, "w") as f:
+            json.dump(res, f, indent=1)
+        print(f"saved -> {args.save}")
+    if args.compare:
+        with open(args.compare) as f:
+            base = json.load(f)
+        if base.get("device") != res["device"]:
+            print(f"WARNING: baseline device {base.get('device')!r} != "
+                  f"{res['device']!r}; ratios are not meaningful",
+                  file=sys.stderr)
+        bad = []
+        for name, us in res["ops"].items():
+            b = base.get("ops", {}).get(name)
+            if b is None:
+                continue
+            ratio = us / b
+            mark = " REGRESSION" if ratio > args.threshold else ""
+            print(f"{name:28s} {ratio:6.2f}x vs baseline{mark}")
+            if ratio > args.threshold:
+                bad.append(name)
+        if bad:
+            print(f"FAILED: {len(bad)} op(s) regressed: {bad}", file=sys.stderr)
+            sys.exit(1)
+        print("PASS: no op regressed beyond "
+              f"{args.threshold:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
