@@ -437,7 +437,11 @@ def _attn_with_step(x, qkv_w, lin_w, ln_w, ln_b, qkv_b, lin_b, cache_kv,
     has_mask = attn_mask is not None
     has_rope = rotary_embs is not None
     has_seq = seq_lens is not None
-    kd = gen.next_key() if (training and dropout_rate > 0.0) else None
+    # distinct keys for the attention-probs and output dropouts — sharing one
+    # key correlates the two masks (ADVICE r2)
+    need_keys = training and dropout_rate > 0.0
+    ka = gen.next_key() if need_keys else None
+    kd = gen.next_key() if need_keys else None
 
     def f(v, *rest):
         it = iter(rest)
@@ -448,7 +452,7 @@ def _attn_with_step(x, qkv_w, lin_w, ln_w, ln_b, qkv_b, lin_b, cache_kv,
         d = dict(zip(keys, it))
         out, nc = _mha_core(v, d, d["qkv_w"].shape[1], pre_layer_norm, epsilon,
                             epsilon, m, dropout_rate, dropout_rate, True,
-                            training, mode, kd, kd, cache_kv=ck, time_step=ts,
+                            training, mode, ka, kd, cache_kv=ck, time_step=ts,
                             rotary_sincos=rt, seq_lens=sl)
         return (out, nc) if has_cache else out
 

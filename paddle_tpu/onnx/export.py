@@ -41,12 +41,87 @@ def _elem_type(dtype):
     return _DTYPE[name]
 
 
+# Symbolic input dims are traced at distinct large-prime "sentinel" sizes so
+# that any traced shape value derived from them is recognizable by
+# factorization (ADVICE r2: constants baked from a representative size 2 made
+# every internal reshape/expand silently wrong at other sizes).  Static dims
+# big enough to collide with the affine-resolution window are vanishingly
+# rare (primes start at 7919 and the window is +/-64).
+_SYM_PRIMES = [7919, 7927, 7933, 7937, 7949, 7951, 7963, 7993]
+_AFFINE_WINDOW = 64
+
+
 class _Ctx:
     def __init__(self, graph):
         self.graph = graph
         self.names: Dict[object, str] = {}
         self.counter = 0
         self.const_cache: Dict[bytes, str] = {}
+        # prime -> (graph_input_name, axis) where the symbol appears
+        self.sym_dims: Dict[int, tuple] = {}
+        # prime -> symbolic dim name (for output dim_params)
+        self.sym_names: Dict[int, str] = {}
+        self._shape_cache: Dict[str, str] = {}
+
+    def runtime_dim(self, prime):
+        """int64 [1]-tensor holding the runtime size of a symbolic dim."""
+        inp, ax = self.sym_dims[prime]
+        key = f"{inp}:{ax}"
+        if key not in self._shape_cache:
+            shp = self.node("Shape", [inp])
+            idx = self.constant(np.asarray([ax], np.int64))
+            self._shape_cache[key] = self.node("Gather", [shp, idx], axis=0)
+        return self._shape_cache[key]
+
+    def resolve_dyn(self, v):
+        """None if v is a static dim value; else a list of primes + static
+        multiplier/offset such that v = prod(primes) * mult + off (off only
+        for single-prime affine forms like S-1)."""
+        v = int(v)
+        if not self.sym_dims or v < min(self.sym_dims) // 2:
+            return None
+        rem, primes = v, []
+        for p in self.sym_dims:
+            while rem % p == 0 and rem >= p:
+                rem //= p
+                primes.append(p)
+        if primes and rem <= _AFFINE_WINDOW:
+            return (primes, rem, 0)
+        # affine in one symbol: v = m*p + off, |off| small (e.g. S-1, 2S+1)
+        for p in self.sym_dims:
+            m = int(round(v / p))
+            off = v - m * p
+            if m >= 1 and abs(off) <= _AFFINE_WINDOW:
+                return ([p] * m, 1, off)
+        return None
+
+    def dyn_scalar(self, resolved):
+        """Emit the runtime int64 [1]-tensor for a resolve_dyn() result."""
+        primes, mult, off = resolved
+        out = self.runtime_dim(primes[0])
+        for p in primes[1:]:
+            out = self.node("Mul", [out, self.runtime_dim(p)])
+        if mult != 1:
+            out = self.node(
+                "Mul", [out, self.constant(np.asarray([mult], np.int64))])
+        if off:
+            out = self.node(
+                "Add", [out, self.constant(np.asarray([off], np.int64))])
+        return out
+
+    def shape_tensor(self, shape, prim_name):
+        """A 1-D int64 tensor for a target shape: a plain constant when fully
+        static, else runtime-derived per-entry (Shape/Gather/Mul/Concat)."""
+        entries = [self.resolve_dyn(d) for d in shape]
+        if not any(e is not None for e in entries):
+            return self.constant(np.asarray(list(shape), np.int64))
+        parts = []
+        for d, e in zip(shape, entries):
+            if e is None:
+                parts.append(self.constant(np.asarray([int(d)], np.int64)))
+            else:
+                parts.append(self.dyn_scalar(e))
+        return self.node("Concat", parts, axis=0)
 
     def fresh(self, hint="t"):
         self.counter += 1
@@ -146,10 +221,24 @@ def _conv_prim(ctx, eqn, ins):
              "acosh", "atanh"):
         return [ctx.node(p.capitalize(), ins)]
     if p == "atan2":
-        return [ctx.node("Atan", [ctx.node("Div", ins)])]  # principal branch
+        # quadrant-corrected: atan(y/x) + pi*(x<0)*(y>=0 ? 1 : -1)
+        # (ADVICE r2: the principal branch alone is off by +/-pi on x<0)
+        dt = np.dtype(out_aval.dtype)
+        y, x = ins
+        at = ctx.node("Atan", [ctx.node("Div", [y, x])])
+        zero = ctx.constant(np.asarray(0.0, dt))
+        pi_pos = ctx.constant(np.asarray(np.pi, dt))
+        pi_neg = ctx.constant(np.asarray(-np.pi, dt))
+        x_neg = ctx.node("Less", [x, zero])
+        y_nonneg = ctx.node("GreaterOrEqual", [y, zero])
+        corr = ctx.node("Where", [y_nonneg, pi_pos, pi_neg])
+        corr = ctx.node("Where", [x_neg, corr, zero])
+        return [ctx.node("Add", [at, corr])]
     if p == "cbrt":
+        # sign(x)*|x|^(1/3): Pow(x, 1/3) is NaN for negative x (ADVICE r2)
         third = ctx.constant(np.asarray(1.0 / 3.0, np.dtype(out_aval.dtype)))
-        return [ctx.node("Pow", [ins[0], third])]
+        mag = ctx.node("Pow", [ctx.node("Abs", ins), third])
+        return [ctx.node("Mul", [ctx.node("Sign", ins), mag])]
     if p == "integer_pow":
         y = ctx.constant(np.asarray(eqn.params["y"],
                                     np.dtype(out_aval.dtype)))
@@ -161,7 +250,7 @@ def _conv_prim(ctx, eqn, ins):
     if p == "convert_element_type":
         return [ctx.node("Cast", ins, to=_elem_type(eqn.params["new_dtype"]))]
     if p == "reshape":
-        shp = ctx.constant(np.asarray(eqn.params["new_sizes"], np.int64))
+        shp = ctx.shape_tensor(eqn.params["new_sizes"], p)
         return [ctx.node("Reshape", [ins[0], shp])]
     if p == "transpose":
         return [ctx.node("Transpose", ins, perm=list(eqn.params["permutation"]))]
@@ -173,10 +262,8 @@ def _conv_prim(ctx, eqn, ins):
         mid = [1] * len(shape)
         for i, d in enumerate(bdims):
             mid[d] = in_shape[i]
-        r = ctx.node("Reshape",
-                     [ins[0], ctx.constant(np.asarray(mid, np.int64))])
-        return [ctx.node("Expand",
-                         [r, ctx.constant(np.asarray(shape, np.int64))])]
+        r = ctx.node("Reshape", [ins[0], ctx.shape_tensor(mid, p)])
+        return [ctx.node("Expand", [r, ctx.shape_tensor(shape, p)])]
     if p in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
              "reduce_and", "reduce_or"):
         op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
@@ -194,9 +281,13 @@ def _conv_prim(ctx, eqn, ins):
         ends = list(eqn.params["limit_indices"])
         strides = eqn.params["strides"] or [1] * len(starts)
         axes = list(range(len(starts)))
+        # dynamic-dim-derived bounds (e.g. [:, :S] or [:, S-1:]) become
+        # runtime scalars via the same factorization as shape_tensor
+        starts_t = ctx.shape_tensor(starts, p) if starts else \
+            ctx.constant(np.asarray([], np.int64))
+        ends_t = ctx.shape_tensor(ends, p)
         return [ctx.node("Slice", [
-            ins[0], ctx.constant(np.asarray(starts, np.int64)),
-            ctx.constant(np.asarray(ends, np.int64)),
+            ins[0], starts_t, ends_t,
             ctx.constant(np.asarray(axes, np.int64)),
             ctx.constant(np.asarray(list(strides), np.int64))])]
     if p == "rev":
@@ -224,11 +315,23 @@ def _conv_prim(ctx, eqn, ins):
         shape = eqn.params["shape"]
         dim = eqn.params["dimension"]
         n = shape[dim]
-        arr = np.arange(n, dtype=dt)
         mid = [1] * len(shape)
         mid[dim] = n
-        arr = np.broadcast_to(arr.reshape(mid), shape)
-        return [ctx.constant(np.ascontiguousarray(arr))]
+        res = ctx.resolve_dyn(n)
+        if res is None:
+            base = ctx.constant(np.arange(n, dtype=dt).reshape(mid))
+        else:
+            # iota along a dynamic dim (e.g. causal masks over a dynamic
+            # sequence): emit a runtime Range instead of baking a constant
+            lim = ctx.node("Reshape", [ctx.dyn_scalar(res),
+                                       ctx.constant(np.asarray([], np.int64))])
+            lim = ctx.node("Cast", [lim], to=_elem_type(dt))
+            rng = ctx.node("Range", [ctx.constant(np.asarray(0, dt)), lim,
+                                     ctx.constant(np.asarray(1, dt))])
+            base = ctx.node("Reshape", [rng, ctx.shape_tensor(mid, p)])
+        if list(shape) == mid:
+            return [base]
+        return [ctx.node("Expand", [base, ctx.shape_tensor(shape, p)])]
     if p == "pad":
         lo_hi = eqn.params["padding_config"]
         if any(interior != 0 for _, _, interior in lo_hi):
@@ -319,7 +422,7 @@ def _gather(ctx, eqn, ins):
     # indices last dim is 1 -> squeeze it
     idx_aval = eqn.invars[1].aval
     idx = ins[1]
-    shp = ctx.constant(np.asarray(list(idx_aval.shape[:-1]), np.int64))
+    shp = ctx.shape_tensor(list(idx_aval.shape[:-1]), "gather")
     idx = ctx.node("Reshape", [idx, shp])
     idx64 = ctx.node("Cast", [idx], to=pb.TensorProto.INT64)
     return ctx.node("Gather", [ins[0], idx64], axis=int(axis))
@@ -398,6 +501,7 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     # but the jaxpr itself is traced at a representative size)
     in_avals = []
     dim_params: List[List] = []
+    sym_primes: Dict[str, int] = {}  # symbolic dim name -> sentinel prime
     for s in _avals_from_spec(input_spec):
         dims, params = [], []
         for d in s.shape:
@@ -405,8 +509,15 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
                 dims.append(d)
                 params.append(None)
             else:
-                dims.append(2)  # representative size for symbolic dims
-                params.append(str(d))
+                name = str(d)
+                if name not in sym_primes:
+                    if len(sym_primes) >= len(_SYM_PRIMES):
+                        raise NotImplementedError(
+                            f"at most {len(_SYM_PRIMES)} distinct dynamic "
+                            "dims supported")
+                    sym_primes[name] = _SYM_PRIMES[len(sym_primes)]
+                dims.append(sym_primes[name])  # sentinel size for tracing
+                params.append(name)
         in_avals.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
         dim_params.append(params)
 
@@ -467,12 +578,15 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
         vi.name = nm
         tt = vi.type.tensor_type
         tt.elem_type = _elem_type(aval.dtype)
-        for d, dp in zip(aval.shape, dparams):
+        for ax, (d, dp) in enumerate(zip(aval.shape, dparams)):
             dim = tt.shape.dim.add()
             if dp is None:
                 dim.dim_value = d
             else:
                 dim.dim_param = dp
+                prime = sym_primes[dp]
+                ctx.sym_dims.setdefault(prime, (nm, ax))
+                ctx.sym_names[prime] = dp
     outs = _convert_jaxpr(ctx, jaxpr, param_onnx + in_names)
     for i, (o, var) in enumerate(zip(outs, jaxpr.outvars)):
         vo = g.output.add()
@@ -480,7 +594,18 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
         tt = vo.type.tensor_type
         tt.elem_type = _elem_type(var.aval.dtype)
         for d in var.aval.shape:
-            tt.shape.dim.add().dim_value = int(d)
+            dim = tt.shape.dim.add()
+            res = ctx.resolve_dyn(d)
+            if res is None:
+                dim.dim_value = int(d)
+            else:
+                primes, mult, off = res
+                expr = "*".join(ctx.sym_names[p] for p in primes)
+                if mult != 1:
+                    expr = f"{mult}*{expr}"
+                if off:
+                    expr = f"{expr}{off:+d}"
+                dim.dim_param = expr
 
     out_path = path if path.endswith(".onnx") else path + ".onnx"
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)

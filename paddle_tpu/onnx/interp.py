@@ -171,6 +171,29 @@ def run_model(model_bytes_or_path, inputs: dict):
             r = np.sin(x[0])
         elif op == "Cos":
             r = np.cos(x[0])
+        elif op == "Tan":
+            r = np.tan(x[0])
+        elif op == "Sinh":
+            r = np.sinh(x[0])
+        elif op == "Cosh":
+            r = np.cosh(x[0])
+        elif op == "Asin":
+            r = np.arcsin(x[0])
+        elif op == "Acos":
+            r = np.arccos(x[0])
+        elif op == "Atan":
+            r = np.arctan(x[0])
+        elif op == "Asinh":
+            r = np.arcsinh(x[0])
+        elif op == "Acosh":
+            r = np.arccosh(x[0])
+        elif op == "Atanh":
+            r = np.arctanh(x[0])
+        elif op == "Shape":
+            r = np.asarray(x[0].shape, np.int64)
+        elif op == "Range":
+            r = np.arange(x[0].item(), x[1].item(), x[2].item(),
+                          dtype=x[0].dtype)
         elif op == "IsInf":
             r = np.isinf(x[0])
         elif op == "IsNaN":

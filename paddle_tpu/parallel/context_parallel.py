@@ -150,18 +150,39 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
 
 def _local_dense_attn(q, k, v, causal, scale):
-    """[B, H, S, D] dense attention (used by Ulysses after the re-shard)."""
-    d = q.shape[-1]
+    """[B, H, S, D] dense attention (used by Ulysses after the re-shard).
+
+    Real GQA: when q has g x as many heads as k/v, q is viewed as
+    [B, H_kv, g, S, D] and attention is computed per kv-head group — no
+    repeat materialized.  Correct after Ulysses' head all-to-all because the
+    contiguous block of g q-heads that shares kv head j lands on the same
+    device as kv head j (head axes are split contiguously and
+    H_q/n = g * H_kv/n)."""
+    b, hq, sq, d = q.shape
+    hk = k.shape[1]
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * sc
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    if hq != hk:
+        if hq % hk != 0:
+            raise ValueError(
+                f"GQA head counts must divide: q heads {hq}, kv heads {hk}")
+        g = hq // hk
+        qg = q32.reshape(b, hk, g, sq, d)
+        logits = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k32) * sc
+    else:
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * sc
     if causal:
         s_q, s_k = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
         logits = jnp.where(mask, logits, _NEG)
     p = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    if hq != hk:
+        o = jnp.einsum("bhgqk,bhkd->bhgqd", p, v32).reshape(b, hq, sq, d)
+    else:
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v32)
+    return o.astype(q.dtype)
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,

@@ -68,6 +68,71 @@ def test_onnx_export_llama_transformer(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
 
 
+def test_onnx_atan2_cbrt_quadrants(tmp_path):
+    """ADVICE r2: atan2 must be quadrant-correct (not the principal branch)
+    and cbrt must handle negative inputs."""
+    class M(nn.Layer):
+        def forward(self, y, x):
+            from paddle_tpu.ops.dispatch import apply
+            import jax.numpy as jnp
+            return P.atan2(y, x) + apply(jnp.cbrt, x)
+
+    m = M()
+    path = P.onnx.export(m, str(tmp_path / "quad"),
+                         input_spec=[InputSpec([5], "float32", name="y"),
+                                     InputSpec([5], "float32", name="x")])
+    y = np.asarray([1.0, 1.0, -1.0, -1.0, 0.0], np.float32)
+    x = np.asarray([1.0, -1.0, 1.0, -1.0, -2.0], np.float32)
+    got = P.onnx.run_model(path, {"y": y, "x": x})[0]
+    ref = np.arctan2(y, x) + np.cbrt(x)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_dynamic_batch_with_internal_reshape(tmp_path):
+    """ADVICE r2: dynamic dims flowing into reshape/broadcast targets were
+    baked from the representative trace size; now they are runtime-derived,
+    so ONE export serves multiple batch sizes."""
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(6, 8)
+
+        def forward(self, x):
+            b = x.shape[0]
+            h = self.lin(x.reshape([b * 3, 6]))       # merged dynamic dim
+            return h.reshape([b, 3, 8]).sum(axis=1)   # split back
+
+    m = M()
+    path = P.onnx.export(m, str(tmp_path / "dyn"),
+                         input_spec=[InputSpec([None, 3, 6], "float32",
+                                               name="x")])
+    for bsz in (2, 5):
+        x = rng.randn(bsz, 3, 6).astype("f")
+        ref = m(P.to_tensor(x)).numpy()
+        got = P.onnx.run_model(path, {"x": x})[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"batch={bsz}")
+
+
+def test_onnx_dynamic_seq_transformer(tmp_path):
+    """Dynamic sequence length through a full transformer (causal-mask iotas
+    become runtime Ranges, attention reshapes become runtime shapes)."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    P.seed(3)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=4, inter=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    path = P.onnx.export(
+        m, str(tmp_path / "llama_dyn"),
+        input_spec=[InputSpec([1, None], "int32", name="ids")])
+    for seq in (4, 9):
+        ids = rng.randint(0, 64, (1, seq)).astype(np.int32)
+        ref = m(P.to_tensor(ids)).numpy()
+        got = P.onnx.run_model(path, {"ids": ids})[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4,
+                                   err_msg=f"seq={seq}")
+
+
 def test_onnx_unsupported_primitive_raises(tmp_path):
     class Weird(nn.Layer):
         def forward(self, x):

@@ -46,6 +46,32 @@ def test_single_worker_rpc_roundtrip():
         rpc.shutdown()
 
 
+def test_unauthenticated_request_rejected():
+    """ADVICE r2: the agent must never unpickle an unauthenticated payload
+    (pickle deserialization is code execution)."""
+    import struct
+
+    hits = []
+    rpc.init_rpc("bob", rank=0, world_size=1)
+    try:
+        info = rpc.get_worker_info("bob")
+        payload = pickle.dumps((hits.append, ("pwned",), {}))
+        s = socket.create_connection((info.ip, info.port), timeout=5)
+        # correct framing, garbage MAC: must be dropped before unpickling
+        s.sendall(struct.pack("<Q", len(payload)) + b"\x00" * 32 + payload)
+        s.settimeout(2)
+        with pytest.raises((socket.timeout, ConnectionError)):
+            data = s.recv(1)
+            if not data:
+                raise ConnectionError("closed without executing")
+        s.close()
+        assert hits == []
+        # the authenticated path still works afterwards
+        assert rpc.rpc_sync("bob", _add, args=(1, 2)) == 3
+    finally:
+        rpc.shutdown()
+
+
 def _worker(rank, world, port, q):
     from paddle_tpu.distributed import rpc as r
     name = f"w{rank}"
