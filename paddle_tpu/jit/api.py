@@ -167,12 +167,18 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     """Decorator/wrapper. Accepts a Layer (wraps .forward) or a function."""
 
     def decorate(obj):
+        from .dy2static import convert_control_flow
         if isinstance(obj, Layer):
-            sf = StaticFunction(type(obj).forward, layer=obj, input_spec=input_spec)
+            fwd = type(obj).forward
+            if not getattr(fwd, "_not_to_static", False):
+                fwd = convert_control_flow(fwd)
+            sf = StaticFunction(fwd, layer=obj, input_spec=input_spec)
             obj.forward = lambda *a, **k: sf._call_impl(None, *a, **k)
             obj._static_function = sf
             return obj
-        sf = StaticFunction(obj, input_spec=input_spec)
+        fn = obj if getattr(obj, "_not_to_static", False) \
+            else convert_control_flow(obj)
+        sf = StaticFunction(fn, input_spec=input_spec)
 
         def wrapper(*a, **k):
             # support being stored on a class and called as a method

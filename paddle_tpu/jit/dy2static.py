@@ -1,0 +1,459 @@
+"""Data-dependent control flow for to_static.
+
+The reference converts Python ``if``/``while`` on tensor values into graph
+ops via 15 AST transformers
+(python/paddle/jit/dy2static/ast_transformer.py:31-42, ifelse_transformer.py,
+loop_transformer.py). The trace-based to_static here would otherwise bake the
+branch taken at trace time into the compiled program.
+
+This module is the TPU-native analog: ONE light AST pass that rewrites
+
+    if <test>:  ...          (a, b) = ___pt_if(<test>, true_fn, false_fn,
+    else:       ...    ->                      ('a', 'b'), locals())
+
+    while <test>: ...  ->    (a, b) = ___pt_while(cond_fn, body_fn,
+                                                  ('a', 'b'), locals())
+
+where the runtime helpers dispatch on the predicate: a concrete (Python/
+eager) predicate executes the chosen branch as plain Python — semantics,
+side effects and all — while a traced tensor predicate lowers to
+``lax.cond`` / ``lax.while_loop``, so the compiled function changes behavior
+with runtime values WITHOUT retracing. ``and``/``or``/``not`` inside
+converted tests become tensor-aware helpers (reference:
+logical_transformer.py).
+
+Conversion is conservative: an ``if``/``while`` containing ``return``,
+``break``, ``continue``, ``global``/``nonlocal``, attribute/subscript
+stores, or assigning no names at all is left as plain Python (a traced
+predicate there surfaces jax's concretization error).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["convert_control_flow"]
+
+
+class _Undefined:
+    """Placeholder for names not yet bound when a converted branch runs."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        raise NameError(
+            "variable used before assignment in converted control flow")
+
+
+_UNDEF = _Undefined()
+
+
+def _is_traced(x):
+    from ..core.tensor import Tensor
+    v = x._value if isinstance(x, Tensor) else x
+    return isinstance(v, jax.core.Tracer)
+
+
+def _pred_value(p):
+    from ..core.tensor import Tensor
+    v = p._value if isinstance(p, Tensor) else jnp.asarray(p)
+    return v.reshape(())
+
+
+def _unwrap_tree(tree):
+    from ..core.tensor import Tensor
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_like(vals, template):
+    """Re-wrap jax values as Tensors where the template had Tensors."""
+    from ..core.tensor import Tensor
+    out = []
+    for v, t in zip(vals, template):
+        out.append(Tensor(v) if isinstance(t, Tensor) or isinstance(v, jax.Array)
+                   or isinstance(v, jax.core.Tracer) else v)
+    return tuple(out)
+
+
+def _fetch(names, lcls):
+    return tuple(lcls.get(n, _UNDEF) for n in names)
+
+
+def _check_defined(names, ops, what):
+    bad = [n for n, o in zip(names, ops) if o is _UNDEF]
+    if bad:
+        raise ValueError(
+            f"to_static control-flow conversion: variable(s) {bad} must be "
+            f"defined before a tensor-dependent {what} that assigns them")
+
+
+def ___pt_if(pred, true_fn, false_fn, names, needs_input, lcls):
+    ops = _fetch(names, lcls)
+    if not _is_traced(pred):
+        out = (true_fn if bool(pred) else false_fn)(*ops)
+        return out
+    # names assigned in BOTH branches don't need a prior binding (their
+    # operand slot is a dummy); names assigned in only one branch pass
+    # through the inbound value on the other side, so they must exist
+    needed = [n for n, need in zip(names, needs_input) if need]
+    needed_ops = [o for o, need in zip(ops, needs_input) if need]
+    _check_defined(needed, needed_ops, "if")
+    ops = tuple(jnp.zeros(()) if o is _UNDEF else o for o in ops)
+    from ..core.tensor import Tensor
+    ops_vals = tuple(_unwrap_tree(o) for o in ops)
+    is_t = tuple(isinstance(o, Tensor) for o in ops)
+
+    def rewrap(vals):
+        return tuple(Tensor(v) if f else v for v, f in zip(vals, is_t))
+
+    def run(fn):
+        def g(vals):
+            out = fn(*rewrap(vals))
+            return tuple(jnp.asarray(_unwrap_tree(o)) for o in out)
+        return g
+
+    try:
+        out_vals = jax.lax.cond(_pred_value(pred), run(true_fn),
+                                run(false_fn), ops_vals)
+    except TypeError as e:
+        raise TypeError(
+            f"to_static: the branches of a tensor-dependent `if` must "
+            f"produce matching shapes/dtypes for {names}: {e}") from None
+    return _wrap_like(out_vals, ops)
+
+
+def ___pt_while(cond_fn, body_fn, names, lcls):
+    ops = _fetch(names, lcls)
+    pred = cond_fn(*ops)
+    if not _is_traced(pred):
+        vals = ops
+        while bool(pred):
+            vals = body_fn(*vals)
+            pred = cond_fn(*vals)
+        return vals
+    _check_defined(names, ops, "while")
+    from ..core.tensor import Tensor
+    ops_vals = tuple(jnp.asarray(_unwrap_tree(o)) for o in ops)
+    is_t = tuple(isinstance(o, Tensor) for o in ops)
+
+    def rewrap(vals):
+        return tuple(Tensor(v) if f else v for v, f in zip(vals, is_t))
+
+    def c(vals):
+        return _pred_value(cond_fn(*rewrap(vals)))
+
+    def b(vals):
+        out = body_fn(*rewrap(vals))
+        return tuple(jnp.asarray(_unwrap_tree(o)) for o in out)
+
+    out_vals = jax.lax.while_loop(c, b, ops_vals)
+    return _wrap_like(out_vals, ops)
+
+
+def ___pt_and(*thunks):
+    val = thunks[0]()
+    for t in thunks[1:]:
+        if _is_traced(val):
+            from ..ops.dispatch import apply
+            val = apply(jnp.logical_and, val, t())
+        else:
+            if not val:
+                return val
+            val = t()
+    return val
+
+
+def ___pt_or(*thunks):
+    val = thunks[0]()
+    for t in thunks[1:]:
+        if _is_traced(val):
+            from ..ops.dispatch import apply
+            val = apply(jnp.logical_or, val, t())
+        else:
+            if val:
+                return val
+            val = t()
+    return val
+
+
+def ___pt_not(x):
+    if _is_traced(x):
+        from ..ops.dispatch import apply
+        return apply(jnp.logical_not, x)
+    return not x
+
+
+_HELPERS = {"___pt_if": ___pt_if, "___pt_while": ___pt_while,
+            "___pt_and": ___pt_and, "___pt_or": ___pt_or,
+            "___pt_not": ___pt_not}
+
+_SKIP_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def _stored_names(nodes):
+    """Names assigned in a statement list; None if unconvertible stores or
+    control-flow escapes are present (conservative)."""
+    names, ok = set(), [True]
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                names.add(n.id)
+
+        def visit_Attribute(self, n):
+            if isinstance(n.ctx, ast.Store):
+                ok[0] = False
+            self.generic_visit(n)
+
+        def visit_Subscript(self, n):
+            if isinstance(n.ctx, ast.Store):
+                ok[0] = False
+            self.generic_visit(n)
+
+        def visit_Return(self, n):
+            ok[0] = False
+
+        def visit_Break(self, n):
+            ok[0] = False
+
+        def visit_Continue(self, n):
+            ok[0] = False
+
+        def visit_Global(self, n):
+            ok[0] = False
+
+        def visit_Nonlocal(self, n):
+            ok[0] = False
+
+        def visit_Yield(self, n):
+            ok[0] = False
+
+        def visit_YieldFrom(self, n):
+            ok[0] = False
+
+        def generic_visit(self, n):
+            if isinstance(n, _SKIP_SCOPES):
+                return  # nested scopes keep their own control flow
+            super().generic_visit(n)
+
+    for nd in nodes:
+        V().visit(nd)
+    return sorted(names) if ok[0] else None
+
+
+class _TestTransformer(ast.NodeTransformer):
+    """and/or/not inside a converted test -> tensor-aware helpers with
+    Python short-circuit preserved via thunks."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = "___pt_and" if isinstance(node.op, ast.And) else "___pt_or"
+        thunks = [ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=v) for v in node.values]
+        return ast.Call(func=ast.Name(id=fn, ctx=ast.Load()),
+                        args=thunks, keywords=[])
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=ast.Name(id="___pt_not", ctx=ast.Load()),
+                            args=[node.operand], keywords=[])
+        return node
+
+    def generic_visit(self, node):
+        if isinstance(node, _SKIP_SCOPES):
+            return node
+        return super().generic_visit(node)
+
+
+def _fn_args(names):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+        kwonlyargs=[], kw_defaults=[], defaults=[])
+
+
+def _names_tuple(names, ctx):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                     ctx=ctx())
+
+
+def _const_names(names):
+    return ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                     ctx=ast.Load())
+
+
+def _locals_call():
+    return ast.Call(func=ast.Name(id="locals", ctx=ast.Load()), args=[],
+                    keywords=[])
+
+
+class _CtrlFlow(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+
+    def _visit_body(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
+    def generic_visit(self, node):
+        if isinstance(node, _SKIP_SCOPES):
+            return node
+        return super().generic_visit(node)
+
+    def visit_If(self, node):
+        body = self._visit_body(node.body)
+        orelse = self._visit_body(node.orelse)
+        names_t = _stored_names(body)
+        names_e = _stored_names(orelse)
+        if names_t is None or names_e is None:
+            node.body, node.orelse = body, orelse
+            return node
+        names = sorted(set(names_t) | set(names_e))
+        if not names:
+            node.body, node.orelse = body, orelse
+            return node
+        both = set(names_t) & set(names_e)
+        needs_input = ast.Tuple(
+            elts=[ast.Constant(value=n not in both) for n in names],
+            ctx=ast.Load())
+        self.n += 1
+        i = self.n
+        test = _TestTransformer().visit(node.test)
+        ret = ast.Return(value=_names_tuple(names, ast.Load))
+        tdef = ast.FunctionDef(name=f"___pt_true_{i}", args=_fn_args(names),
+                               body=body + [ret], decorator_list=[])
+        fdef = ast.FunctionDef(
+            name=f"___pt_false_{i}", args=_fn_args(names),
+            body=(orelse or []) + [ast.Return(value=_names_tuple(
+                names, ast.Load))],
+            decorator_list=[])
+        assign = ast.Assign(
+            targets=[_names_tuple(names, ast.Store)],
+            value=ast.Call(func=ast.Name(id="___pt_if", ctx=ast.Load()),
+                           args=[test,
+                                 ast.Name(id=tdef.name, ctx=ast.Load()),
+                                 ast.Name(id=fdef.name, ctx=ast.Load()),
+                                 _const_names(names), needs_input,
+                                 _locals_call()],
+                           keywords=[]))
+        return [tdef, fdef, assign]
+
+    def visit_While(self, node):
+        body = self._visit_body(node.body)
+        if node.orelse:
+            node.body = body
+            return node
+        names = _stored_names(body)
+        if not names:  # None (unconvertible) or no loop vars
+            node.body = body
+            return node
+        self.n += 1
+        i = self.n
+        test = _TestTransformer().visit(node.test)
+        cdef = ast.FunctionDef(name=f"___pt_cond_{i}", args=_fn_args(names),
+                               body=[ast.Return(value=test)],
+                               decorator_list=[])
+        bdef = ast.FunctionDef(
+            name=f"___pt_body_{i}", args=_fn_args(names),
+            body=body + [ast.Return(value=_names_tuple(names, ast.Load))],
+            decorator_list=[])
+        assign = ast.Assign(
+            targets=[_names_tuple(names, ast.Store)],
+            value=ast.Call(func=ast.Name(id="___pt_while", ctx=ast.Load()),
+                           args=[ast.Name(id=cdef.name, ctx=ast.Load()),
+                                 ast.Name(id=bdef.name, ctx=ast.Load()),
+                                 _const_names(names), _locals_call()],
+                           keywords=[]))
+        return [cdef, bdef, assign]
+
+
+def _has_ctrl_flow(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While)):
+            return True
+    return False
+
+
+@functools.lru_cache(maxsize=256)
+def _convert_cached(fn):
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    if not _has_ctrl_flow(fdef):
+        return fn
+    fdef.decorator_list = []  # do not re-apply decorators on exec
+
+    t = _CtrlFlow()
+    fdef.body = t._visit_body(fdef.body)
+    if t.n == 0:
+        return fn
+
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        # rebuild the closure: wrap the def in a factory taking the free
+        # variables as parameters, then call it with the live cell contents
+        factory = ast.FunctionDef(
+            name="___pt_factory", args=_fn_args(list(freevars)),
+            body=[fdef, ast.Return(value=ast.Name(id=fdef.name,
+                                                  ctx=ast.Load()))],
+            decorator_list=[])
+        mod = ast.Module(body=[factory], type_ignores=[])
+    else:
+        mod = ast.Module(body=[fdef], type_ignores=[])
+    ast.fix_missing_locations(mod)
+
+    glb = dict(fn.__globals__)
+    glb.update(_HELPERS)
+    code = compile(mod, filename=getattr(fn.__code__, "co_filename",
+                                         "<dy2static>"), mode="exec")
+    ns: dict = {}
+    exec(code, glb, ns)  # noqa: S102 — recompiling the user's own source
+    if freevars:
+        cells = [c.cell_contents for c in fn.__closure__]
+        new_fn = ns["___pt_factory"](*cells)
+    else:
+        new_fn = ns[fdef.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    functools.update_wrapper(new_fn, fn)
+    return new_fn
+
+
+def convert_control_flow(fn: Callable) -> Callable:
+    """Rewrite tensor-dependent if/while in `fn` to lax control flow.
+
+    Returns `fn` unchanged when its source is unavailable or conversion is
+    not applicable; never raises."""
+    try:
+        return _convert_cached(fn)
+    except (OSError, TypeError, SyntaxError, ValueError):
+        return fn
+    except Exception as e:  # noqa: BLE001 — conversion must never break jit
+        warnings.warn(f"to_static control-flow conversion failed for "
+                      f"{getattr(fn, '__name__', fn)!r}: {e}")
+        return fn

@@ -99,3 +99,111 @@ def test_static_dropout_varies_across_calls():
     y2 = model(x).numpy()
     # different rng key per call => different masks
     assert not np.allclose(y1, y2)
+
+
+# ---- data-dependent control flow (VERDICT r2 item 4; reference:
+# python/paddle/jit/dy2static/ast_transformer.py) ----
+
+def test_to_static_tensor_if_changes_across_calls():
+    """A branch on a runtime tensor value must change the compiled output
+    WITHOUT retracing."""
+    traces = []
+
+    @P.to_static
+    def f(x):
+        traces.append(1)
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = -x
+        return y + 1.0
+
+    pos = P.to_tensor([1.0, 2.0])
+    neg = P.to_tensor([-1.0, -2.0])
+    np.testing.assert_allclose(f(pos).numpy(), [3.0, 5.0])
+    np.testing.assert_allclose(f(neg).numpy(), [2.0, 3.0])
+    assert len(traces) <= 2  # one signature: fwd trace (+ possible vjp)
+
+
+def test_to_static_tensor_while_loop():
+    @P.to_static
+    def f(x):
+        s = x
+        while s.sum() < 100.0:
+            s = s * 2.0
+        return s
+
+    out = f(P.to_tensor([1.0, 2.0]))  # 3 -> 6 -> 12 -> 24 -> 48 -> 96 -> 192
+    np.testing.assert_allclose(out.numpy(), [64.0, 128.0])
+    out2 = f(P.to_tensor([30.0, 40.0]))  # 70 -> 140: one iteration
+    np.testing.assert_allclose(out2.numpy(), [60.0, 80.0])
+
+
+def test_to_static_bool_ops_in_condition():
+    @P.to_static
+    def f(x, lo, hi):
+        if (x.sum() > lo) and not (x.sum() > hi):
+            r = x + 100.0
+        else:
+            r = x - 100.0
+        return r
+
+    t = P.to_tensor([1.0, 2.0])
+    np.testing.assert_allclose(
+        f(t, P.to_tensor(0.0), P.to_tensor(10.0)).numpy(), [101.0, 102.0])
+    np.testing.assert_allclose(
+        f(t, P.to_tensor(5.0), P.to_tensor(10.0)).numpy(), [-99.0, -98.0])
+
+
+def test_to_static_python_if_still_static():
+    """A Python-bool condition keeps plain-Python semantics (side effects,
+    per-branch tracing via the static-arg cache)."""
+    hits = []
+
+    @P.to_static
+    def f(x, flag):
+        if flag:
+            hits.append(1)
+            return x * 2.0
+        return x * 3.0
+
+    a = f(P.to_tensor([1.0]), True)
+    b = f(P.to_tensor([1.0]), False)
+    np.testing.assert_allclose(a.numpy(), [2.0])
+    np.testing.assert_allclose(b.numpy(), [3.0])
+    assert hits == [1]
+
+
+def test_to_static_if_grads_flow_through_cond():
+    @P.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 3.0
+        else:
+            y = x * 5.0
+        return y.sum()
+
+    x = P.to_tensor([1.0, 1.0], stop_gradient=False)
+    f(x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+    x2 = P.to_tensor([-1.0, -1.0], stop_gradient=False)
+    f(x2).backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [5.0, 5.0])
+
+
+def test_to_static_eager_call_of_converted_fn():
+    """The converted function still runs eagerly (concrete predicates take
+    the plain-Python path)."""
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = -x
+        return y
+
+    g = convert_control_flow(f)
+    assert g is not f
+    np.testing.assert_allclose(g(P.to_tensor([2.0])).numpy(), [4.0])
+    np.testing.assert_allclose(g(P.to_tensor([-2.0])).numpy(), [2.0])
