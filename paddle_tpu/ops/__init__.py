@@ -5,6 +5,7 @@ from .math import *  # noqa: F401,F403
 from .manip import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401  (namespaced under paddle_tpu.linalg too)
 from .random import *  # noqa: F401,F403
+from .breadth import *  # noqa: F401,F403
 from . import _method_patch  # noqa: F401  (installs Tensor methods)
 
-from . import creation, linalg, manip, math, random  # noqa: F401
+from . import breadth, creation, linalg, manip, math, random  # noqa: F401

@@ -84,7 +84,8 @@ def _install():
     T.__setitem__ = _setitem
 
     # ---- named methods: bulk-install from op modules ----
-    method_sources = [math, manip, creation, linalg]
+    from . import breadth
+    method_sources = [math, manip, creation, linalg, breadth]
     skip = {"to_tensor", "as_tensor", "arange", "linspace", "logspace", "eye",
             "meshgrid", "zeros", "ones", "full", "empty", "tril_indices",
             "triu_indices", "scatter_nd", "complex"}

@@ -1,0 +1,362 @@
+"""Op-breadth phase 3: stacking/structure, scatter-views, predicates,
+bit-shifts, distance ops, and the full inplace-variant family.
+
+Analog of the remaining public surface of python/paddle/tensor/
+(manipulation.py, math.py, logic.py — e.g. atleast_1d:4584, hstack:5098,
+diagonal_scatter:6913, select_scatter:6975, signbit:7621, combinations:7457)
+and the `*_` inplace variants paddle exposes at top level
+(python/paddle/__init__.py __all__). Inplace variants are generated from the
+out-of-place ops: compute, then rebind the tensor's value/grad-node — under
+jit the "inplace" is functional anyway (XLA buffers are immutable), matching
+how the reference's inplace kernels appear inside its new IR.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import apply
+
+__all__ = []
+
+
+def _export(fn, name=None):
+    name = name or fn.__name__
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def _u(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _multi(f, xs, op_name):
+    ts = [x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)) for x in xs]
+    return apply(lambda *vs: f(vs), *ts, op_name=op_name)
+
+
+# ---- stacking / structure ----
+
+def _atleast(nd):
+    def fn(*inputs):
+        f = {1: jnp.atleast_1d, 2: jnp.atleast_2d, 3: jnp.atleast_3d}[nd]
+        outs = [apply(f, x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)),
+                      op_name=f"atleast_{nd}d") for x in inputs]
+        return outs[0] if len(outs) == 1 else outs
+    fn.__name__ = f"atleast_{nd}d"
+    return fn
+
+
+_export(_atleast(1))
+_export(_atleast(2))
+_export(_atleast(3))
+
+
+@_export
+def hstack(x, name=None):
+    return _multi(jnp.hstack, x, "hstack")
+
+
+@_export
+def vstack(x, name=None):
+    return _multi(jnp.vstack, x, "vstack")
+
+
+@_export
+def dstack(x, name=None):
+    return _multi(jnp.dstack, x, "dstack")
+
+
+@_export
+def column_stack(x, name=None):
+    return _multi(jnp.column_stack, x, "column_stack")
+
+
+@_export
+def row_stack(x, name=None):
+    return _multi(jnp.vstack, x, "row_stack")
+
+
+@_export
+def block_diag(inputs, name=None):
+    import jax.scipy.linalg as jsl
+    return _multi(lambda vs: jsl.block_diag(*[jnp.atleast_2d(v) for v in vs]),
+                  inputs, "block_diag")
+
+
+@_export
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    def f(v):
+        n = v.shape[-1]
+        size = n + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (size, size), v.dtype)
+        idx = jnp.arange(n)
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(v)
+        return jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return apply(f, input, op_name="diag_embed")
+
+
+@_export
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    def f(v):
+        n = v.shape[0]
+        it = itertools.combinations_with_replacement(range(n), r) \
+            if with_replacement else itertools.combinations(range(n), r)
+        idx = np.asarray(list(it), np.int32).reshape(-1, r)
+        return v[idx]
+    return apply(f, x, op_name="combinations")
+
+
+@_export
+def cartesian_prod(x, name=None):
+    def f(vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return _multi(f, x, "cartesian_prod")
+
+
+# ---- scatter views ----
+
+@_export
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(v, s):
+        v2 = jnp.moveaxis(v, (axis1, axis2), (-2, -1))
+        h, w = v2.shape[-2], v2.shape[-1]
+        n = min(h + min(offset, 0), w - max(offset, 0))
+        idx = jnp.arange(n)
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        v2 = v2.at[..., r, c].set(s)
+        return jnp.moveaxis(v2, (-2, -1), (axis1, axis2))
+    return apply(f, x, y, op_name="diagonal_scatter")
+
+
+@_export
+def select_scatter(x, values, axis, index, name=None):
+    def f(v, s):
+        idx = [slice(None)] * v.ndim
+        idx[axis] = index
+        return v.at[tuple(idx)].set(s)
+    return apply(f, x, values, op_name="select_scatter")
+
+
+@_export
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(v, s):
+        idx = [slice(None)] * v.ndim
+        for a, st, en, sr in zip(axes, starts, ends, strides):
+            idx[int(a)] = slice(int(st), int(en), int(sr))
+        return v.at[tuple(idx)].set(s)
+    return apply(f, x, value, op_name="slice_scatter")
+
+
+# ---- predicates / sign ----
+
+@_export
+def signbit(x, name=None):
+    return apply(jnp.signbit, x, op_name="signbit")
+
+
+@_export
+def isposinf(x, name=None):
+    return apply(jnp.isposinf, x, op_name="isposinf")
+
+
+@_export
+def isneginf(x, name=None):
+    return apply(jnp.isneginf, x, op_name="isneginf")
+
+
+@_export
+def isreal(x, name=None):
+    return apply(jnp.isreal, x, op_name="isreal")
+
+
+@_export
+def positive(x, name=None):
+    return apply(lambda v: +v, x, op_name="positive")
+
+
+@_export
+def negative(x, name=None):
+    return apply(jnp.negative, x, op_name="negative")
+
+
+# ---- bitwise shifts ----
+
+@_export
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return apply(jnp.left_shift, x, y, op_name="bitwise_left_shift")
+
+
+@_export
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    # arithmetic shift preserves sign (numpy semantics for signed ints);
+    # logical shift operates on the unsigned bit pattern
+    if is_arithmetic:
+        return apply(jnp.right_shift, x, y, op_name="bitwise_right_shift")
+
+    def f(v, s):
+        if not jnp.issubdtype(v.dtype, jnp.signedinteger):
+            return jnp.right_shift(v, s)
+        u = {"int8": jnp.uint8, "int16": jnp.uint16, "int32": jnp.uint32,
+             "int64": jnp.uint64}[str(v.dtype)]
+        return jnp.right_shift(v.astype(u), s.astype(u)).astype(v.dtype)
+    return apply(f, x, y, op_name="bitwise_right_shift")
+
+
+@_export
+def bitwise_invert(x, name=None):
+    return apply(jnp.invert, x, op_name="bitwise_invert")
+
+
+# ---- math ----
+
+@_export
+def sinc(x, name=None):
+    return apply(jnp.sinc, x, op_name="sinc")
+
+
+@_export
+def cbrt(x, name=None):
+    return apply(jnp.cbrt, x, op_name="cbrt")
+
+
+@_export
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x, op_name="sigmoid")
+
+
+@_export
+def pdist(x, p=2.0, name=None):
+    def f(v):
+        n = v.shape[0]
+        i, j = np.triu_indices(n, k=1)
+        d = v[i] - v[j]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1))
+        if p == 0:
+            return jnp.sum(d != 0, axis=-1).astype(v.dtype)
+        if np.isinf(p):
+            return jnp.max(jnp.abs(d), axis=-1)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return apply(f, x, op_name="pdist")
+
+
+@_export
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (reference: math.py reduce_as)."""
+    def f(v, t):
+        extra = v.ndim - t.ndim
+        if extra:
+            v = jnp.sum(v, axis=tuple(range(extra)))
+        keep = tuple(i for i in range(v.ndim)
+                     if t.shape[i] == 1 and v.shape[i] != 1)
+        if keep:
+            v = jnp.sum(v, axis=keep, keepdims=True)
+        return v
+    return apply(f, x, target, op_name="reduce_as")
+
+
+@_export
+def rearrange(tensor, pattern, **axes_lengths):
+    import einops
+
+    def f(v):
+        return einops.rearrange(v, pattern, **axes_lengths)
+    return apply(f, tensor, op_name="rearrange")
+
+
+@_export
+def reverse(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply(lambda v: jnp.flip(v, ax), x, op_name="reverse")
+
+
+# ---- inplace-variant family -------------------------------------------------
+#
+# paddle exposes `op_(x, ...)` top-level and `x.op_(...)` methods for most
+# out-of-place ops (python/paddle/__init__.py __all__). The generated variant
+# computes out-of-place, then rebinds the tensor to the result (value AND
+# autograd node, like manip.reshape_).
+
+def _rebind(x, out):
+    x._set_value(out._value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def _make_inplace(base_fn, name):
+    def ip(x, *args, **kwargs):
+        return _rebind(x, base_fn(x, *args, **kwargs))
+    ip.__name__ = name
+    ip.__doc__ = f"Inplace version of ``{base_fn.__name__}``."
+    return ip
+
+
+_INPLACE_ALIASES = {"remainder": "mod", "floor_mod": "mod", "mod": "mod"}
+
+_INPLACE_BASES = [
+    "abs", "acos", "add", "addmm", "atan", "bitwise_and", "bitwise_invert",
+    "bitwise_not", "bitwise_or", "bitwise_xor", "cast", "ceil", "clip",
+    "cos", "cumprod", "cumsum", "digamma", "divide", "equal", "erf",
+    "erfinv", "exp", "expm1", "fill_diagonal", "fill_diagonal_tensor",
+    "floor", "floor_divide", "floor_mod", "frac", "gcd", "greater_equal",
+    "greater_than", "i0", "index_add", "index_put", "lcm", "ldexp", "lerp",
+    "less_equal", "less_than", "lgamma", "log", "log10", "log1p", "log2",
+    "logical_and", "logical_not", "logical_or", "logical_xor", "logit",
+    "mod", "multiply", "nan_to_num", "neg", "not_equal", "polygamma",
+    "pow", "put_along_axis", "reciprocal", "remainder", "renorm", "round",
+    "rsqrt", "scale", "scatter", "sigmoid", "sin", "sinh", "sqrt", "square",
+    "squeeze", "subtract", "tan", "tanh", "tril", "triu", "trunc",
+    "unsqueeze",
+]
+
+
+def _where_inplace():
+    """where_'s inplace target is x (arg 1), not the condition (arg 0)."""
+    from . import manip, math
+    base = getattr(math, "where", None) or getattr(manip, "where", None)
+    if base is None:
+        return
+
+    def where_(condition, x, y, name=None):
+        return _rebind(x, base(condition, x, y))
+    _export(where_)
+
+
+_where_inplace()
+
+
+def _install_inplace():
+    from . import creation, linalg, manip, math
+    sources = [globals(), *(vars(m) for m in (math, manip, creation, linalg))]
+
+    def lookup(base):
+        target = _INPLACE_ALIASES.get(base, base)
+        for src in sources:
+            if target in src and callable(src[target]):
+                return src[target]
+        return None
+
+    made = []
+    for base in _INPLACE_BASES:
+        name = base + "_"
+        if name in globals():
+            continue
+        fn = lookup(base)
+        if fn is None:
+            continue
+        _export(_make_inplace(fn, name), name)
+        made.append(name)
+    return made
+
+
+_install_inplace()

@@ -16,12 +16,18 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # hard override: the env may pin a
+# (possibly wedged) accelerator platform via JAX_PLATFORMS
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=4"
 
 import jax  # noqa: E402
+
+# sitecustomize may have imported jax before this script ran, in which case
+# the env var was already captured — pin the platform via config too
+jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
