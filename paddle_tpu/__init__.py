@@ -41,6 +41,12 @@ from . import utils  # noqa: E402,F401
 from .utils import flags as _flags  # noqa: E402
 from .utils.flags import set_flags, get_flags  # noqa: E402,F401
 from .framework_io import save, load  # noqa: E402,F401
+from .framework_compat import (  # noqa: E402,F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, LazyGuard, ParamAttr, TPUPlace,
+    batch, bool, check_shape, disable_signal_handler, dtype, finfo, flops,
+    get_cuda_rng_state, get_rng_state, iinfo, set_cuda_rng_state,
+    set_grad_enabled, set_printoptions, set_rng_state,
+)
 
 __version__ = "0.1.0"
 

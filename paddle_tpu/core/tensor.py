@@ -150,6 +150,44 @@ class Tensor:
         return dispatch.apply(jnp.copy, self, op_name="clone")
 
     # in-place value swap (used by optimizers / load_state_dict)
+    def _inplace_assign(self, out: "Tensor") -> "Tensor":
+        """Adopt `out`'s value AND tape linkage in place (`x.op_()` semantics).
+
+        The node that produced `out` holds `self` among its inputs; naively
+        repointing self._grad_node at that node would make the tape edge a
+        self-loop (the node's input's parent is the node itself), silently
+        dropping every upstream gradient.  Instead the pre-op tape state is
+        snapshotted into a fresh Tensor which replaces `self` in the node's
+        inputs, keeping the chain intact — the eager analog of the
+        reference's inplace version-counter + AutogradMeta rewiring
+        (paddle/fluid/eager/eager_tensor.h)."""
+        node = getattr(out, "_grad_node", None)
+        if node is None:
+            # no-grad product (e.g. inplace op under no_grad): value-only
+            # update; keep this tensor's recorded tape edge and grad flags
+            self._set_value(out._value)
+            return self
+        if out is not self:
+            if self.stop_gradient is False and self._grad_node is None:
+                raise RuntimeError(
+                    "a leaf Tensor with stop_gradient=False cannot be the "
+                    "target of an inplace op; operate out-of-place or set "
+                    "stop_gradient=True first")
+            snap = None
+            for i, inp in enumerate(node.inputs):
+                if inp is self:
+                    if snap is None:
+                        snap = Tensor(self._value,
+                                      stop_gradient=self.stop_gradient)
+                        snap._grad_node = self._grad_node
+                        snap._out_index = self._out_index
+                        snap._backward_hooks = self._backward_hooks
+                    node.inputs[i] = snap
+        self._set_value(out._value)
+        self._grad_node, self._out_index = out._grad_node, out._out_index
+        self.stop_gradient = out.stop_gradient
+        return self
+
     def _set_value(self, new_value):
         if isinstance(new_value, Tensor):
             new_value = new_value._value
@@ -179,7 +217,8 @@ class Tensor:
 
 class Parameter(Tensor):
     """Trainable tensor — analog of paddle's Parameter/EagerParamBase."""
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed", "_sharding")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed",
+                 "_sharding", "_lazy_initializer")
 
     def __init__(self, value, name=None, trainable=True):
         super().__init__(value, stop_gradient=not trainable, name=name)

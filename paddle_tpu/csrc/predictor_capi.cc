@@ -1,0 +1,370 @@
+// C ABI for the inference Predictor — the serving-embedder surface the
+// reference exposes as paddle/fluid/inference/capi_exp/ (pd_config.h,
+// pd_inference_api).  Design differs by necessity and by TPU-first choice:
+// the reference's C API fronts its C++ AnalysisPredictor; ours fronts the
+// StableHLO Predictor (paddle_tpu/inference), whose execution engine is
+// PJRT/XLA.  The C layer embeds a CPython interpreter purely as the
+// control-plane glue — tensor data crosses as raw buffers, and all compute
+// runs compiled XLA, so the overhead is per-call microseconds, not per-op.
+//
+// Flat C ABI (no C++ types across the boundary), ctypes/dlopen friendly:
+//   PDT_Init(platform)                 — optional; force "cpu"/"tpu"
+//   PDT_ConfigCreate / SetModel / Destroy
+//   PDT_PredictorCreate / Destroy
+//   PDT_PredictorGetInputNum/Name, GetOutputNum/Name
+//   PDT_PredictorGetInputHandle / GetOutputHandle, PDT_TensorDestroy
+//   PDT_TensorReshape / CopyFromCpuFloat / CopyToCpuFloat / GetShape
+//   PDT_PredictorRun
+//   PDT_GetLastError
+// Thread model: calls may come from any thread; every entry point takes the
+// GIL (PyGILState_Ensure), so concurrent calls serialize on the interpreter
+// but never corrupt it.
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  g_last_error = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() : st(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(st); }
+};
+
+bool ensure_interpreter(const char* platform) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // hand the GIL back so GIL guards below work from any thread
+    PyEval_SaveThread();
+  }
+  GIL gil;
+  if (platform && platform[0]) {
+    std::string code =
+        "import jax\n"
+        "jax.config.update('jax_platforms', '" + std::string(platform) + "')\n";
+    if (PyRun_SimpleString(code.c_str()) != 0) {
+      g_last_error = "failed to set jax platform";
+      return false;
+    }
+  }
+  return true;
+}
+
+struct Config {
+  std::string prog_path;
+};
+
+struct Predictor {
+  PyObject* obj = nullptr;  // paddle_tpu.inference.Predictor
+  std::vector<std::string> input_names, output_names;
+};
+
+struct TensorHandle {
+  PyObject* obj = nullptr;  // _IOHandle
+  std::vector<int> shape;   // cache of last GetShape
+};
+
+bool fetch_names(PyObject* pred, const char* method,
+                 std::vector<std::string>* out) {
+  PyObject* names = PyObject_CallMethod(pred, method, nullptr);
+  if (!names) {
+    set_error_from_python();
+    return false;
+  }
+  PyObject* seq = PySequence_Fast(names, "names not a sequence");
+  Py_DECREF(names);
+  if (!seq) {
+    set_error_from_python();
+    return false;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+    const char* c = PyUnicode_AsUTF8(item);
+    out->push_back(c ? c : "");
+  }
+  Py_DECREF(seq);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int PDT_Init(const char* platform) {
+  return ensure_interpreter(platform) ? 0 : -1;
+}
+
+const char* PDT_GetLastError() { return g_last_error.c_str(); }
+
+void* PDT_ConfigCreate() { return new Config(); }
+
+void PDT_ConfigSetModel(void* config, const char* prog_path) {
+  static_cast<Config*>(config)->prog_path = prog_path ? prog_path : "";
+}
+
+void PDT_ConfigDestroy(void* config) { delete static_cast<Config*>(config); }
+
+void* PDT_PredictorCreate(void* config) {
+  if (!ensure_interpreter(nullptr)) return nullptr;
+  GIL gil;
+  Config* cfg = static_cast<Config*>(config);
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* pred = nullptr;
+  PyObject* cfg_cls = PyObject_GetAttrString(mod, "Config");
+  if (cfg_cls) {
+    PyObject* cfg_obj =
+        PyObject_CallFunction(cfg_cls, "s", cfg->prog_path.c_str());
+    Py_DECREF(cfg_cls);
+    if (cfg_obj) {
+      PyObject* create = PyObject_GetAttrString(mod, "create_predictor");
+      if (create) {
+        pred = PyObject_CallFunctionObjArgs(create, cfg_obj, nullptr);
+        Py_DECREF(create);
+      }
+      Py_DECREF(cfg_obj);
+    }
+  }
+  Py_DECREF(mod);
+  if (!pred) {
+    set_error_from_python();
+    return nullptr;
+  }
+  Predictor* p = new Predictor();
+  p->obj = pred;
+  if (!fetch_names(pred, "get_input_names", &p->input_names) ||
+      !fetch_names(pred, "get_output_names", &p->output_names)) {
+    Py_DECREF(pred);
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+void PDT_PredictorDestroy(void* predictor) {
+  Predictor* p = static_cast<Predictor*>(predictor);
+  if (p) {
+    GIL gil;
+    Py_XDECREF(p->obj);
+    delete p;
+  }
+}
+
+size_t PDT_PredictorGetInputNum(void* predictor) {
+  return static_cast<Predictor*>(predictor)->input_names.size();
+}
+
+size_t PDT_PredictorGetOutputNum(void* predictor) {
+  return static_cast<Predictor*>(predictor)->output_names.size();
+}
+
+const char* PDT_PredictorGetInputName(void* predictor, size_t i) {
+  Predictor* p = static_cast<Predictor*>(predictor);
+  return i < p->input_names.size() ? p->input_names[i].c_str() : nullptr;
+}
+
+const char* PDT_PredictorGetOutputName(void* predictor, size_t i) {
+  Predictor* p = static_cast<Predictor*>(predictor);
+  return i < p->output_names.size() ? p->output_names[i].c_str() : nullptr;
+}
+
+static void* get_handle(void* predictor, const char* name, const char* method) {
+  GIL gil;
+  Predictor* p = static_cast<Predictor*>(predictor);
+  PyObject* h = PyObject_CallMethod(p->obj, method, "s", name);
+  if (!h) {
+    set_error_from_python();
+    return nullptr;
+  }
+  TensorHandle* t = new TensorHandle();
+  t->obj = h;
+  return t;
+}
+
+void* PDT_PredictorGetInputHandle(void* predictor, const char* name) {
+  return get_handle(predictor, name, "get_input_handle");
+}
+
+void* PDT_PredictorGetOutputHandle(void* predictor, const char* name) {
+  return get_handle(predictor, name, "get_output_handle");
+}
+
+void PDT_TensorDestroy(void* tensor) {
+  TensorHandle* t = static_cast<TensorHandle*>(tensor);
+  if (t) {
+    GIL gil;
+    Py_XDECREF(t->obj);
+    delete t;
+  }
+}
+
+int PDT_TensorReshape(void* tensor, const int* dims, int ndims) {
+  GIL gil;
+  TensorHandle* t = static_cast<TensorHandle*>(tensor);
+  PyObject* shape = PyList_New(ndims);
+  for (int i = 0; i < ndims; ++i)
+    PyList_SET_ITEM(shape, i, PyLong_FromLong(dims[i]));
+  PyObject* r = PyObject_CallMethod(t->obj, "reshape", "O", shape);
+  Py_DECREF(shape);
+  if (!r) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int PDT_TensorCopyFromCpuFloat(void* tensor, const float* data, size_t n) {
+  GIL gil;
+  TensorHandle* t = static_cast<TensorHandle*>(tensor);
+  // np.frombuffer over a borrowed memoryview, reshaped to the handle's
+  // declared shape — one memcpy into numpy, zero per-element Python work
+  PyObject* mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(data)),
+      static_cast<Py_ssize_t>(n * sizeof(float)), PyBUF_READ);
+  if (!mv) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) {
+    Py_DECREF(mv);
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* arr =
+      PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32");
+  Py_DECREF(mv);
+  Py_DECREF(np);
+  if (!arr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* shape = PyObject_GetAttrString(t->obj, "_shape");
+  PyObject* shaped = shape && shape != Py_None
+                         ? PyObject_CallMethod(arr, "reshape", "O", shape)
+                         : (Py_INCREF(arr), arr);
+  Py_XDECREF(shape);
+  Py_DECREF(arr);
+  if (!shaped) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* copy_arr = PyObject_CallMethod(shaped, "copy", nullptr);
+  Py_DECREF(shaped);
+  if (!copy_arr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(t->obj, "copy_from_cpu", "O", copy_arr);
+  Py_DECREF(copy_arr);
+  if (!r) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int PDT_PredictorRun(void* predictor) {
+  GIL gil;
+  Predictor* p = static_cast<Predictor*>(predictor);
+  PyObject* r = PyObject_CallMethod(p->obj, "run", nullptr);
+  if (!r) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int PDT_TensorGetShape(void* tensor, int* dims_out, int max_dims,
+                       int* ndims_out) {
+  GIL gil;
+  TensorHandle* t = static_cast<TensorHandle*>(tensor);
+  PyObject* shape = PyObject_CallMethod(t->obj, "shape", nullptr);
+  if (!shape) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* seq = PySequence_Fast(shape, "shape not a sequence");
+  Py_DECREF(shape);
+  if (!seq) {
+    set_error_from_python();
+    return -1;
+  }
+  int n = static_cast<int>(PySequence_Fast_GET_SIZE(seq));
+  *ndims_out = n;
+  for (int i = 0; i < n && i < max_dims; ++i)
+    dims_out[i] =
+        static_cast<int>(PyLong_AsLong(PySequence_Fast_GET_ITEM(seq, i)));
+  Py_DECREF(seq);
+  return 0;
+}
+
+int PDT_TensorCopyToCpuFloat(void* tensor, float* data, size_t n) {
+  GIL gil;
+  TensorHandle* t = static_cast<TensorHandle*>(tensor);
+  PyObject* arr = PyObject_CallMethod(t->obj, "copy_to_cpu", nullptr);
+  if (!arr) {
+    set_error_from_python();
+    return -1;
+  }
+  // np.ascontiguousarray(arr, float32).tobytes() → memcpy out
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* flat = np ? PyObject_CallMethod(np, "ascontiguousarray", "Os",
+                                            arr, "float32")
+                      : nullptr;
+  Py_XDECREF(np);
+  Py_DECREF(arr);
+  if (!flat) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* bytes = PyObject_CallMethod(flat, "tobytes", nullptr);
+  Py_DECREF(flat);
+  if (!bytes) {
+    set_error_from_python();
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &len) != 0) {
+    Py_DECREF(bytes);
+    set_error_from_python();
+    return -1;
+  }
+  size_t want = n * sizeof(float);
+  std::memcpy(data, buf,
+              len < static_cast<Py_ssize_t>(want) ? len : want);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+}  // extern "C"

@@ -76,6 +76,17 @@ def prune_by_mbs(tuner_cfg, cur, history=None):
     return False
 
 
+def _state_bytes(n_params, cur):
+    """Per-device parameter-state bytes: 4B master + 8B adam moments sharded
+    over mp*pp*sharding, plus the 2B bf16 compute copy sharded over mp*pp.
+    Single source of truth for every memory-based prune rule."""
+    mp = cur.get("mp_degree", 1)
+    pp = cur.get("pp_degree", 1)
+    sh = cur.get("sharding_degree", 1)
+    return (n_params * (4 + 8) / (mp * pp * max(sh, 1))
+            + n_params * 2 / (mp * pp))
+
+
 @register_prune
 def prune_by_memory_estimate(tuner_cfg, cur, history=None):
     """Rough HBM estimate: params(4B master + 8B adam + 2B compute copy) /
@@ -86,9 +97,7 @@ def prune_by_memory_estimate(tuner_cfg, cur, history=None):
         return False
     mp = cur.get("mp_degree", 1)
     pp = cur.get("pp_degree", 1)
-    sh = cur.get("sharding_degree", 1)
-    state_bytes = n_params * (4 + 8) / (mp * pp * max(sh, 1))
-    compute_bytes = n_params * 2 / (mp * pp)
+    state_and_compute = _state_bytes(n_params, cur)
     gbs = tuner_cfg.get("global_batch_size", 1)
     seq = tuner_cfg.get("seq_length", 1)
     hidden = tuner_cfg.get("hidden_size", 1)
@@ -98,7 +107,7 @@ def prune_by_memory_estimate(tuner_cfg, cur, history=None):
     act = 2.0 * gbs / dp / mb * seq * hidden * layers / pp / mp
     if not cur.get("use_recompute", False):
         act *= 4.0
-    return (state_bytes + compute_bytes + act) > budget
+    return (state_and_compute + act) > budget
 
 
 @register_prune
@@ -121,15 +130,12 @@ def prune_by_schedule_tradeoff(tuner_cfg, cur, history=None):
     pp = cur.get("pp_degree", 1)
     if pp <= 1:
         return schedule == "1f1b"  # no pipeline, 1f1b machinery is pure cost
-    sh = cur.get("sharding_degree", 1)
     dp = cur.get("dp_degree", 1)
     M = cur.get("micro_batches", 1)
     gbs = tuner_cfg.get("global_batch_size", 1)
     seq = tuner_cfg.get("seq_length", 1)
     hidden = tuner_cfg.get("hidden_size", 1)
-    base = n_params * (4 + 8) / (mp * pp * max(sh, 1)) \
-        + n_params * 2 / (mp * pp)
-    headroom = budget - base
+    headroom = budget - _state_bytes(n_params, cur)
     per_mb = 2.0 * (gbs / dp / M) * seq * hidden / mp  # one stage input
     gpipe_stash = (M + pp - 1) * per_mb
     f1b_stash = min(pp, M) * per_mb

@@ -222,9 +222,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
            ReduceOp.AVG: lambda v, a: jax.lax.pmean(v, a)}.get(op, jax.lax.psum)
     if _in_shard_map(axis):
         out = apply(lambda v: red(v, axis), tensor, op_name="all_reduce")
-        tensor._set_value(out._value)
-        tensor._grad_node, tensor._out_index = out._grad_node, out._out_index
-        tensor.stop_gradient = out.stop_gradient
+        _update_inplace(tensor, out)
         return _Task(tensor)
     # global view: psum over the axis via a pass-through shard_map
     _check_replicated(tensor, axis, "all_reduce")
@@ -239,9 +237,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     # On a replicated global array every shard is identical: psum multiplies by
     # the axis size — matching per-rank all_reduce semantics.
     out = apply(f, tensor, op_name="all_reduce")
-    tensor._set_value(out._value)
-    tensor._grad_node, tensor._out_index = out._grad_node, out._out_index
-    tensor.stop_gradient = out.stop_gradient
+    _update_inplace(tensor, out)
     return _Task(tensor)
 
 
@@ -317,12 +313,37 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         out = apply(lambda v: jax.lax.psum_scatter(v, axis, scatter_dimension=0,
                                                    tiled=True),
                     src, op_name="reduce_scatter")
-        tensor._set_value(out._value)
-        tensor._grad_node, tensor._out_index = out._grad_node, out._out_index
-        tensor.stop_gradient = out.stop_gradient
+        _update_inplace(tensor, out)
         return _Task(tensor)
-    raise NotImplementedError("reduce_scatter outside shard_map: shard the "
-                              "tensor over the mesh axis instead (GSPMD)")
+    # Global view: in GSPMD a reduce_scatter IS "reduce, then reshard dim 0
+    # over the group axis" — device j's shard of the result is exactly rank
+    # j's chunk.  With a replicated input every rank contributes the same
+    # value, so the reduction is closed-form per op.
+    ax = _single_axis(axis)
+    _check_replicated(src, axis, "reduce_scatter")
+    n = group.nranks if group is not None else mesh_mod.mesh_axis_size(ax)
+    full = _u(src)
+    if full.shape[0] % n != 0:
+        raise ValueError(
+            f"reduce_scatter: dim 0 ({full.shape[0]}) not divisible by "
+            f"group size {n}")
+    red = {ReduceOp.SUM: lambda v: v * n, ReduceOp.AVG: lambda v: v,
+           ReduceOp.MAX: lambda v: v, ReduceOp.MIN: lambda v: v,
+           ReduceOp.PROD: lambda v: v ** n}.get(op, lambda v: v * n)
+    out = apply(red, src, op_name="reduce_scatter")
+    spec = PartitionSpec(ax, *([None] * (full.ndim - 1)))
+    out._set_value(_shard_global(out._value, spec))
+    _update_inplace(tensor, out)
+    return _Task(tensor)
+
+
+def _shard_global(value, spec):
+    """Lay a global-view array out with `spec` (device_put eagerly; a sharding
+    constraint when tracing under jit)."""
+    sharding = NamedSharding(mesh_mod.get_mesh(), spec)
+    if isinstance(value, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(value, sharding)
+    return jax.device_put(value, sharding)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
@@ -367,9 +388,19 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if len(tensor_list) != n:
         raise ValueError(f"scatter needs {n} tensors, got {len(tensor_list)}")
     if not _in_shard_map(ax):
-        raise NotImplementedError(
-            "scatter() requires a per-rank view (inside shard_map); in the "
-            "global view shard the stacked tensor over the mesh axis instead")
+        # Global view: rank j's output is tensor_list[j] (all copies are
+        # authoritative here — replicated inputs ARE src's copies).  The
+        # GSPMD encoding of "each rank holds its own chunk" is the
+        # concatenation sharded over the group axis on dim 0: device j's
+        # shard IS tensor_list[j].
+        for t in tensor_list:
+            _check_replicated(t, axis, "scatter")
+        from ..ops.manip import concat
+        out = concat(list(tensor_list), axis=0)
+        spec = PartitionSpec(ax, *([None] * (_u(out).ndim - 1)))
+        out._set_value(_shard_global(out._value, spec))
+        _update_inplace(tensor, out)
+        return _Task(tensor)
     src_i = int(src) % n
 
     def f(*vs):
@@ -466,9 +497,8 @@ def _from_src(v, ax, src_i):
 
 
 def _update_inplace(tensor, out):
-    tensor._set_value(out._value)
-    tensor._grad_node, tensor._out_index = out._grad_node, out._out_index
-    tensor.stop_gradient = out.stop_gradient
+    # snapshot-aware rebind: avoids the tape self-loop (Tensor._inplace_assign)
+    tensor._inplace_assign(out)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):

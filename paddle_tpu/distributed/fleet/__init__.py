@@ -3,7 +3,7 @@ from . import meta_parallel  # noqa: F401
 from .distributed_strategy import DistributedStrategy  # noqa: F401
 from .fleet import (  # noqa: F401
     init, distributed_model, distributed_optimizer, worker_num, worker_index,
-    is_first_worker, get_hybrid_communicate_group,
+    is_first_worker, is_worker, is_server, get_hybrid_communicate_group,
 )
 from .hybrid_optimizer import HybridParallelOptimizer  # noqa: F401
 from .role_maker import (  # noqa: F401

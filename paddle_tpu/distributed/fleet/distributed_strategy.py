@@ -28,9 +28,22 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01,
+                             "exclude_from_weight_decay": []}
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                             "epsilon": 0.0, "exclude_from_weight_decay": []}
+        # dgc / localsgd / fp16_allreduce are accepted for API parity but are
+        # documented N/A on TPU: they exist to cut gradient-allreduce bytes on
+        # slow interconnects (PCIe/ethernet NCCL rings); over ICI the fused
+        # bf16 psum XLA emits is already bandwidth-optimal, and sparsifying or
+        # desynchronizing it would cost accuracy for no speedup (see README
+        # "Meta-optimizer dispositions"). Enabling them warns and no-ops.
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        self.fp16_allreduce = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.find_unused_parameters = False

@@ -87,11 +87,13 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    from .hybrid_optimizer import HybridParallelOptimizer, apply_meta_optimizers
+    strategy = strategy or _state.strategy
+    optimizer = apply_meta_optimizers(optimizer, strategy)
     hcg = get_hcg()
     if hcg is None:
         return optimizer
-    from .hybrid_optimizer import HybridParallelOptimizer
-    return HybridParallelOptimizer(optimizer, hcg, _state.strategy)
+    return HybridParallelOptimizer(optimizer, hcg, strategy)
 
 
 # introspection API parity (role maker first, env fallback)

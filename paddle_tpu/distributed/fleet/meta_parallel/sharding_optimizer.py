@@ -79,7 +79,18 @@ def _shard_opt_states(optim: Optimizer, mesh):
 def _stage2_eager_step(optim: Optimizer):
     """One eager stage-2 step: scatter grads over the sharding axis (the
     eager analog of reduce-to-owner, group_sharded_stage2.py:46), update,
-    shard the states, re-gather params to their at-rest layout."""
+    shard the states, re-gather params to their at-rest layout.
+
+    PERF NOTE (deliberate tradeoff, not the perf path): this eager step pays
+    full-size transients — the grad materializes replicated before the
+    scatter, and params are re-gathered to replicated layout after every
+    step, i.e. per-step all-gather traffic of the whole model.  Semantics
+    match the reference's stage-2 exactly, which is what the eager path is
+    for (debugging/parity).  Real training runs the COMPILED path
+    (`build_hybrid_train_step` / `compile_train_step`), where `_zero_state_spec`
+    hands GSPMD sharded state specs and XLA fuses the reduce-scatter into
+    the backward and overlaps the all-gather with the next forward — no
+    full-size transient ever materializes there."""
     from ....parallel.trainer import _param_sharding_spec
     mesh = _mesh_with_axis()
     if mesh is not None:

@@ -21,11 +21,10 @@ class Linear(Layer):
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
+        # create_parameter applies XavierNormal by default (is_bias=False)
+        # and honors weight_attr.initializer / LazyGuard deferral
         self.weight = self.create_parameter(
-            [in_features, out_features], attr=weight_attr,
-            default_initializer=None if weight_attr is None else None)
-        if weight_attr is None or getattr(weight_attr, "initializer", None) is None:
-            XavierNormal()(self.weight)
+            [in_features, out_features], attr=weight_attr)
         if bias_attr is False:
             self.bias = None
         else:

@@ -35,6 +35,7 @@ class Layer:
         self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
         self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
         self._hook_id = 0
+        self._lazy_pending = False  # params created under LazyGuard, uninit
         self._name_scope = name_scope or self.__class__.__name__.lower()
 
     # ---- attribute plumbing ----
@@ -96,8 +97,32 @@ class Layer:
         if init is None:
             init = Constant(0.0) if is_bias else XavierNormal()
         p = Parameter(jnp.zeros(tuple(int(s) for s in shape), dt))
-        init(p)
+        if attr is not None:
+            if getattr(attr, "name", None):
+                p.name = attr.name
+            if getattr(attr, "trainable", True) is False:
+                p.stop_gradient = True
+        from ...framework_compat import LazyGuard
+        if LazyGuard._active:
+            # lazy init (LazyGuard): keep the zeros placeholder unwritten;
+            # lazy_init() (or the first forward) runs `init` later
+            p._lazy_initializer = init
+            self._lazy_pending = True
+        else:
+            init(p)
         return p
+
+    def lazy_init(self):
+        """Run deferred initializers for parameters created under LazyGuard
+        (recursive; also triggered by the first post-guard forward)."""
+        for p in self.parameters():
+            init = getattr(p, "_lazy_initializer", None)
+            if init is not None:
+                init(p)
+                p._lazy_initializer = None
+        for _, sub in self.named_sublayers(include_self=True):
+            sub._lazy_pending = False
+        return self
 
     # ---- iteration ----
     def named_sublayers(self, prefix="", include_self=False) -> Iterator[Tuple[str, "Layer"]]:
@@ -186,6 +211,9 @@ class Layer:
 
     # ---- call ----
     def __call__(self, *inputs, **kwargs):
+        if self._lazy_pending:
+            # first forward after a LazyGuard block: run deferred initializers
+            self.lazy_init()
         for hook in list(self._forward_pre_hooks.values()):
             result = hook(self, inputs)
             if result is not None:

@@ -88,7 +88,12 @@ def _install():
     method_sources = [math, manip, creation, linalg, breadth]
     skip = {"to_tensor", "as_tensor", "arange", "linspace", "logspace", "eye",
             "meshgrid", "zeros", "ones", "full", "empty", "tril_indices",
-            "triu_indices", "scatter_nd", "complex"}
+            "triu_indices", "scatter_nd", "complex",
+            # sequence-input ops: `self` would bind to the tensor-list param,
+            # and paddle's Tensor does not define these as methods
+            "hstack", "vstack", "dstack", "column_stack", "row_stack",
+            "block_diag", "cartesian_prod", "atleast_1d", "atleast_2d",
+            "atleast_3d"}
     for mod in method_sources:
         for name in getattr(mod, "__all__", []):
             if name in skip or hasattr(T, name):
@@ -97,20 +102,9 @@ def _install():
             if callable(fn):
                 setattr(T, name, fn)
 
-    # in-place variants used pervasively by optimizers/training code
-    def _make_inplace(op):
-        def ip(s, *a, **k):
-            out = op(s, *a, **k)
-            s._set_value(out._value)
-            return s
-        return ip
-
-    for base in ["add", "subtract", "multiply", "divide", "clip", "scale", "floor",
-                 "ceil", "exp", "sqrt", "rsqrt", "reciprocal", "round", "tanh",
-                 "cast"]:
-        setattr(T, base + "_", _make_inplace(getattr(math, base)))
-    T.zero_ = lambda s: s._set_value(jnp.zeros_like(s._value)) or s
-    T.fill_ = lambda s, v: s._set_value(jnp.full_like(s._value, v)) or s
+    # in-place variants come from breadth._install_inplace via the bulk
+    # install above — those rebind both value AND grad node, so x.sqrt_()
+    # and P.sqrt_(x) share one autograd semantics.
 
     def _zero(s):
         s._set_value(jnp.zeros_like(s._value))

@@ -29,10 +29,6 @@ def _export(fn, name=None):
     return fn
 
 
-def _u(x):
-    return x._value if isinstance(x, Tensor) else x
-
-
 def _multi(f, xs, op_name):
     ts = [x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)) for x in xs]
     return apply(lambda *vs: f(vs), *ts, op_name=op_name)
@@ -275,8 +271,8 @@ def rearrange(tensor, pattern, **axes_lengths):
 
 @_export
 def reverse(x, axis, name=None):
-    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
-    return apply(lambda v: jnp.flip(v, ax), x, op_name="reverse")
+    from .manip import flip
+    return flip(x, axis)
 
 
 # ---- inplace-variant family -------------------------------------------------
@@ -287,10 +283,7 @@ def reverse(x, axis, name=None):
 # autograd node, like manip.reshape_).
 
 def _rebind(x, out):
-    x._set_value(out._value)
-    x._grad_node, x._out_index = out._grad_node, out._out_index
-    x.stop_gradient = out.stop_gradient
-    return x
+    return x._inplace_assign(out)
 
 
 def _make_inplace(base_fn, name):

@@ -44,11 +44,7 @@ def reshape(x, shape):
 
 @_export
 def reshape_(x, shape):
-    out = reshape(x, shape)
-    x._set_value(out._value)
-    x._grad_node, x._out_index = out._grad_node, out._out_index
-    x.stop_gradient = out.stop_gradient
-    return x
+    return x._inplace_assign(reshape(x, shape))
 
 
 @_export

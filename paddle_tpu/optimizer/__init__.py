@@ -1,6 +1,7 @@
 """paddle_tpu.optimizer — analog of python/paddle/optimizer/."""
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizers import (  # noqa: F401
-    SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb,
+    SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb, Lars,
 )

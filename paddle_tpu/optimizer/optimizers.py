@@ -182,13 +182,17 @@ class Lamb(Optimizer):
                  exclude_from_weight_decay_fn=None, name=None, **kw):
         super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
 
     def _init_state(self, p: Parameter):
+        excluded = bool(self._exclude_fn(p)) if self._exclude_fn else False
         return {"moment1": jnp.zeros_like(p._value),
-                "moment2": jnp.zeros_like(p._value)}
+                "moment2": jnp.zeros_like(p._value),
+                "wd_scale": jnp.asarray(0.0 if excluded else 1.0, jnp.float32)}
 
     def _update_rule(self, val, grad, state, lr, wd):
         b1, b2, eps = self._beta1, self._beta2, self._eps
+        wd = wd * state.get("wd_scale", 1.0)
         t = state["__step__"].astype(jnp.float32)
         m = b1 * state["moment1"] + (1 - b1) * grad
         v = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
@@ -198,4 +202,44 @@ class Lamb(Optimizer):
         w_norm = jnp.sqrt(jnp.sum(jnp.square(val)))
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
-        return val - lr.astype(val.dtype) * trust * r, {"moment1": m, "moment2": v}
+        return val - lr.astype(val.dtype) * trust * r, \
+            {"moment1": m, "moment2": v,
+             "wd_scale": state.get("wd_scale", jnp.asarray(1.0, jnp.float32))}
+
+
+class Lars(Optimizer):
+    """LARS momentum: layer-wise adaptive rate scaling (analog of
+    python/paddle/incubate/optimizer/lars_momentum.py:30-41 and the fleet
+    lars meta-optimizer).  The layer-local learning rate
+
+        local_lr = lr * lars_coeff * ||w|| / (||g|| + wd * ||w|| + eps)
+
+    scales each tensor's momentum update; the whole-model update still runs
+    as ONE fused XLA program via the base class."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=0.0, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, lars_weight_decay,
+                         grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._epsilon = epsilon
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _init_state(self, p: Parameter):
+        name = getattr(p, "name", "") or ""
+        excluded = any(tag in name for tag in self._exclude)
+        return {"velocity": jnp.zeros_like(p._value),
+                "wd_scale": jnp.asarray(0.0 if excluded else 1.0, jnp.float32)}
+
+    def _update_rule(self, val, grad, state, lr, wd):
+        mu, coeff, eps = self._momentum, self._lars_coeff, self._epsilon
+        wd = wd * state["wd_scale"]
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(val.astype(jnp.float32))))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(grad.astype(jnp.float32))))
+        denom = g_norm + wd * w_norm + eps
+        local_lr = jnp.where(denom > 0, lr * coeff * w_norm / denom, lr)
+        v = mu * state["velocity"] + local_lr.astype(val.dtype) * (
+            grad + wd.astype(val.dtype) * val)
+        return val - v, {"velocity": v, "wd_scale": state["wd_scale"]}

@@ -57,6 +57,39 @@ def _build() -> str | None:
         return str(e)
 
 
+def build_capi() -> str:
+    """(Re)build the serving C ABI (csrc/predictor_capi.cc →
+    libpaddle_tpu_capi.so, the capi_exp analog). Returns the .so path;
+    raises on compile failure. Same atomic-publish discipline as _build()."""
+    import tempfile
+    src = os.path.join(_CSRC, "predictor_capi.cc")
+    out = os.path.join(_CSRC, "libpaddle_tpu_capi.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    includes = subprocess.run(["python3-config", "--includes"],
+                              capture_output=True, text=True,
+                              check=True).stdout.split()
+    ldflags = subprocess.run(["python3-config", "--ldflags", "--embed"],
+                             capture_output=True, text=True,
+                             check=True).stdout.split()
+    fd, tmp = tempfile.mkstemp(suffix=".so", prefix=".capi_build_", dir=_CSRC)
+    os.close(fd)
+    try:
+        cmd = (["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                src] + includes + ldflags + ["-o", tmp])
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(f"capi build failed:\n{proc.stderr[-2000:]}")
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return out
+
+
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     c = ctypes
     sigs = {

@@ -20,12 +20,29 @@ def _build_native(repo_root):
     csrc = os.path.join(repo_root, "paddle_tpu", "csrc")
     src = os.path.join(csrc, "runtime.cc")
     out = os.path.join(csrc, "libpaddle_tpu_rt.so")
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
-        return
-    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-           src, "-o", out]
-    print("building native runtime:", " ".join(cmd))
-    subprocess.run(cmd, check=True)
+    if not (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+               src, "-o", out]
+        print("building native runtime:", " ".join(cmd))
+        subprocess.run(cmd, check=True)
+    try:
+        _build_capi(repo_root)
+    except Exception as e:  # noqa: BLE001 — serving ABI is optional at runtime
+        print(f"warning: serving C ABI build skipped ({e})", file=sys.stderr)
+
+
+def _build_capi(repo_root):
+    """Serving C ABI (csrc/predictor_capi.cc): embeds CPython as control
+    plane over the StableHLO Predictor — the capi_exp analog.  native.py is
+    loaded standalone (stdlib-only module) so a PEP-517 isolated build env
+    without jax can still `pip install .`."""
+    import importlib.util
+    path = os.path.join(repo_root, "paddle_tpu", "utils", "native.py")
+    spec = importlib.util.spec_from_file_location("_pt_native_build", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    print("built serving C ABI:", mod.build_capi())
 
 
 class BuildPyWithNative(build_py):

@@ -1,0 +1,121 @@
+/* C serving smoke test: load a jit.saved StableHLO model through the
+ * PDT_* C ABI (libpaddle_tpu_capi.so) and run named-IO inference — the
+ * capability the reference ships as capi_exp (pd_inference_api).
+ * Usage: capi_smoke <model_prefix> <n_features>
+ * Prints "OUT <v0> <v1> ..." for the first batch row on success. */
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void* (*fp_void)(void);
+typedef void (*fp_cfg_set)(void*, const char*);
+typedef void* (*fp_pred_create)(void*);
+typedef size_t (*fp_num)(void*);
+typedef const char* (*fp_name)(void*, size_t);
+typedef void* (*fp_handle)(void*, const char*);
+typedef int (*fp_reshape)(void*, const int*, int);
+typedef int (*fp_copy_from)(void*, const float*, size_t);
+typedef int (*fp_run)(void*);
+typedef int (*fp_get_shape)(void*, int*, int, int*);
+typedef int (*fp_copy_to)(void*, float*, size_t);
+typedef int (*fp_init)(const char*);
+typedef const char* (*fp_err)(void);
+
+#define LOAD(sym, type)                                    \
+  type sym = (type)dlsym(lib, #sym);                       \
+  if (!sym) {                                              \
+    fprintf(stderr, "missing symbol %s\n", #sym);          \
+    return 2;                                              \
+  }
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_prefix> <n_features>\n", argv[0]);
+    return 2;
+  }
+  const char* model = argv[1];
+  int nfeat = atoi(argv[2]);
+
+  void* lib = dlopen("libpaddle_tpu_capi.so", RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 2;
+  }
+  LOAD(PDT_Init, fp_init);
+  LOAD(PDT_GetLastError, fp_err);
+  LOAD(PDT_ConfigCreate, fp_void);
+  LOAD(PDT_ConfigSetModel, fp_cfg_set);
+  LOAD(PDT_PredictorCreate, fp_pred_create);
+  LOAD(PDT_PredictorGetInputNum, fp_num);
+  LOAD(PDT_PredictorGetInputName, fp_name);
+  LOAD(PDT_PredictorGetOutputNum, fp_num);
+  LOAD(PDT_PredictorGetOutputName, fp_name);
+  LOAD(PDT_PredictorGetInputHandle, fp_handle);
+  LOAD(PDT_PredictorGetOutputHandle, fp_handle);
+  LOAD(PDT_TensorReshape, fp_reshape);
+  LOAD(PDT_TensorCopyFromCpuFloat, fp_copy_from);
+  LOAD(PDT_PredictorRun, fp_run);
+  LOAD(PDT_TensorGetShape, fp_get_shape);
+  LOAD(PDT_TensorCopyToCpuFloat, fp_copy_to);
+
+  if (PDT_Init(getenv("PDT_PLATFORM") ? getenv("PDT_PLATFORM") : "") != 0) {
+    fprintf(stderr, "init: %s\n", PDT_GetLastError());
+    return 1;
+  }
+  void* cfg = PDT_ConfigCreate();
+  PDT_ConfigSetModel(cfg, model);
+  void* pred = PDT_PredictorCreate(cfg);
+  if (!pred) {
+    fprintf(stderr, "create: %s\n", PDT_GetLastError());
+    return 1;
+  }
+  size_t nin = PDT_PredictorGetInputNum(pred);
+  size_t nout = PDT_PredictorGetOutputNum(pred);
+  if (nin < 1 || nout < 1) {
+    fprintf(stderr, "io counts: %zu in %zu out\n", nin, nout);
+    return 1;
+  }
+  const char* in_name = PDT_PredictorGetInputName(pred, 0);
+  const char* out_name = PDT_PredictorGetOutputName(pred, 0);
+  printf("IO %s -> %s\n", in_name, out_name);
+
+  void* in = PDT_PredictorGetInputHandle(pred, in_name);
+  int batch = 2;
+  int dims[2];
+  dims[0] = batch;
+  dims[1] = nfeat;
+  if (PDT_TensorReshape(in, dims, 2) != 0) {
+    fprintf(stderr, "reshape: %s\n", PDT_GetLastError());
+    return 1;
+  }
+  float* data = (float*)malloc(sizeof(float) * batch * nfeat);
+  for (int i = 0; i < batch * nfeat; ++i) data[i] = 0.01f * i;
+  if (PDT_TensorCopyFromCpuFloat(in, data, (size_t)(batch * nfeat)) != 0) {
+    fprintf(stderr, "copy_from: %s\n", PDT_GetLastError());
+    return 1;
+  }
+  if (PDT_PredictorRun(pred) != 0) {
+    fprintf(stderr, "run: %s\n", PDT_GetLastError());
+    return 1;
+  }
+  void* out = PDT_PredictorGetOutputHandle(pred, out_name);
+  int oshape[8], ondims = 0;
+  if (PDT_TensorGetShape(out, oshape, 8, &ondims) != 0) {
+    fprintf(stderr, "get_shape: %s\n", PDT_GetLastError());
+    return 1;
+  }
+  size_t total = 1;
+  for (int i = 0; i < ondims; ++i) total *= (size_t)oshape[i];
+  float* result = (float*)malloc(sizeof(float) * total);
+  if (PDT_TensorCopyToCpuFloat(out, result, total) != 0) {
+    fprintf(stderr, "copy_to: %s\n", PDT_GetLastError());
+    return 1;
+  }
+  size_t per_row = total / (size_t)batch;
+  printf("OUT");
+  for (size_t i = 0; i < per_row && i < 8; ++i) printf(" %.6f", result[i]);
+  printf("\n");
+  free(result);
+  free(data);
+  return 0;
+}

@@ -1,0 +1,75 @@
+"""Serving C ABI (csrc/predictor_capi.cc) — the capi_exp analog
+(/root/reference/paddle/fluid/inference/capi_exp/pd_config.h): a C program
+dlopens libpaddle_tpu_capi.so, loads a jit.saved StableHLO model, runs
+named-IO inference, and its output must match the in-process Predictor."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "paddle_tpu", "csrc")
+CAPI_SO = os.path.join(CSRC, "libpaddle_tpu_capi.so")
+SMOKE_C = os.path.join(REPO, "tests", "capi_smoke.c")
+
+
+def _build_capi():
+    from paddle_tpu.utils.native import build_capi
+    build_capi()
+
+
+def _build_smoke(tmp_path):
+    exe = str(tmp_path / "capi_smoke")
+    subprocess.run(["gcc", "-O1", SMOKE_C, "-o", exe, "-ldl"], check=True)
+    return exe
+
+
+@pytest.fixture(scope="module")
+def capi_exe(tmp_path_factory):
+    _build_capi()
+    return _build_smoke(tmp_path_factory.mktemp("capi"))
+
+
+def _save_model(tmp_path):
+    P.seed(0)
+    mlp = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    prefix = str(tmp_path / "served")
+    P.jit.save(mlp, prefix,
+               input_spec=[InputSpec([None, 16], "float32", name="feats")])
+    return mlp, prefix
+
+
+def test_c_program_serves_saved_model(capi_exe, tmp_path):
+    mlp, prefix = _save_model(tmp_path)
+    env = dict(os.environ)
+    env["PDT_PLATFORM"] = "cpu"  # deterministic vs the in-process reference
+    env["LD_LIBRARY_PATH"] = CSRC + ":" + env.get("LD_LIBRARY_PATH", "")
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    r = subprocess.run([capi_exe, prefix, "16"], capture_output=True,
+                       text=True, env=env, timeout=300)
+    assert r.returncode == 0, f"stderr: {r.stderr}\nstdout: {r.stdout}"
+    assert "IO feats -> output_0" in r.stdout
+    out_line = [ln for ln in r.stdout.splitlines() if ln.startswith("OUT ")][0]
+    c_vals = np.array([float(v) for v in out_line.split()[1:]])
+
+    # reference: same feed through the in-process Predictor
+    data = (0.01 * np.arange(2 * 16, dtype=np.float32)).reshape(2, 16)
+    ref = np.asarray(mlp(P.to_tensor(data)).numpy())[0, :len(c_vals)]
+    np.testing.assert_allclose(c_vals, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_c_program_reports_missing_model(capi_exe, tmp_path):
+    env = dict(os.environ)
+    env["PDT_PLATFORM"] = "cpu"
+    env["LD_LIBRARY_PATH"] = CSRC + ":" + env.get("LD_LIBRARY_PATH", "")
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    r = subprocess.run([capi_exe, str(tmp_path / "nope"), "16"],
+                       capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 1
+    assert "create:" in r.stderr  # PDT_GetLastError surfaced the failure

@@ -144,3 +144,116 @@ def test_mlp_training_convergence():
     assert final < 0.35 * first, (first, final)
     acc = (np.argmax(model(x).numpy(), -1) == y_np).mean()
     assert acc > 0.9
+
+
+# ---- LBFGS (reference: python/paddle/optimizer/lbfgs.py:309) ----
+
+def _rosenbrock_lbfgs(line_search):
+    from paddle_tpu.optimizer import LBFGS
+    xy = P.Parameter(P.to_tensor([-1.2, 1.0])._value)
+    opt = LBFGS(learning_rate=1.0 if line_search else 0.01,
+                max_iter=20, history_size=10,
+                line_search_fn="strong_wolfe" if line_search else None,
+                parameters=[xy])
+
+    def closure():
+        opt.clear_grad()
+        x, y = xy[0], xy[1]
+        loss = (1 - x) ** 2 + 100 * (y - x * x) ** 2
+        loss.backward()
+        return loss
+
+    for _ in range(15 if line_search else 60):
+        opt.step(closure)
+    return np.asarray(xy.numpy())
+
+
+def test_lbfgs_strong_wolfe_solves_rosenbrock():
+    sol = _rosenbrock_lbfgs(line_search=True)
+    np.testing.assert_allclose(sol, [1.0, 1.0], atol=1e-4)
+
+
+def test_lbfgs_fixed_step_descends():
+    from paddle_tpu.optimizer import LBFGS
+    w = P.Parameter(P.to_tensor([5.0, -3.0])._value)
+    opt = LBFGS(learning_rate=0.5, max_iter=10, parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        loss = (w * w).sum()
+        loss.backward()
+        return loss
+
+    first = float(opt.step(closure).numpy())
+    last = float(opt.step(closure).numpy())
+    assert last < 1e-4 * first
+
+
+def test_lbfgs_matches_reference_quadratic_minimum():
+    # Quadratic f(w) = 0.5 w^T A w - b^T w with SPD A: L-BFGS with strong
+    # Wolfe must hit the closed-form minimum A^-1 b (numeric OpTest pattern).
+    from paddle_tpu.optimizer import LBFGS
+    rng = np.random.RandomState(0)
+    m = rng.randn(4, 4).astype(np.float32)
+    a_np = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+    b_np = rng.randn(4).astype(np.float32)
+    a, b = P.to_tensor(a_np), P.to_tensor(b_np)
+    w = P.Parameter(P.zeros([4])._value)
+    opt = LBFGS(learning_rate=1.0, max_iter=30, history_size=10,
+                line_search_fn="strong_wolfe", parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        loss = 0.5 * (w @ (a @ w)) - (b * w).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    np.testing.assert_allclose(w.numpy(), np.linalg.solve(a_np, b_np),
+                               atol=5e-4)
+
+
+def test_lbfgs_state_dict_roundtrip():
+    from paddle_tpu.optimizer import LBFGS
+    w = P.Parameter(P.to_tensor([3.0])._value)
+    opt = LBFGS(learning_rate=1.0, max_iter=3,
+                line_search_fn="strong_wolfe", parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        loss = (w * w).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    sd = opt.state_dict()
+    opt2 = LBFGS(learning_rate=1.0, max_iter=3,
+                 line_search_fn="strong_wolfe", parameters=[w])
+    opt2.set_state_dict(sd)
+    assert opt2._hist["n_iter"] == opt._hist["n_iter"]
+    assert len(opt2._hist["old_stps"]) == len(opt._hist["old_stps"])
+
+
+def test_lars_trust_ratio_update():
+    # One step of LARS against the hand-computed layer-wise update
+    # (incubate/optimizer/lars_momentum.py:30-41 formula).
+    from paddle_tpu.optimizer import Lars
+    w0 = np.array([3.0, 4.0], np.float32)  # ||w|| = 5
+    w = P.Parameter(P.to_tensor(w0)._value)
+    lr, mu, coeff, wd = 0.1, 0.9, 0.001, 0.0005
+    opt = Lars(learning_rate=lr, momentum=mu, lars_coeff=coeff,
+               lars_weight_decay=wd, parameters=[w])
+    (w * w).sum().backward()  # grad = 2w, ||g|| = 10
+    opt.step()
+    g = 2 * w0
+    w_n, g_n = np.linalg.norm(w0), np.linalg.norm(g)
+    local_lr = lr * coeff * w_n / (g_n + wd * w_n)
+    v = local_lr * (g + wd * w0)
+    np.testing.assert_allclose(w.numpy(), w0 - v, rtol=1e-5)
+
+
+def test_lars_converges():
+    from paddle_tpu.optimizer import Lars
+    losses = _quadratic_step(Lars, learning_rate=1.0, momentum=0.5,
+                             lars_coeff=0.1, lars_weight_decay=0.0)
+    assert losses[-1] < 1e-2 * losses[0]
