@@ -3,7 +3,10 @@
 TPU-first: the default low-precision dtype is bfloat16 (no loss scaling needed),
 but fp16 + dynamic GradScaler is kept for API/behavior parity.
 """
-from .auto_cast import auto_cast, amp_guard, amp_state, white_list, black_list  # noqa: F401
+from .auto_cast import (  # noqa: F401
+    amp_guard, amp_state, auto_cast, black_list, decorate,
+    is_bfloat16_supported, is_float16_supported, white_list,
+)
 from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
 from . import debugging  # noqa: F401
 

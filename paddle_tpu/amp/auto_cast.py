@@ -85,16 +85,65 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
 amp_guard = auto_cast
 
 
-def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
-             master_weight=None, save_dtype=None):
-    """O2 decoration: cast model params to the AMP dtype (master weights are
-    maintained by the optimizer via multi_precision)."""
-    dt = dtypes.convert_dtype(dtype)
-    out_models = models
-    if models is not None:
-        ms = models if isinstance(models, (list, tuple)) else [models]
-        for m in ms:
-            m.astype(dt)
+def is_float16_supported(device=None):
+    """fp16 compute support (reference auto_cast.py is_float16_supported).
+    TPUs natively prefer bf16; fp16 still computes (XLA upcasts), so this
+    reports True on any accelerator backend and True on CPU (XLA CPU
+    emulates)."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the TPU-native low precision — always supported under XLA."""
+    return True
+
+
+def decorate(models, optimizers=None, level="O1", dtype="float16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 pure-low-precision decoration (reference auto_cast.py:755): cast
+    parameters of `models` to `dtype`, except normalization layers (and
+    `excluded_layers`); O1 returns inputs unchanged (autocast at op level
+    handles it).  Optimizer master weights are implicit: the fused update
+    always computes in the state dtype (fp32 states kept by multi_precision
+    semantics)."""
+    from ..core import dtype as dtypes
+
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level not in ("O1", "O2"):
+        raise ValueError(f"level must be O1 or O2, got {level!r}")
+    if level == "O2":
+        import jax.numpy as jnp
+
+        from ..nn.layer.norm import (GroupNorm, InstanceNorm1D, LayerNorm,
+                                     LocalResponseNorm, RMSNorm,
+                                     _BatchNormBase)
+        # base classes: covers BatchNorm/SyncBatchNorm/1D/2D/3D and the
+        # InstanceNorm family — every norm layer stays fp32 like the reference
+        norm_types = (_BatchNormBase, LayerNorm, RMSNorm, GroupNorm,
+                      InstanceNorm1D, LocalResponseNorm)
+        excluded = []
+        if excluded_layers is not None:
+            excluded = ([excluded_layers]
+                        if not isinstance(excluded_layers, (list, tuple))
+                        else list(excluded_layers))
+        ex_types = tuple(e for e in excluded if isinstance(e, type))
+        ex_insts = [e for e in excluded if not isinstance(e, type)]
+        dt = dtypes.convert_dtype(dtype)
+        for m in model_list:
+            for _, sub in m.named_sublayers(include_self=True):
+                if isinstance(sub, norm_types) or isinstance(sub, ex_types) \
+                        or any(sub is e for e in ex_insts):
+                    continue
+                for p in sub._parameters.values():
+                    if p is not None and jnp.issubdtype(p._value.dtype,
+                                                        jnp.floating):
+                        p._value = p._value.astype(dt)
+    if save_dtype is not None:
+        for m in model_list:
+            m._amp_save_dtype = dtypes.convert_dtype(save_dtype)
+    models_out = model_list[0] if single_model else model_list
     if optimizers is None:
-        return out_models
-    return out_models, optimizers
+        return models_out
+    return models_out, optimizers

@@ -1,0 +1,65 @@
+"""Audio datasets (reference python/paddle/audio/datasets/: ESC50, TESS).
+
+Offline environment: like the text datasets, construction from a local copy
+of the corpus directory; the reference's download step is unavailable."""
+from __future__ import annotations
+
+import os
+
+from ..io.dataset import Dataset
+from . import backends
+
+
+class _LocalAudioDataset(Dataset):
+    _NAME = "dataset"
+
+    def __init__(self, data_dir=None, mode="train", feat_type="raw", **kw):
+        self.mode = mode
+        self.feat_type = feat_type
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                f"{type(self).__name__}: the reference downloads the "
+                f"{self._NAME} corpus; this environment has no egress. Pass "
+                "data_dir=<local copy>.")
+        self.files = sorted(
+            os.path.join(r, f) for r, _, fs in os.walk(data_dir)
+            for f in fs if f.lower().endswith(".wav"))
+        self.labels = [self._label_of(f) for f in self.files]
+
+    def _label_of(self, path):
+        return 0
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        wav, _sr = backends.load(self.files[idx])
+        return wav, self.labels[idx]
+
+
+class ESC50(_LocalAudioDataset):
+    """ESC-50 environmental sounds: label = target field of the filename
+    (reference audio/datasets/esc50.py naming: fold-clipid-take-target.wav)."""
+
+    _NAME = "ESC-50"
+
+    def _label_of(self, path):
+        stem = os.path.splitext(os.path.basename(path))[0]
+        parts = stem.split("-")
+        try:
+            return int(parts[-1])
+        except ValueError:
+            return 0
+
+
+class TESS(_LocalAudioDataset):
+    """TESS emotional speech: label = emotion suffix of the filename
+    (reference audio/datasets/tess.py)."""
+
+    _NAME = "TESS"
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def _label_of(self, path):
+        stem = os.path.splitext(os.path.basename(path))[0].lower()
+        emo = stem.rsplit("_", 1)[-1]
+        return self.EMOTIONS.index(emo) if emo in self.EMOTIONS else 0

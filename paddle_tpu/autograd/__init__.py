@@ -3,3 +3,4 @@ from .backward import backward, grad  # noqa: F401
 from .functional import Hessian, Jacobian, hessian, jacobian, jvp, vhp, vjp  # noqa: F401
 from .grad_mode import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401

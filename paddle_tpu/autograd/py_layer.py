@@ -21,14 +21,29 @@ class PyLayerContext:
         self.materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        from .saved_tensors_hooks import current_hooks
+        hooks = current_hooks()
+        if hooks is not None:
+            pack, self._unpack = hooks[0], hooks[1]
+            self._saved = tuple(pack(t) for t in tensors)
+            self._packed = True
+        else:
+            self._saved = tensors
+            self._packed = False
+
+    def _unpacked(self):
+        if getattr(self, "_packed", False):
+            # unpack once, lazily, at first backward access
+            self._saved = tuple(self._unpack(p) for p in self._saved)
+            self._packed = False
+        return self._saved
 
     @property
     def saved_tensor(self):
-        return self._saved
+        return self._unpacked()
 
     def saved_tensors(self):
-        return self._saved
+        return self._unpacked()
 
 
 class _PyLayerNode(GradNode):

@@ -1,0 +1,35 @@
+"""saved_tensors_hooks (reference python/paddle/autograd/saved_tensors_hooks.py:20).
+
+Registers a pack/unpack hook pair applied to tensors saved for backward.
+Scope here: PyLayerContext.save_for_backward — the reference's documented
+hook point — packs through `pack_hook` at save time and unpacks lazily at
+first backward access.  For the implicit tape (non-PyLayer ops), the
+TPU-idiomatic memory lever is rematerialization (`paddle_tpu.distributed.
+fleet.recompute` eagerly, `jax.checkpoint` in compiled steps), which trades
+recompute for memory without a host round-trip; offload hooks on every op
+would serialize HBM↔host DMA into the step and is deliberately not done.
+"""
+from __future__ import annotations
+
+_active = None  # (pack_hook, unpack_hook) | None
+
+
+def current_hooks():
+    return _active
+
+
+class saved_tensors_hooks:  # noqa: N801 — reference-parity name
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        global _active
+        self._prev = _active
+        _active = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        global _active
+        _active = self._prev
+        return False

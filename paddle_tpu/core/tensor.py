@@ -25,7 +25,7 @@ class Tensor:
     __slots__ = (
         "_value", "stop_gradient", "grad", "name", "persistable",
         "_grad_node", "_out_index", "_retain_grads", "_backward_hooks",
-        "__weakref__",
+        "_consumer_nodes", "__weakref__",
     )
 
     # let Tensor win in  np_array * Tensor  reflected ops
@@ -45,6 +45,7 @@ class Tensor:
         self._out_index = 0          # which output of that node
         self._retain_grads = False
         self._backward_hooks = None
+        self._consumer_nodes = None   # weakrefs of GradNodes consuming this
 
     # ---- basic properties ----
     @property
@@ -173,16 +174,25 @@ class Tensor:
                     "a leaf Tensor with stop_gradient=False cannot be the "
                     "target of an inplace op; operate out-of-place or set "
                     "stop_gradient=True first")
-            snap = None
-            for i, inp in enumerate(node.inputs):
-                if inp is self:
-                    if snap is None:
-                        snap = Tensor(self._value,
-                                      stop_gradient=self.stop_gradient)
-                        snap._grad_node = self._grad_node
-                        snap._out_index = self._out_index
-                        snap._backward_hooks = self._backward_hooks
-                    node.inputs[i] = snap
+            snap = Tensor(self._value, stop_gradient=self.stop_gradient)
+            snap._grad_node = self._grad_node
+            snap._out_index = self._out_index
+            snap._backward_hooks = self._backward_hooks
+            # every recorded consumer of the pre-op tensor (including the
+            # node that just produced `out`) captured the PRE-op value in
+            # its vjp closure, so each must keep the pre-op tape linkage too
+            swapped = False
+            for ref in (self._consumer_nodes or ()):
+                consumer = ref()
+                if consumer is None:
+                    continue
+                for i, inp in enumerate(consumer.inputs):
+                    if inp is self:
+                        consumer.inputs[i] = snap
+                        swapped = True
+            if swapped:
+                snap._consumer_nodes = self._consumer_nodes
+                self._consumer_nodes = None
         self._set_value(out._value)
         self._grad_node, self._out_index = out._grad_node, out._out_index
         self.stop_gradient = out.stop_gradient

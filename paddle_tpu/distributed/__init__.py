@@ -23,6 +23,15 @@ from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from . import rpc  # noqa: F401
 from . import auto_tuner  # noqa: F401
+from . import io  # noqa: F401
+from . import launch  # noqa: F401
+from .compat import (  # noqa: F401
+    CountFilterEntry, DistAttr, InMemoryDataset, ProbabilityEntry,
+    QueueDataset, ShowClickEntry, alltoall_single, broadcast_object_list,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, is_available,
+    scatter_object_list, split,
+)
+from .fleet.topology import ParallelMode  # noqa: F401
 
 from ..parallel.mesh import init_mesh, get_mesh  # noqa: F401
 

@@ -1,0 +1,283 @@
+"""Remaining paddle.distributed top-level surface
+(python/paddle/distributed/__init__.py): object collectives,
+alltoall_single, distributed split, gloo rendezvous, PS dataset/entry
+classes, DistAttr."""
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import collective as C
+from .env import get_rank, get_world_size
+
+__all__ = [
+    "scatter_object_list", "broadcast_object_list", "alltoall_single",
+    "split", "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "QueueDataset", "InMemoryDataset", "CountFilterEntry", "ShowClickEntry",
+    "ProbabilityEntry", "is_available", "DistAttr",
+]
+
+
+def is_available() -> bool:
+    """Whether the distributed package can be used (reference
+    parallel.py is_available) — always true: XLA collectives are built in."""
+    return True
+
+
+# ---- object collectives (communication/serialization over tensors) ----
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """In the single-controller global view every rank holds src's objects
+    already (replicated python state); across processes the TCPStore carries
+    the pickle (reference broadcast_object_list semantics)."""
+    world = get_world_size()
+    if world <= 1 or group is not None:
+        return object_list
+    store = C._world_store()
+    if store is None:
+        return object_list
+    rank = get_rank()
+    key = f"bcast_obj/{src}"
+    if rank == src:
+        store.set(key, pickle.dumps(list(object_list)))
+    else:
+        objs = pickle.loads(store.get(key))
+        object_list[:] = objs
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Rank j receives in_object_list[j] (reference scatter_object_list).
+    Global view: pick this process's slot."""
+    rank = get_rank()
+    n = group.nranks if group is not None else max(get_world_size(), 1)
+    if in_object_list is None:
+        in_object_list = []
+    if len(in_object_list) not in (0, n):
+        raise ValueError(
+            f"scatter_object_list needs {n} objects, got {len(in_object_list)}")
+    if in_object_list:
+        out_object_list[:] = [in_object_list[min(rank, len(in_object_list) - 1)]]
+    return out_object_list
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all: dim 0 split across the group, one chunk to
+    each rank (process_group.h AllToAll single form); lowered through the
+    same all_to_all path (ppermute/all_to_all inside shard_map; resharding
+    eagerly)."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "uneven alltoall_single splits: pad to equal chunks (XLA "
+            "all_to_all is equal-split)")
+    axis = C._axis_of(group)
+    if axis is None:
+        out_tensor._set_value(in_tensor._value)
+        return C._Task(out_tensor)
+    n = group.nranks if group is not None else 1
+    from ..ops.manip import concat, split as split_op
+    chunks = split_op(in_tensor, n, axis=0)
+    outs: List[Tensor] = []
+    C.all_to_all(outs, list(chunks), group=group)
+    out = concat(outs, axis=0)
+    out_tensor._set_value(out._value)
+    out_tensor._grad_node = out._grad_node
+    out_tensor._out_index = out._out_index
+    out_tensor.stop_gradient = out.stop_gradient
+    return C._Task(out_tensor)
+
+
+# ---- distributed split (python/paddle/distributed/collective.py split) ----
+
+_split_layers = {}
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=1,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style distributed fc/embedding applied functionally
+    (reference paddle.distributed.split): partitions the weight over the
+    model-parallel mesh axis via the fleet parallel layers.  Layers are
+    cached by `name` so repeated calls reuse the same parameters; an
+    anonymous call creates fresh parameters each time (pass name= for
+    training loops)."""
+    from .fleet.meta_parallel import mp_layers as PL
+
+    key = name or f"_anon_{len(_split_layers)}"
+    layer = _split_layers.get(name) if name else None
+    if layer is None:
+        if operation == "linear":
+            in_f, out_f = size
+            if axis == 1:
+                layer = PL.RowParallelLinear(
+                    in_f, out_f, weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    input_is_parallel=False)
+            else:
+                layer = PL.ColumnParallelLinear(
+                    in_f, out_f, weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    gather_output=gather_out)
+        elif operation == "embedding":
+            num_emb, emb_dim = size
+            layer = PL.VocabParallelEmbedding(num_emb, emb_dim,
+                                              weight_attr=weight_attr)
+        else:
+            raise ValueError(f"unknown split operation {operation!r}")
+        _split_layers[key] = layer
+    return layer(x)
+
+
+# ---- gloo rendezvous (reference parallel.py gloo_init_parallel_env):
+# CPU-side barrier service — here the TCPStore plays gloo's role ----
+
+_gloo_store = None
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    global _gloo_store
+    from .store import TCPStore
+    host, port = server_endpoint.rsplit(":", 1)
+    _gloo_store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                           world_size=rank_num)
+
+
+def gloo_barrier():
+    if _gloo_store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    n = _gloo_store.add("gloo/barrier", 1)
+    import time
+    world = get_world_size()
+    deadline = time.time() + 300
+    while _gloo_store.add("gloo/barrier", 0) % max(world, 1) != 0 \
+            and time.time() < deadline:
+        time.sleep(0.005)
+
+
+def gloo_release():
+    global _gloo_store
+    if _gloo_store is not None:
+        try:
+            _gloo_store.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        _gloo_store = None
+
+
+# ---- PS-style datasets (reference distributed/fleet/dataset/):
+# file-list datasets with a parse pipeline, iterated host-side ----
+
+class InMemoryDataset:
+    """Load a filelist into host memory, optionally shuffle, iterate parsed
+    samples (reference InMemoryDataset minus the C++ channel machinery —
+    the TPU input path feeds a jax host buffer, not a PS channel)."""
+
+    def __init__(self):
+        self._files: List[str] = []
+        self._records: List = []
+        self._parse = None
+        self.batch_size = 1
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command=None,
+             input_type=0, fs_name="", fs_ugi="", **kw):
+        self.batch_size = batch_size
+        if callable(pipe_command):
+            self._parse = pipe_command
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        self._records = []
+        for path in self._files:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    self._records.append(
+                        self._parse(line) if self._parse else line)
+
+    def local_shuffle(self):
+        np.random.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=1):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def release_memory(self):
+        self._records = []
+
+    def __iter__(self):
+        return iter(self._records)
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant: iterates files lazily instead of materializing
+    (reference QueueDataset)."""
+
+    def load_into_memory(self):
+        pass  # streaming — nothing to materialize
+
+    def __iter__(self):
+        for path in self._files:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    yield self._parse(line) if self._parse else line
+
+
+# ---- PS sparse-table entry configs (reference entry_attr.py) ----
+
+class _Entry:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(_Entry):
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class CountFilterEntry(_Entry):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = count_filter
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ShowClickEntry(_Entry):
+    def __init__(self, show_name, click_name):
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+# ---- DistAttr (auto_parallel interface.py DistAttr) ----
+
+class DistAttr:
+    """Tensor distributed attributes: process_mesh + per-dim sharding specs
+    (reference auto_parallel/api.py DistAttr); consumed by shard_tensor."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")

@@ -9,6 +9,10 @@ from .hybrid_optimizer import HybridParallelOptimizer  # noqa: F401
 from .role_maker import (  # noqa: F401
     PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker,
 )
+from .util import (  # noqa: F401
+    DataGenerator, Fleet, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+    UtilBase,
+)
 from .moe import MoELayer, NaiveGate, GShardGate, SwitchGate  # noqa: F401
 from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: F401
 from .topology import (  # noqa: F401

@@ -1,0 +1,178 @@
+"""Fleet utility surface (reference fleet/base/util_factory.py UtilBase,
+fleet/data_generator/data_generator.py, fleet/fleet.py Fleet class)."""
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+
+class UtilBase:
+    """Cluster utilities bound to the collective runtime
+    (util_factory.py:UtilBase): reductions/barrier over python objects plus
+    filesystem helpers."""
+
+    def __init__(self):
+        self.role_maker = None
+        self.fs_client = None
+
+    def _set_role_maker(self, role_maker):
+        self.role_maker = role_maker
+
+    # collective object helpers — single-controller: world of 1 process per
+    # controller; across controllers the TCPStore carries the values
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        arr = np.asarray(input)
+        from ..collective import _world_store
+        import jax
+        if jax.process_count() <= 1:
+            return arr
+        st = _world_store()
+        if st is None:
+            return arr
+        import pickle
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        st.set(f"util/ar/{rank}", pickle.dumps(arr))
+        world = jax.process_count()
+        vals = []
+        for r in range(world):
+            vals.append(pickle.loads(st.get(f"util/ar/{r}")))
+        stack = np.stack(vals)
+        return {"sum": stack.sum(0), "max": stack.max(0),
+                "min": stack.min(0)}[mode]
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier
+        barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        import jax
+        if jax.process_count() <= 1:
+            return [input]
+        return list(self.all_reduce_objects(input))
+
+    def all_reduce_objects(self, obj):
+        import pickle
+
+        import jax
+
+        from ..collective import _world_store
+        st = _world_store()
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        st.set(f"util/ag/{rank}", pickle.dumps(obj))
+        return [pickle.loads(st.get(f"util/ag/{r}"))
+                for r in range(jax.process_count())]
+
+    def get_file_shard(self, files: List[str]) -> List[str]:
+        """This worker's shard of a file list (util_factory.py
+        get_file_shard)."""
+        import jax
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        world = max(jax.process_count(),
+                    int(os.environ.get("PADDLE_TRAINERS_NUM", 1)))
+        n = len(files)
+        base, rem = divmod(n, world)
+        start = rank * base + min(rank, rem)
+        end = start + base + (1 if rank < rem else 0)
+        return list(files)[start:end]
+
+    def print_on_rank(self, message, rank_id=0):
+        if int(os.environ.get("PADDLE_TRAINER_ID", 0)) == rank_id:
+            print(message)
+
+
+class DataGenerator:
+    """Line -> samples pipeline base (data_generator.py:28): subclasses
+    override generate_sample(line); run_from_stdin streams the datafeed
+    text protocol."""
+
+    def __init__(self):
+        self.batch_size_ = 32
+        self._proto_info = None
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass DataGenerator and implement generate_sample(line)")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        batch = []
+        for line in sys.stdin:
+            for sample in self.generate_sample(line)():
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    for s in self.generate_batch(batch)():
+                        sys.stdout.write(self._gen_str(s))
+                    batch = []
+        if batch:
+            for s in self.generate_batch(batch)():
+                sys.stdout.write(self._gen_str(s))
+
+    def run_from_memory(self):
+        out = []
+        batch = []
+        for sample in self.generate_sample(None)():
+            batch.append(sample)
+            if len(batch) == self.batch_size_:
+                for s in self.generate_batch(batch)():
+                    out.append(self._gen_str(s))
+                batch = []
+        if batch:
+            for s in self.generate_batch(batch)():
+                out.append(self._gen_str(s))
+        return out
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Datafeed text protocol: `[(name, [id, ...]), ...]` ->
+    "len id..." per slot (data_generator.py:233)."""
+
+    def _gen_str(self, line):
+        parts = []
+        for _name, ids in line:
+            parts.append(str(len(ids)))
+            parts += [str(i) for i in ids]
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        parts = []
+        for _name, ids in line:
+            parts.append(str(len(ids)))
+            parts += [str(i) for i in ids]
+        return " ".join(parts) + "\n"
+
+
+class Fleet:
+    """Class form of the fleet module API (fleet/fleet.py:99): the module
+    functions are the single instance's bound methods, so both
+    `paddle.distributed.fleet.init(...)` and `Fleet().init(...)` work."""
+
+    def __init__(self):
+        self.util = UtilBase()
+
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level="INFO"):
+        from . import fleet as _f
+        _f.init(role_maker=role_maker, is_collective=is_collective,
+                strategy=strategy, log_level=log_level)
+        if role_maker is not None:
+            self.util._set_role_maker(role_maker)
+        return self
+
+    def __getattr__(self, item):
+        from . import fleet as _f
+        return getattr(_f, item)

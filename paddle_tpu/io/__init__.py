@@ -7,4 +7,6 @@ from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler, BatchSampler,
     DistributedBatchSampler,
 )
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader, WorkerInfo, default_collate_fn, get_worker_info,
+)

@@ -154,8 +154,33 @@ def _make_blocking_queue(depth):
     return _PyOutQueue(depth)
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, seed):
+class WorkerInfo:
+    """Worker-process introspection (reference io/dataloader/worker.py:158):
+    id / num_workers / seed / dataset, available inside dataset code via
+    get_worker_info()."""
+
+    def __init__(self, id, num_workers, seed, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """In a DataLoader worker process: that worker's WorkerInfo; in the main
+    process: None (reference worker.py:79)."""
+    return _worker_info
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, seed,
+                 num_workers=0):
+    global _worker_info
     np.random.seed((seed + worker_id) % (2 ** 31))
+    _worker_info = WorkerInfo(worker_id, num_workers, seed + worker_id,
+                              dataset)
     while True:
         item = index_queue.get()
         if item is None:
@@ -233,7 +258,8 @@ class DataLoader:
         for wid in range(self.num_workers):
             iq = ctx.Queue()
             w = ctx.Process(target=_worker_loop,
-                            args=(self.dataset, iq, data_queue, collate, wid, seed),
+                            args=(self.dataset, iq, data_queue, collate, wid,
+                                  seed, self.num_workers),
                             daemon=True)
             w.start()
             index_queues.append(iq)

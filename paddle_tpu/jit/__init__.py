@@ -1,3 +1,7 @@
 """paddle_tpu.jit — trace/compile/save/load (analog of python/paddle/jit/)."""
-from .api import to_static, not_to_static, ignore_module, InputSpec, StaticFunction  # noqa: F401
+from .api import (  # noqa: F401
+    InputSpec, StaticFunction, enable_to_static, ignore_module, not_to_static,
+    set_code_level, set_verbosity, to_static,
+)
+from . import api  # noqa: F401
 from .save_load import save, load, TranslatedLayer  # noqa: F401

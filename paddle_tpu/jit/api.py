@@ -75,6 +75,14 @@ class StaticFunction:
     def _call_impl(self, bound_self, *args, **kwargs):
         layer = self._layer if self._layer is not None else (
             bound_self if isinstance(bound_self, Layer) else None)
+        if not _to_static_enabled:
+            # global escape hatch (enable_to_static(False)): run eagerly,
+            # before any cache-key work
+            if layer is not None:
+                return self._fn(layer, *args, **kwargs)
+            if bound_self is not None:
+                return self._fn(bound_self, *args, **kwargs)
+            return self._fn(*args, **kwargs)
         names, param_tensors = [], []
         if layer is not None:
             for n, p in layer.named_parameters():
@@ -101,8 +109,21 @@ class StaticFunction:
                tuple((tuple(t.shape), np.dtype(t.dtype).name) for t in tensor_args))
         entry = self._cache.get(key)
         if entry is None:
+            if _verbosity > 0:
+                import sys
+                print(f"[to_static] compiling new signature {key[4]}",
+                      file=sys.stderr)
             entry = self._build(layer, names, param_tensors, flat_in, in_treedef,
                                 tensor_idx, bound_self)
+            if _code_level > 0:
+                import sys
+                jitted0 = entry[0]
+                vals = [t._value for t in param_tensors] +                     [t._value for t in tensor_args]
+                try:
+                    print(jax.make_jaxpr(lambda *a: jitted0(*a))(*vals),
+                          file=sys.stderr)
+                except Exception:  # noqa: BLE001 — dump is best-effort
+                    pass
             self._cache[key] = entry
         jitted, out_cell, n_params = entry
 
@@ -201,3 +222,37 @@ def not_to_static(fn):
 
 def ignore_module(modules):
     return None
+
+
+# ---- global to_static switch + dy2static logging (reference jit/api.py
+# enable_to_static, jit/dy2static/logging_utils.py set_verbosity:
+# set_code_level) ----
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable: bool):
+    """Globally enable/disable to_static compilation: when disabled,
+    StaticFunction runs the original eager function (debug escape hatch,
+    reference ProgramTranslator.enable)."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """Dy2static transform logging verbosity. At >0, compile events (cache
+    miss, jaxpr build) print to stderr."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Print the transformed computation at compile time: any level > 0 dumps
+    the traced jaxpr for each newly-compiled signature (the trace-based
+    analog of dumping AST-transformed source)."""
+    global _code_level
+    _code_level = int(level)

@@ -1,0 +1,15 @@
+"""paddle.linalg namespace module (python/paddle/linalg.py): re-exports the
+decomposition/solve family from ops.linalg so `import paddle_tpu.linalg`
+works like the reference's `import paddle.linalg`."""
+from .ops.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
+    eigvalsh, inv, lstsq, lu, lu_unpack, matrix_power, matrix_rank, multi_dot,
+    norm, pca_lowrank, pinv, qr, slogdet, solve, svd, triangular_solve,
+)
+
+__all__ = [
+    "cholesky", "norm", "cond", "cov", "corrcoef", "inv", "eig", "eigvals",
+    "multi_dot", "matrix_rank", "svd", "qr", "pca_lowrank", "lu", "lu_unpack",
+    "matrix_power", "det", "slogdet", "eigh", "eigvalsh", "pinv", "solve",
+    "cholesky_solve", "triangular_solve", "lstsq",
+]

@@ -43,4 +43,11 @@ from .layer.transformer import (  # noqa: F401
 from .layer.rnn import (  # noqa: F401
     LSTM, GRU, SimpleRNN, LSTMCell, GRUCell,
 )
+from .layer.extras import (  # noqa: F401
+    RNN, BeamSearchDecoder, BiRNN, GaussianNLLLoss, HSigmoidLoss,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, PairwiseDistance, PoissonNLLLoss, RNNCellBase, RNNTLoss,
+    SimpleRNNCell, SoftMarginLoss, Softmax2D, TripletMarginWithDistanceLoss,
+    Unflatten, dynamic_decode,
+)
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

@@ -1,0 +1,558 @@
+"""Remaining nn.functional surface (analog of the corresponding entries in
+python/paddle/nn/functional/: distance.py, activation.py inplace variants,
+common.py, loss.py, vision.py, input.py).  All pure-jnp compositions routed
+through dispatch.apply so AMP/profiler/static hooks see them."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply
+
+__all__ = [
+    "pairwise_distance", "elu_", "hardtanh_", "leaky_relu_", "softmax_",
+    "tanh_", "thresholded_relu_", "gumbel_softmax", "diag_embed",
+    "sequence_mask", "one_hot", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "dice_loss", "poisson_nll_loss", "npair_loss", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "gaussian_nll_loss", "hsigmoid_loss",
+    "margin_cross_entropy", "rnnt_loss", "affine_grid", "grid_sample",
+    "gather_tree", "temporal_shift", "sparse_attention",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+# ---------------- distance ----------------
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return apply(f, x, y, op_name="pairwise_distance")
+
+
+# ---------------- inplace activations ----------------
+
+def _inplace(fn_name, x, *args, **kwargs):
+    from . import activation as act_mod
+    out = getattr(act_mod, fn_name)(x, *args, **kwargs)
+    return x._inplace_assign(out)
+
+
+def elu_(x, alpha=1.0, name=None):
+    return _inplace("elu", x, alpha)
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return _inplace("hardtanh", x, min, max)
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    return _inplace("leaky_relu", x, negative_slope)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return _inplace("softmax", x, axis)
+
+
+def tanh_(x, name=None):
+    from ...ops import math as om
+    return x._inplace_assign(om.tanh(x))
+
+
+def thresholded_relu_(x, threshold=1.0, name=None):
+    return _inplace("thresholded_relu", x, threshold)
+
+
+# ---------------- sampling / shaping ----------------
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    """Gumbel-softmax with optional straight-through hard sampling
+    (functional/activation.py gumbel_softmax semantics)."""
+    from ...core.generator import default_generator
+    key = default_generator().next_key()
+
+    def f(logits):
+        u = jax.random.uniform(key, logits.shape, jnp.float32,
+                               minval=1e-20, maxval=1.0)
+        g = -jnp.log(-jnp.log(u))
+        y = jax.nn.softmax((logits + g.astype(logits.dtype)) / temperature,
+                           axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(
+                y_hard, idx, jnp.ones_like(idx, y.dtype), axis=axis,
+                inplace=False)
+            # straight-through: hard forward, soft gradient
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return apply(f, x, op_name="gumbel_softmax")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    from ...ops import breadth
+    return breadth.diag_embed(x, offset, dim1, dim2)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    def f(lengths):
+        if maxlen is None:
+            if isinstance(lengths, jax.core.Tracer):
+                raise ValueError(
+                    "sequence_mask: maxlen=None needs the concrete max "
+                    "length, which is data-dependent and unavailable under "
+                    "jit/to_static — pass an explicit static maxlen")
+            m = int(jnp.max(lengths))
+        else:
+            m = maxlen
+        ar = jnp.arange(m, dtype=lengths.dtype)
+        return (ar[None, :] < lengths[..., None]).astype(dtype)
+    return apply(f, x, op_name="sequence_mask")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32),
+                 x, op_name="one_hot")
+
+
+# ---------------- max unpool ----------------
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                spatial, data_format, op_name):
+    """Scatter pooled values back to pre-pool positions; `indices` are the
+    flat within-plane argmax positions max_poolNd(return_mask=True) records
+    (functional/pooling.py unpool semantics)."""
+    if isinstance(kernel_size, int):
+        kernel_size = [kernel_size] * spatial
+    if stride is None:
+        stride = kernel_size
+    if isinstance(stride, int):
+        stride = [stride] * spatial
+    if isinstance(padding, int):
+        padding = [padding] * spatial
+
+    def f(v, idx):
+        lead = v.shape[:-spatial]
+        pooled_sp = v.shape[-spatial:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in output_size[-spatial:])
+        else:
+            out_sp = tuple(
+                (pooled_sp[i] - 1) * stride[i] - 2 * padding[i]
+                + kernel_size[i] for i in range(spatial))
+        plane = 1
+        for s in out_sp:
+            plane *= s
+        nplanes = 1
+        for s in lead:
+            nplanes *= s
+        vf = v.reshape(nplanes, -1)
+        idxf = idx.reshape(nplanes, -1).astype(jnp.int32)
+        out = jnp.zeros((nplanes, plane), v.dtype)
+        rows = jnp.arange(nplanes)[:, None]
+        out = out.at[rows, idxf].set(vf)
+        return out.reshape(*lead, *out_sp)
+    return apply(f, x, indices, op_name=op_name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       1, data_format, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       2, data_format, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       3, data_format, "max_unpool3d")
+
+
+# ---------------- losses ----------------
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(pred, lab):
+        lab_oh = jax.nn.one_hot(jnp.squeeze(lab, -1), pred.shape[-1],
+                                dtype=pred.dtype)
+        red = tuple(range(1, pred.ndim))
+        inter = jnp.sum(pred * lab_oh, axis=red)
+        union = jnp.sum(pred, axis=red) + jnp.sum(lab_oh, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+    return apply(f, input, label, op_name="dice_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(pred, lab):
+        if log_input:
+            loss = jnp.exp(pred) - lab * pred
+        else:
+            loss = pred - lab * jnp.log(pred + epsilon)
+        if full:
+            stirling = lab * jnp.log(lab) - lab + 0.5 * jnp.log(
+                2 * math.pi * lab)
+            loss = loss + jnp.where(lab > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply(f, input, label, op_name="poisson_nll_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (loss.py npair_loss): CE over anchor·positiveᵀ similarity
+    + l2 on the embeddings."""
+    def f(anc, pos, lab):
+        reg = jnp.mean(jnp.sum(jnp.square(anc), -1)) \
+            + jnp.mean(jnp.sum(jnp.square(pos), -1))
+        sim = anc @ pos.T
+        tgt = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+        tgt = tgt / jnp.sum(tgt, -1, keepdims=True)
+        ce = jnp.mean(jnp.sum(-tgt * jax.nn.log_softmax(sim, -1), -1))
+        return ce + l2_reg * reg * 0.25
+    return apply(f, anchor, positive, labels, op_name="npair_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(pred, lab):
+        return _reduce(jnp.log1p(jnp.exp(-lab.astype(pred.dtype) * pred)),
+                       reduction)
+    return apply(f, input, label, op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    args = (input, label) + ((weight,) if weight is not None else ())
+
+    def f(pred, lab, *w):
+        lab = lab.astype(pred.dtype)
+        loss = -(lab * jax.nn.log_sigmoid(pred)
+                 + (1 - lab) * jax.nn.log_sigmoid(-pred))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, -1), reduction)
+    return apply(f, *args, op_name="multi_label_soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    args = (input, label) + ((weight,) if weight is not None else ())
+
+    def f(pred, lab, *w):
+        n, c = pred.shape
+        tgt = jnp.take_along_axis(pred, lab[:, None], 1)
+        m = jnp.maximum(0.0, margin - tgt + pred) ** p
+        if w:
+            m = m * w[0][lab][:, None]
+        mask = 1.0 - jax.nn.one_hot(lab, c, dtype=pred.dtype)
+        return _reduce(jnp.sum(m * mask, -1) / c, reduction)
+    return apply(f, *args, op_name="multi_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    dist = distance_function or (
+        lambda a, b: pairwise_distance(a, b))
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_pn = dist(positive, negative)
+        from ...ops import math as om
+        d_neg = om.minimum(d_neg, d_pn)
+
+    def f(dp, dn):
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply(f, d_pos, d_neg, op_name="triplet_margin_with_distance_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(pred, lab, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(pred - lab) / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+    return apply(f, input, label, variance, op_name="gaussian_nll_loss")
+
+
+def _hsigmoid_paths(num_classes):
+    """Complete-binary-tree paths for the default hsigmoid tree: leaves are
+    heap nodes [num_classes, 2*num_classes); internal nodes 1..num_classes-1
+    map to rows 0..num_classes-2 of `weight`.  Returns (path_table,
+    path_code, lengths) as static numpy arrays padded to max depth."""
+    import numpy as np
+    depth = max(1, math.ceil(math.log2(max(num_classes, 2))) + 1)
+    table = np.zeros((num_classes, depth), np.int64)
+    code = np.zeros((num_classes, depth), np.int64)
+    length = np.zeros((num_classes,), np.int64)
+    for leaf in range(num_classes):
+        n = leaf + num_classes
+        path = []
+        bits = []
+        while n > 1:
+            bits.append(n & 1)
+            n >>= 1
+            path.append(n - 1)  # internal heap node -> weight row
+        path.reverse()
+        bits.reverse()
+        length[leaf] = len(path)
+        table[leaf, :len(path)] = path
+        code[leaf, :len(bits)] = bits
+    return table, code, length
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (loss.py hsigmoid_loss): walk the class
+    tree, one sigmoid per edge.  Default tree = complete binary tree; custom
+    trees via path_table/path_code (padded, 0-length tail ignored)."""
+    if path_table is None or path_code is None:
+        import numpy as np
+        table_np, code_np, len_np = _hsigmoid_paths(num_classes)
+        path_table = Tensor(jnp.asarray(table_np))
+        path_code = Tensor(jnp.asarray(code_np))
+        lengths = jnp.asarray(len_np)
+    else:
+        lengths = None
+    args = (input, label, weight, path_table, path_code) + (
+        (bias,) if bias is not None else ())
+
+    def f(x, lab, w, table, codes, *b):
+        t = table[lab]          # (N, D) weight rows along the path
+        c = codes[lab]          # (N, D) branch bits
+        if lengths is not None:
+            valid = jnp.arange(t.shape[1])[None, :] < lengths[lab][:, None]
+        else:
+            # padded custom paths: a row repeated at its own position-0 id
+            # with code 0 contributes log-sigmoid(±z); mask pad rows = -1
+            valid = t >= 0
+            t = jnp.maximum(t, 0)
+        z = jnp.einsum("nf,nkf->nk", x, w[t])  # dot with each path row
+        if b:
+            z = z + b[0][t]
+        # edge label: code bit 1 -> sigmoid(z), 0 -> sigmoid(-z)
+        sign = 1.0 - 2.0 * c.astype(z.dtype)
+        ll = jax.nn.log_sigmoid(sign * z)
+        return -jnp.sum(jnp.where(valid, ll, 0.0), axis=-1)
+    return apply(f, *args, op_name="hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """Combined-margin softmax (loss.py margin_cross_entropy: arcface
+    cos(m1·θ + m2) − m3 on the target logit, then scaled CE)."""
+    def f(lg, lab):
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(jnp.take_along_axis(cos, lab[:, None], 1))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        out = jnp.put_along_axis(cos, lab[:, None],
+                                 target.astype(cos.dtype), 1, inplace=False)
+        out = out * scale
+        logp = jax.nn.log_softmax(out, -1)
+        loss = -jnp.take_along_axis(logp, lab[:, None], 1)[:, 0]
+        loss = _reduce(loss, reduction)
+        return (loss, jnp.exp(logp)) if return_softmax else loss
+    return apply(f, logits, label, op_name="margin_cross_entropy")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-transducer loss (loss.py rnnt_loss): exact forward-variable DP,
+    alpha over (T, U+1) per batch — lax.scan over time keeps the whole DP in
+    one XLA program (vs the reference's warprnnt CUDA kernel)."""
+    def f(acts, labels, t_lens, u_lens):
+        if acts.ndim == 3:  # single sample convenience
+            acts = acts[None]
+            labels = labels[None]
+            t_lens = t_lens[None]
+            u_lens = u_lens[None]
+        logp = jax.nn.log_softmax(acts, -1)          # (B, T, U1, V)
+        B, T, U1, V = logp.shape
+        neg_inf = jnp.asarray(-1e30, logp.dtype)
+        blank_lp = logp[..., blank]                  # (B, T, U1)
+        lab_idx = jnp.minimum(labels, V - 1)         # (B, U)
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :-1, :], lab_idx[:, None, :, None], -1)[..., 0]
+        emit_lp = jnp.pad(emit_lp, ((0, 0), (0, 0), (0, 1)),
+                          constant_values=0.0)       # (B, T, U1)
+        if fastemit_lambda:
+            # FastEmit (arXiv:2010.11148) as implemented in practice: scale
+            # the emit-arc gradient by (1+λ) while leaving the forward loss
+            # unchanged — exactly expressed as a stop_gradient decomposition
+            emit_lp = emit_lp + fastemit_lambda * (
+                emit_lp - jax.lax.stop_gradient(emit_lp))
+
+        u_range = jnp.arange(U1)
+
+        def u_scan(alpha_t_prev_row, t):
+            # alpha[t, u] = logaddexp(alpha[t-1, u] + blank[t-1, u],
+            #                         alpha[t, u-1] + emit[t, u-1])
+            from_blank = jnp.where(
+                t > 0,
+                alpha_t_prev_row + jnp.where(
+                    t > 0, blank_lp[:, jnp.maximum(t - 1, 0), :], neg_inf),
+                jnp.where(u_range[None, :] == 0, 0.0, neg_inf))
+
+            def inner(carry, u):
+                prev = carry  # alpha[t, u-1] per batch
+                horiz = jnp.where(
+                    u > 0, prev + emit_lp[:, t, jnp.maximum(u - 1, 0)],
+                    neg_inf)
+                cur = jnp.where(
+                    (t == 0) & (u == 0), 0.0,
+                    jnp.logaddexp(from_blank[:, u], horiz))
+                return cur, cur
+            _, cols = jax.lax.scan(inner, jnp.full((B,), neg_inf), u_range)
+            row = cols.T  # (B, U1)
+            return row, row
+
+        _, alphas = jax.lax.scan(u_scan, jnp.full((B, U1), neg_inf),
+                                 jnp.arange(T))      # (T, B, U1)
+        alphas = alphas.transpose(1, 0, 2)           # (B, T, U1)
+        bi = jnp.arange(B)
+        tl = jnp.maximum(t_lens - 1, 0)
+        ul = u_lens
+        ll = alphas[bi, tl, ul] + blank_lp[bi, tl, ul]
+        loss = -ll
+        return _reduce(loss, reduction)
+    return apply(f, input, label, input_lengths, label_lengths,
+                 op_name="rnnt_loss")
+
+
+# ---------------- vision ----------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid (vision.py affine_grid), NCHW out_shape."""
+    def f(th):
+        n, _, h, w = [int(s) for s in out_shape]
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # (H, W, 3)
+        return jnp.einsum("hwk,nck->nhwc", base.astype(th.dtype), th)
+    return apply(f, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Bilinear/nearest sampling of NCHW `x` at normalized `grid` (N,H,W,2)
+    locations (vision.py grid_sample); gather+lerp lowers to fused XLA."""
+    def f(img, g):
+        n, c, h, w = img.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, h - 1)
+            ixc = jnp.clip(ix, 0, w - 1)
+            vals = img[jnp.arange(n)[:, None, None], :, iyc, ixc]  # N,Ho,Wo,C
+            if padding_mode == "zeros":
+                inside = ((iy >= 0) & (iy <= h - 1) & (ix >= 0)
+                          & (ix <= w - 1))
+                vals = jnp.where(inside[..., None], vals, 0.0)
+            return vals
+
+        if mode == "nearest":
+            out = gather(jnp.round(fy).astype(jnp.int32),
+                         jnp.round(fx).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            wx = (fx - x0)[..., None]
+            wy = (fy - y0)[..., None]
+            out = (gather(y0, x0) * (1 - wx) * (1 - wy)
+                   + gather(y0, x0 + 1) * wx * (1 - wy)
+                   + gather(y0 + 1, x0) * (1 - wx) * wy
+                   + gather(y0 + 1, x0 + 1) * wx * wy)
+        return out.transpose(0, 3, 1, 2)
+    return apply(f, x, grid, op_name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """Shift a channel slice one step along the segment (time) axis
+    (vision.py temporal_shift, the TSM op)."""
+    def f(v):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        back = jnp.pad(v5[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+        fwd = jnp.pad(v5[:, :-1, fold:2 * fold],
+                      ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+        keep = v5[:, :, 2 * fold:]
+        return jnp.concatenate([back, fwd, keep], 2).reshape(nt, c, h, w)
+    return apply(f, x, op_name="temporal_shift")
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (input.py gather_tree): follow parent pointers
+    from the last step to recover full beams.  lax.scan runs the walk
+    in-program, (T, B, W) layout."""
+    def f(idv, par):
+        t = idv.shape[0]
+        b = jnp.arange(idv.shape[1])[:, None]
+        beams = jnp.arange(idv.shape[2])[None, :]
+
+        def back(carry, step):
+            beam_at = carry  # (B, W) beam index followed at step+1
+            tok = idv[step, b, beam_at]
+            parent = par[step, b, beam_at]
+            return parent, tok
+
+        _, toks = jax.lax.scan(back, jnp.broadcast_to(
+            beams, idv.shape[1:]), jnp.arange(t - 1, -1, -1))
+        return jnp.flip(toks, 0)
+    return apply(f, ids, parents, op_name="gather_tree")
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block/CSR-pattern attention (the reference's GPU-only sparse_attention
+    op): same math, computed dense-with-mask — on TPU the masked softmax +
+    matmul fuse into MXU-shaped kernels, and the CSR pattern only zeroes
+    scores.  Layouts: q/k/v (B, H, L, D), offset (B, H, L+1), columns
+    (B, H, nnz)."""
+    def f(q, k, v, offs, cols):
+        b, h, L, d = q.shape
+        scores = jnp.einsum("bhld,bhmd->bhlm", q, k) / math.sqrt(d)
+        # CSR -> dense mask: row r keeps columns cols[offs[r]:offs[r+1]]
+        nnz = cols.shape[-1]
+        ar = jnp.arange(nnz)
+        row_of = jnp.sum((ar[None, None, None, :]
+                          >= offs[..., 1:, None]).astype(jnp.int32), -2)
+        mask = jnp.zeros((b, h, L, L), bool)
+        bi = jnp.arange(b)[:, None, None]
+        hi = jnp.arange(h)[None, :, None]
+        mask = mask.at[bi, hi, row_of, cols].set(True)
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, -1)
+        probs = jnp.where(mask, probs, 0.0)
+        return jnp.einsum("bhlm,bhmd->bhld", probs, v)
+    return apply(f, query, key, value, sparse_csr_offset, sparse_csr_columns,
+                 op_name="sparse_attention")

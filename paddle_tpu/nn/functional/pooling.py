@@ -30,6 +30,74 @@ def _pad_cfg(padding, n):
     return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
 
 
+def _ceil_adjust(pad, spatial, ks, st):
+    """Extend right padding so floor-mode window math yields ceil-mode output
+    sizes (reference ceil_mode=True semantics). Returns a new pad list."""
+    out = []
+    for d in range(len(ks)):
+        lo, hi = pad[d]
+        L = spatial[d] + lo + hi
+        ceil_n = -(-(L - ks[d]) // st[d]) + 1
+        floor_n = (L - ks[d]) // st[d] + 1
+        if ceil_n > floor_n:
+            hi += (ceil_n - 1) * st[d] + ks[d] - L
+        out.append((lo, hi))
+    return out
+
+
+def _max_pool_with_mask(x, kernel, stride, padding, n, name, ceil_mode=False):
+    """Max pool returning (values, flat within-(N,C)-plane argmax indices) —
+    the mask max_unpoolNd consumes (reference max_poolNd return_mask=True).
+    Window patches come from conv_general_dilated_patches, so the argmax is
+    one vectorized reduction, not a Python window loop."""
+    ks = _tuple(kernel, n)
+    st = _tuple(stride if stride is not None else kernel, n)
+    pad = _pad_cfg(padding, n)
+
+    def f(v):
+        neg = -jnp.inf if np.issubdtype(v.dtype, np.floating) \
+            else np.iinfo(v.dtype).min
+        spatial = v.shape[2:]
+        eff_pad = _ceil_adjust(pad, spatial, ks, st) if ceil_mode else list(pad)
+        patches = jax.lax.conv_general_dilated_patches(
+            v, ks, st, eff_pad, precision=None)
+        # (N, C*prod(ks), *out_spatial), channel-major (C, k1..kn)
+        N, _, *out_sp = patches.shape
+        C = v.shape[1]
+        kprod = int(np.prod(ks))
+        patches = patches.reshape(N, C, kprod, *out_sp)
+        # padding contributed zeros, not -inf: rebuild the validity mask so
+        # argmax never selects a padded slot
+        in_idx = []
+        for d in range(n):
+            starts = jnp.arange(out_sp[d]) * st[d] - (eff_pad[d][0]
+                                                      if ceil_mode else pad[d][0])
+            offs = jnp.arange(ks[d])
+            idxd = starts[:, None] + offs[None, :]  # (out_d, ks_d)
+            in_idx.append(idxd)
+        # flat window index -> per-dim coords
+        coords = np.stack(np.unravel_index(np.arange(kprod), ks), 0)  # (n,kprod)
+        valid = jnp.ones((kprod, *out_sp), bool)
+        flat_in = jnp.zeros((kprod, *out_sp), jnp.int32)
+        mult = 1
+        for d in range(n - 1, -1, -1):
+            idxd = in_idx[d][:, coords[d]]            # (out_d, kprod)
+            shape = [kprod] + [1] * n
+            shape[1 + d] = out_sp[d]
+            idx_b = jnp.transpose(idxd).reshape(shape)
+            valid = valid & (idx_b >= 0) & (idx_b < spatial[d])
+            flat_in = flat_in + idx_b * mult
+            mult *= spatial[d]
+        pvals = jnp.where(valid[None, None], patches, neg)
+        am = jnp.argmax(pvals, axis=2)                # (N, C, *out_sp)
+        vals = jnp.take_along_axis(pvals, am[:, :, None], 2)[:, :, 0]
+        flat = jnp.take_along_axis(
+            jnp.broadcast_to(flat_in[None, None], pvals.shape),
+            am[:, :, None], 2)[:, :, 0]
+        return vals, flat.astype(jnp.int32)
+    return apply(f, x, op_name=name)
+
+
 def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, name,
           ceil_mode=False, count_include_pad=True, exclusive=None):
     ks = _tuple(kernel, n)
@@ -37,14 +105,18 @@ def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, name,
     pad = _pad_cfg(padding, n)
 
     def f(v):
+        sp_pad = pad
+        if ceil_mode and not isinstance(pad, str):
+            spatial = v.shape[1:-1] if channel_last else v.shape[2:]
+            sp_pad = _ceil_adjust(pad, spatial, ks, st)
         if channel_last:
             window = (1,) + ks + (1,)
             strides = (1,) + st + (1,)
-            pads = ([(0, 0)] + list(pad) + [(0, 0)]) if not isinstance(pad, str) else pad
+            pads = ([(0, 0)] + list(sp_pad) + [(0, 0)]) if not isinstance(sp_pad, str) else sp_pad
         else:
             window = (1, 1) + ks
             strides = (1, 1) + st
-            pads = ([(0, 0), (0, 0)] + list(pad)) if not isinstance(pad, str) else pad
+            pads = ([(0, 0), (0, 0)] + list(sp_pad)) if not isinstance(sp_pad, str) else sp_pad
         if reducer == "max":
             out = jax.lax.reduce_window(v, -jnp.inf if np.issubdtype(v.dtype, np.floating)
                                         else np.iinfo(v.dtype).min,
@@ -84,18 +156,33 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL"):
+    if return_mask:
+        if data_format == "NLC":
+            raise NotImplementedError("return_mask requires channel-first")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   "max_pool1d", ceil_mode)
     return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC", "max", None,
                  "max_pool1d", ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW"):
+    if return_mask:
+        if data_format == "NHWC":
+            raise NotImplementedError("return_mask requires channel-first")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   "max_pool2d", ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", "max", None,
                  "max_pool2d", ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW"):
+    if return_mask:
+        if data_format == "NDHWC":
+            raise NotImplementedError("return_mask requires channel-first")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   "max_pool3d", ceil_mode)
     return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", "max", None,
                  "max_pool3d", ceil_mode)
 

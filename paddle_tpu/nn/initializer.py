@@ -187,3 +187,54 @@ class ParamAttr:
         self.regularizer = regularizer
         self.trainable = trainable
         self.need_clip = need_clip
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init (nn/initializer/Bilinear): for
+    transposed-conv weights (C_out, C_in, kH, kW)."""
+
+    def __call__(self, p):
+        shape = p.shape
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D conv weight")
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        cy = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cx = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        yy, xx = np.mgrid[0:kh, 0:kw]
+        filt = (1 - np.abs(yy / fh - cy)) * (1 - np.abs(xx / fw - cx))
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        p._set_value(jnp.asarray(w, p._value.dtype))
+        return p
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Recommended init gain per activation
+    (nn/initializer/initializer.py calculate_gain)."""
+    import math
+    gains = {
+        "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0, "conv3d": 1.0,
+        "conv_transpose1d": 1.0, "conv_transpose2d": 1.0,
+        "conv_transpose3d": 1.0, "sigmoid": 1.0, "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None
+                                            else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity {nonlinearity!r}")
+    return gains[nonlinearity]
+
+
+_global_initializer = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Default initializers for subsequently-created parameters
+    (nn/initializer/set_global_initializer); Layer.create_parameter
+    consults these when no attr/default initializer is given."""
+    _global_initializer["weight"] = weight_init
+    _global_initializer["bias"] = bias_init

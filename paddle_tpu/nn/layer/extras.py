@@ -1,0 +1,384 @@
+"""Remaining nn.Layer surface (analog of the matching classes in
+python/paddle/nn/layer/{distance,activation,common,loss,pooling,rnn}.py):
+thin Layer wrappers over nn.functional plus the generic RNN-cell family and
+beam-search decoding."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import functional as F
+from .layers import Layer
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (layer/activation.py)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(f"Softmax2D expects 3-D/4-D input, got {x.ndim}-D")
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ...ops.manip import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, os_ = self._a
+        return F.max_unpool1d(x, indices, k, s, p, df, os_)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, os_ = self._a
+        return F.max_unpool2d(x, indices, k, s, p, df, os_)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCDHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, os_ = self._a
+        return F.max_unpool3d(x, indices, k, s, p, df, os_)
+
+
+# ---------------- losses ----------------
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, *self._a)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._a = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        p, m, w, r = self._a
+        return F.multi_margin_loss(input, label, p, m, w, r)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        d, m, s, r = self._a
+        return F.triplet_margin_with_distance_loss(input, positive, negative,
+                                                   d, m, s, r)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self._a = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, *self._a)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classifier head (layer/loss.py HSigmoidLoss):
+    owns the internal-node weight/bias table."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._a = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        b, fe, r = self._a
+        return F.rnnt_loss(input, label, input_lengths, label_lengths, b, fe, r)
+
+
+# ---------------- generic RNN-cell family ----------------
+
+class RNNCellBase(Layer):
+    """Cell base (layer/rnn.py RNNCellBase): provides get_initial_states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        import paddle_tpu as P
+        batch = batch_ref.shape[batch_dim_idx]
+        state_shape = shape or getattr(self, "state_shape", None)
+        dtype = dtype or "float32"
+
+        def mk(s):
+            return P.full([batch] + [int(d) for d in s], init_value, dtype)
+        if isinstance(state_shape, (list, tuple)) and state_shape \
+                and isinstance(state_shape[0], (list, tuple)):
+            return tuple(mk(s) for s in state_shape)
+        return mk(state_shape or [self.hidden_size])
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        import math as _m
+
+        from ..initializer import Uniform
+        self.hidden_size = hidden_size
+        self.activation = activation
+        # default init is Uniform(±1/√H) via create_parameter, so user
+        # attr initializers and LazyGuard deferral are both honored
+        std = 1.0 / _m.sqrt(hidden_size)
+        u = lambda: Uniform(-std, std)  # noqa: E731
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u())
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u())
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u())
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u())
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as P
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states[0] if isinstance(states, (tuple, list)) else states
+        z = inputs @ self.weight_ih.t() + self.bias_ih \
+            + h @ self.weight_hh.t() + self.bias_hh
+        out = P.tanh(z) if self.activation == "tanh" else P.nn.functional.relu(z)
+        return out, out
+
+
+class RNN(Layer):
+    """Run `cell` over a sequence (layer/rnn.py RNN): eager time loop — the
+    cell is arbitrary user code, so the loop stays in Python; the fused
+    LSTM/GRU/SimpleRNN classes are the lax.scan fast path."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manip import stack
+        axis = 0 if self.time_major else 1
+        steps = inputs.shape[axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = []
+        for i in order:
+            x_t = inputs[i] if self.time_major else inputs[:, i]
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs.reverse()
+        return stack(outs, axis=axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manip import concat
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        o_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        o_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return concat([o_fw, o_bw], axis=-1), (st_fw, st_bw)
+
+
+# ---------------- beam search decoding ----------------
+
+class BeamSearchDecoder:
+    """Beam-search decoder over an RNN cell (layer/rnn.py BeamSearchDecoder /
+    dynamic_decode pattern): embedding_fn maps token ids to inputs,
+    output_fn maps cell outputs to vocab logits."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn or (lambda ids: ids)
+        self.output_fn = output_fn or (lambda x: x)
+
+    def initialize(self, initial_cell_states):
+        import paddle_tpu as P
+        st = initial_cell_states
+        batch = (st[0] if isinstance(st, (tuple, list)) else st).shape[0]
+        ids = P.full([batch, self.beam_size], self.start_token, "int64")
+        log_probs = P.to_tensor(
+            np.tile(np.array([[0.0] + [-1e9] * (self.beam_size - 1)], "f"),
+                    (batch, 1)))
+        finished = P.zeros([batch, self.beam_size], "bool")
+        return ids, (st, log_probs, finished)
+
+    def step(self, time, inputs, states):
+        import paddle_tpu as P
+        cell_states, log_probs, finished = states
+        batch, W = inputs.shape[0], self.beam_size
+        # run the cell on flattened (B*W) beams
+        flat_in = self.embedding_fn(P.to_tensor(inputs._value.reshape(-1)))
+        flat_states = cell_states
+        out, new_flat_states = self.cell(flat_in, flat_states)
+        logits = self.output_fn(out)
+        V = logits.shape[-1]
+        logp = Tensor(jnp.reshape(
+            jnp.log(jnp.maximum(
+                jnp.exp(logits._value - logits._value.max(-1, keepdims=True))
+                / jnp.sum(jnp.exp(
+                    logits._value - logits._value.max(-1, keepdims=True)),
+                    -1, keepdims=True), 1e-30)), (batch, W, V)))
+        # finished beams only extend with end_token at zero cost
+        mask = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished._value[..., None], mask[None, None, :],
+                            logp._value)
+        total = log_probs._value[..., None] + step_lp      # (B, W, V)
+        flat = total.reshape(batch, W * V)
+        top_lp, top_idx = jax.lax.top_k(flat, W)
+        beam_idx = (top_idx // V).astype(jnp.int32)        # (B, W)
+        token_idx = (top_idx % V).astype(jnp.int64)
+        new_finished = jnp.take_along_axis(finished._value, beam_idx, 1) \
+            | (token_idx == self.end_token)
+        # reorder cell states along the selected parent beams
+        flat_parent = (jnp.arange(batch)[:, None] * W + beam_idx).reshape(-1)
+
+        def reorder(s):
+            return Tensor(jnp.take(s._value, flat_parent, axis=0))
+        if isinstance(new_flat_states, (tuple, list)):
+            new_states = type(new_flat_states)(
+                reorder(s) for s in new_flat_states)
+        else:
+            new_states = reorder(new_flat_states)
+        return (Tensor(token_idx), Tensor(beam_idx.astype(jnp.int64)),
+                (new_states, Tensor(top_lp), Tensor(new_finished)))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Greedy/beam decode loop (layer/rnn.py dynamic_decode): step until all
+    beams finish or max_step_num."""
+    import paddle_tpu as P
+    ids, states = decoder.initialize(inits)
+    cell_states, log_probs, finished = states
+    batch, W = ids.shape
+    # beam-tile the initial cell states once
+    def tile(s):
+        return Tensor(jnp.repeat(s._value, W, axis=0))
+    if isinstance(cell_states, (tuple, list)):
+        cell_states = type(cell_states)(tile(s) for s in cell_states)
+    else:
+        cell_states = tile(cell_states)
+    states = (cell_states, log_probs, finished)
+
+    step_ids, step_parents = [], []
+    inputs = ids
+    max_steps = max_step_num or 64
+    for t in range(max_steps):
+        tokens, parents, states = decoder.step(t, inputs, states)
+        step_ids.append(tokens._value)
+        step_parents.append(parents._value)
+        inputs = tokens
+        if bool(jnp.all(states[2]._value)):
+            break
+    ids_arr = jnp.stack(step_ids)          # (T, B, W)
+    par_arr = jnp.stack(step_parents)
+    full = P.nn.functional.gather_tree(Tensor(ids_arr), Tensor(par_arr))
+    # lengths come from the BACKTRACED beams (slot tokens cross beams on
+    # reorder): first end_token inclusive, else the full horizon
+    full_tm = full._value                  # (T, B, W)
+    is_end = full_tm == decoder.end_token
+    has_end = jnp.any(is_end, 0)
+    first_end = jnp.argmax(is_end, 0)
+    lengths = Tensor(jnp.where(has_end, first_end + 1,
+                               full_tm.shape[0]).astype(jnp.int64))
+    if not output_time_major:
+        full = Tensor(jnp.transpose(full_tm, (1, 2, 0)))  # (B, W, T)
+    if return_length:
+        return full, states[1], lengths
+    return full, states[1]

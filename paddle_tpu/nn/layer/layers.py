@@ -95,6 +95,9 @@ class Layer:
         if attr is not None and getattr(attr, "initializer", None) is not None:
             init = attr.initializer
         if init is None:
+            from ..initializer import _global_initializer
+            init = _global_initializer["bias" if is_bias else "weight"]
+        if init is None:
             init = Constant(0.0) if is_bias else XavierNormal()
         p = Parameter(jnp.zeros(tuple(int(s) for s in shape), dt))
         if attr is not None:

@@ -36,12 +36,19 @@ class _RNNBase(Layer):
             for d in range(self.bidirect):
                 in_sz = input_size if layer == 0 else hidden_size * self.bidirect
                 sfx = f"l{layer}" + ("_reverse" if d else "")
-                w_ih = self.create_parameter([gate_mult * hidden_size, in_sz])
-                w_hh = self.create_parameter([gate_mult * hidden_size, hidden_size])
-                b_ih = self.create_parameter([gate_mult * hidden_size], is_bias=True)
-                b_hh = self.create_parameter([gate_mult * hidden_size], is_bias=True)
-                for p in (w_ih, w_hh, b_ih, b_hh):
-                    Uniform(-std, std)(p)
+                u = lambda: Uniform(-std, std)  # noqa: E731
+                w_ih = self.create_parameter([gate_mult * hidden_size, in_sz],
+                                             attr=weight_ih_attr,
+                                             default_initializer=u())
+                w_hh = self.create_parameter([gate_mult * hidden_size, hidden_size],
+                                             attr=weight_hh_attr,
+                                             default_initializer=u())
+                b_ih = self.create_parameter([gate_mult * hidden_size],
+                                             attr=bias_ih_attr, is_bias=True,
+                                             default_initializer=u())
+                b_hh = self.create_parameter([gate_mult * hidden_size],
+                                             attr=bias_hh_attr, is_bias=True,
+                                             default_initializer=u())
                 self.add_parameter(f"weight_ih_{sfx}", w_ih)
                 self.add_parameter(f"weight_hh_{sfx}", w_hh)
                 self.add_parameter(f"bias_ih_{sfx}", b_ih)
@@ -128,24 +135,31 @@ class _RNNBase(Layer):
 
 class LSTM(_RNNBase):
     def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
-                 time_major=False, dropout=0.0, **kw):
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, **kw):
         super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
-                         time_major, dropout)
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
 
 
 class GRU(_RNNBase):
     def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
-                 time_major=False, dropout=0.0, **kw):
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, **kw):
         super().__init__("GRU", input_size, hidden_size, num_layers, direction,
-                         time_major, dropout)
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
 
 
 class SimpleRNN(_RNNBase):
     def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
-                 time_major=False, dropout=0.0, activation="tanh", **kw):
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, **kw):
         mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
         super().__init__(mode, input_size, hidden_size, num_layers, direction,
-                         time_major, dropout)
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
 
 
 class LSTMCell(Layer):
@@ -153,12 +167,15 @@ class LSTMCell(Layer):
         super().__init__()
         self.hidden_size = hidden_size
         std = 1.0 / math.sqrt(hidden_size)
-        self.weight_ih = self.create_parameter([4 * hidden_size, input_size])
-        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size])
-        self.bias_ih = self.create_parameter([4 * hidden_size], is_bias=True)
-        self.bias_hh = self.create_parameter([4 * hidden_size], is_bias=True)
-        for p in (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh):
-            Uniform(-std, std)(p)
+        u = lambda: Uniform(-std, std)  # noqa: E731
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               default_initializer=u())
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               default_initializer=u())
+        self.bias_ih = self.create_parameter([4 * hidden_size], is_bias=True,
+                                             default_initializer=u())
+        self.bias_hh = self.create_parameter([4 * hidden_size], is_bias=True,
+                                             default_initializer=u())
 
     def forward(self, inputs, states=None):
         def f(x, h, c, w_ih, w_hh, b_ih, b_hh):
@@ -183,12 +200,15 @@ class GRUCell(Layer):
         super().__init__()
         self.hidden_size = hidden_size
         std = 1.0 / math.sqrt(hidden_size)
-        self.weight_ih = self.create_parameter([3 * hidden_size, input_size])
-        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size])
-        self.bias_ih = self.create_parameter([3 * hidden_size], is_bias=True)
-        self.bias_hh = self.create_parameter([3 * hidden_size], is_bias=True)
-        for p in (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh):
-            Uniform(-std, std)(p)
+        u = lambda: Uniform(-std, std)  # noqa: E731
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               default_initializer=u())
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               default_initializer=u())
+        self.bias_ih = self.create_parameter([3 * hidden_size], is_bias=True,
+                                             default_initializer=u())
+        self.bias_hh = self.create_parameter([3 * hidden_size], is_bias=True,
+                                             default_initializer=u())
 
     def forward(self, inputs, states=None):
         def f(x, h, w_ih, w_hh, b_ih, b_hh):

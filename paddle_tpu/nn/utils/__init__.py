@@ -1,0 +1,159 @@
+"""paddle.nn.utils (python/paddle/nn/utils/): weight/spectral norm
+reparameterizations, parameter<->vector, gradient clipping helpers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = [
+    "weight_norm", "remove_weight_norm", "spectral_norm",
+    "parameters_to_vector", "vector_to_parameters", "clip_grad_norm_",
+    "clip_grad_value_",
+]
+
+
+def _norm_except(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `layer.<name>` as g * v/||v||
+    (nn/utils/weight_norm_hook.py): splits into <name>_g/<name>_v params and
+    recomputes the weight in a forward-pre hook — functional and
+    differentiable through both factors."""
+    w = getattr(layer, name)
+    g = Tensor(_norm_except(w._value, dim), stop_gradient=False)
+    v = Tensor(jnp.array(w._value, copy=True), stop_gradient=False)
+    from ...core.tensor import Parameter
+    gp = Parameter(g._value)
+    vp = Parameter(v._value)
+    layer.add_parameter(name + "_g", gp)
+    layer.add_parameter(name + "_v", vp)
+    # the base weight is no longer a trained parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        from ...ops.dispatch import apply
+        new_w = apply(
+            lambda gv, vv: gv * vv / jnp.maximum(_norm_except(vv, dim), 1e-12),
+            gp, vp, op_name="weight_norm")
+        object.__setattr__(lyr, name, new_w)
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = (handle, name, dim)
+    _recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    handle, nm, dim = layer._weight_norm_hook
+    handle.remove()
+    gp = getattr(layer, nm + "_g")
+    vp = getattr(layer, nm + "_v")
+    from ...core.tensor import Parameter
+    w = Parameter(np.asarray(
+        gp._value * vp._value
+        / np.maximum(np.asarray(_norm_except(vp._value, dim)), 1e-12)))
+    for extra in (nm + "_g", nm + "_v"):
+        layer._parameters.pop(extra, None)
+    layer.add_parameter(nm, w)
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization (nn/utils/spectral_norm_hook.py): divide the
+    weight by its largest singular value, estimated by power iteration
+    carried in buffers."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    wmat = jnp.moveaxis(w._value, dim, 0).reshape(w._value.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(wmat.shape[0]).astype(np.float32)
+    v0 = rng.randn(wmat.shape[1]).astype(np.float32)
+    layer.register_buffer(name + "_u",
+                          Tensor(jnp.asarray(u0 / np.linalg.norm(u0))))
+    layer.register_buffer(name + "_v",
+                          Tensor(jnp.asarray(v0 / np.linalg.norm(v0))))
+    from ...core.tensor import Parameter
+    orig = Parameter(jnp.array(w._value, copy=True))
+    layer.add_parameter(name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        from ...ops.dispatch import apply
+        u = getattr(lyr, name + "_u")._value
+        v = getattr(lyr, name + "_v")._value
+        wm = jnp.moveaxis(orig._value, dim, 0).reshape(
+            orig._value.shape[dim], -1)
+        for _ in range(n_power_iterations):
+            v = wm.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = wm @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        getattr(lyr, name + "_u")._set_value(u)
+        getattr(lyr, name + "_v")._set_value(v)
+        sigma = u @ wm @ v
+
+        new_w = apply(lambda ov: ov / sigma, orig, op_name="spectral_norm")
+        object.__setattr__(lyr, name, new_w)
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._spectral_norm_hook = (handle, name)
+    _recompute(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops.manip import concat, reshape
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) or 1
+        p._set_value(vec._value[off:off + n].reshape(p._value.shape)
+                     .astype(p._value.dtype))
+        off += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip (nn/utils/clip_grad_norm_)."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.asarray(
+            [jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.sum(jnp.asarray(
+            [jnp.sum(jnp.abs(p.grad._value) ** norm_type)
+             for p in params])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite grad norm in clip_grad_norm_")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._set_value(p.grad._value * scale)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = parameters if isinstance(parameters, (list, tuple)) \
+        else [parameters]
+    for p in params:
+        if p.grad is not None:
+            p.grad._set_value(jnp.clip(p.grad._value, -clip_value, clip_value))

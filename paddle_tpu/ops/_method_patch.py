@@ -84,8 +84,8 @@ def _install():
     T.__setitem__ = _setitem
 
     # ---- named methods: bulk-install from op modules ----
-    from . import breadth
-    method_sources = [math, manip, creation, linalg, breadth]
+    from . import breadth, random as random_ops
+    method_sources = [math, manip, creation, linalg, breadth, random_ops]
     skip = {"to_tensor", "as_tensor", "arange", "linspace", "logspace", "eye",
             "meshgrid", "zeros", "ones", "full", "empty", "tril_indices",
             "triu_indices", "scatter_nd", "complex",
@@ -93,7 +93,10 @@ def _install():
             # and paddle's Tensor does not define these as methods
             "hstack", "vstack", "dstack", "column_stack", "row_stack",
             "block_diag", "cartesian_prod", "atleast_1d", "atleast_2d",
-            "atleast_3d"}
+            "atleast_3d",
+            # shape-first creation RNG ops: `self` would bind to shape/mean
+            "rand", "randn", "randint", "randperm", "standard_normal",
+            "uniform", "normal", "gumbel_softmax"}
     for mod in method_sources:
         for name in getattr(mod, "__all__", []):
             if name in skip or hasattr(T, name):
@@ -117,6 +120,8 @@ def _install():
     T.fill_ = _fill
 
     # misc names paddle exposes on Tensor
+    T.is_tensor = lambda s: True
+    T.scatter_nd = lambda s, updates, shape: creation.scatter_nd(s, updates, shape)
     T.dim = lambda s: s.ndim
     T.rank = lambda s: s.ndim
     T.astype = lambda s, d: math.cast(s, d)

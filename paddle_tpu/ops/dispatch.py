@@ -15,6 +15,7 @@ paddle/fluid/eager/grad_node_info.h:168).
 """
 from __future__ import annotations
 
+import weakref as _weakref
 from typing import Any, Callable, Sequence
 
 import jax
@@ -189,6 +190,21 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
     ]
     node = GradNode(vjp_fn, [args[i] for i in diff_idx], out_avals, multi, name,
                     recompute=(jax_fn, vals, diff_idx, static_kwargs))
+    # consumer registry: lets Tensor._inplace_assign rewire EVERY node that
+    # consumed the pre-op tensor, not just this one (weakrefs — the tape's
+    # strong refs run node->tensor, never tensor->node)
+    for i in diff_idx:
+        t = args[i]
+        if isinstance(t, Tensor):
+            if t._consumer_nodes is None:
+                t._consumer_nodes = []
+            t._consumer_nodes.append(_weakref.ref(node))
+            # amortized compaction: GradNodes die after backward, so for
+            # long-lived tensors (Parameters in a training loop) the list is
+            # mostly dead refs — prune periodically to keep it O(live)
+            if len(t._consumer_nodes) % 64 == 0:
+                t._consumer_nodes = [r for r in t._consumer_nodes
+                                     if r() is not None]
     for i, o in enumerate(outs_list):
         if isinstance(o, Tensor):
             o._grad_node = node

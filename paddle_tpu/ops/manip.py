@@ -48,6 +48,11 @@ def reshape_(x, shape):
 
 
 @_export
+def flatten_(x, start_axis=0, stop_axis=-1):
+    return x._inplace_assign(flatten(x, start_axis, stop_axis))
+
+
+@_export
 def flatten(x, start_axis=0, stop_axis=-1):
     def f(v):
         nd = v.ndim

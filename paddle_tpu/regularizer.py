@@ -1,0 +1,40 @@
+"""Weight-decay regularizers (python/paddle/regularizer.py): L1Decay/L2Decay.
+
+Consumed by the optimizer base: a callable regularizer contributes its grad
+term before the update rule (the reference appends regularization ops in
+append_regularization_ops; here the term fuses into the XLA update)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param_value):
+        """Return the gradient contribution d(penalty)/d(param)."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """penalty = coeff * sum|w|  ->  grad += coeff * sign(w)."""
+
+    def __call__(self, param_value):
+        return self._coeff * jnp.sign(param_value)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """penalty = coeff/2 * sum w^2  ->  grad += coeff * w."""
+
+    def __call__(self, param_value):
+        return self._coeff * param_value

@@ -8,6 +8,8 @@ gather/scatter+MXU; for heavily-structured sparsity prefer dense masking
 """
 from __future__ import annotations
 
+import builtins
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -218,3 +220,121 @@ class nn:
             return jnp.where(mask, s, 0)
         out = apply(f, _as_tensor(x), op_name="sparse_softmax")
         return _rewrap(out, x)
+
+
+# ---- value-wise unary family (python/paddle/sparse/unary.py) ----
+# Each applies to the STORED values only (zeros stay zero for the odd
+# functions; for the non-zero-preserving ones — sqrt/log1p on implicit
+# zeros — the reference also only touches stored values, matching here
+# because _rewrap rebuilds the layout from the dense result).
+
+def _unary(fn, name):
+    def op(x, name_=None):
+        out = apply(fn, _as_tensor(x), op_name=name)
+        return _rewrap(out, x)
+    op.__name__ = name.replace("sparse_", "")
+    return op
+
+
+sin = _unary(jnp.sin, "sparse_sin")
+tan = _unary(jnp.tan, "sparse_tan")
+asin = _unary(jnp.arcsin, "sparse_asin")
+atan = _unary(jnp.arctan, "sparse_atan")
+sinh = _unary(jnp.sinh, "sparse_sinh")
+tanh = _unary(jnp.tanh, "sparse_tanh")
+asinh = _unary(jnp.arcsinh, "sparse_asinh")
+atanh = _unary(jnp.arctanh, "sparse_atanh")
+sqrt = _unary(jnp.sqrt, "sparse_sqrt")
+square = _unary(jnp.square, "sparse_square")
+log1p = _unary(jnp.log1p, "sparse_log1p")
+abs = _unary(jnp.abs, "sparse_abs")  # noqa: A001
+neg = _unary(jnp.negative, "sparse_neg")
+expm1 = _unary(jnp.expm1, "sparse_expm1")
+deg2rad = _unary(jnp.deg2rad, "sparse_deg2rad")
+rad2deg = _unary(jnp.rad2deg, "sparse_rad2deg")
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    out = apply(lambda v: jnp.power(v, factor), _as_tensor(x),
+                op_name="sparse_pow")
+    return _rewrap(out, x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core import dtype as dtypes
+    dt = dtypes.convert_dtype(value_dtype) if value_dtype else None
+
+    out = apply(lambda v: v.astype(dt) if dt else v, _as_tensor(x),
+                op_name="sparse_cast")
+    sp = _rewrap(out, x)
+    if index_dtype is not None and isinstance(sp, SparseCooTensor) \
+            and sp._bcoo is not None \
+            and not isinstance(sp._value, jax.core.Tracer):
+        idt = dtypes.convert_dtype(index_dtype)
+        sp._bcoo = jsparse.BCOO((sp._bcoo.data, sp._bcoo.indices.astype(idt)),
+                                shape=sp._bcoo.shape)
+    return sp
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    out = apply(lambda v: jnp.sum(v, axis=axis, keepdims=keepdim),
+                _as_tensor(x), op_name="sparse_sum")
+    return out  # reduction of a sparse tensor is dense
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    prod = matmul(x, y)
+    out = apply(lambda a, b: beta * a + alpha * b,
+                _as_tensor(input), _as_tensor(prod), op_name="sparse_addmm")
+    return _rewrap(out, input)
+
+
+def transpose(x, perm, name=None):
+    out = apply(lambda v: jnp.transpose(v, perm), _as_tensor(x),
+                op_name="sparse_transpose")
+    return _rewrap(out, x)
+
+
+def coalesce(x, name=None):
+    """Merge duplicate coordinates (paddle.sparse.coalesce). BCOO supports
+    duplicates; sum_duplicates canonicalizes."""
+    if isinstance(x, SparseCooTensor) and x._bcoo is not None:
+        sp = SparseCooTensor(x._bcoo.sum_duplicates(), stop_gradient=x.stop_gradient)
+        return sp
+    return x
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (paddle.sparse.pca_lowrank → same math as
+    linalg.pca_lowrank, sparse input densified for the XLA matmuls)."""
+    from ..ops import linalg as _linalg
+    return _linalg.pca_lowrank(_as_tensor(x), q=q, center=center, niter=niter)
+
+
+def reshape(x, shape, name=None):
+    out = apply(lambda v: jnp.reshape(v, shape), _as_tensor(x),
+                op_name="sparse_reshape")
+    return _rewrap(out, x)
+
+
+def isnan(x, name=None):
+    out = apply(jnp.isnan, _as_tensor(x), op_name="sparse_isnan")
+    return _rewrap(out, x)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return v[tuple(idx)]
+    out = apply(f, _as_tensor(x), op_name="sparse_slice")
+    return _rewrap(out, x)

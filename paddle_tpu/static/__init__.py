@@ -13,6 +13,16 @@ from .framework import (  # noqa: F401
     enable_static, in_dynamic_mode, in_static_mode, program_guard,
     set_program_state,
 )
+from .compat import (  # noqa: F401
+    BuildStrategy, ExecutionStrategy, ExponentialMovingAverage,
+    IpuCompiledProgram, IpuStrategy, Print, WeightNormParamAttr,
+    accuracy, append_backward, auc, cpu_places, create_global_var,
+    create_parameter, ctr_metric_bundle, cuda_places, device_guard,
+    deserialize_persistables, deserialize_program, gradients,
+    ipu_shard_guard, load, load_from_file, load_program_state, name_scope,
+    py_func, save, save_to_file, serialize_persistables, serialize_program,
+    set_ipu_shard, xpu_places,
+)
 from .io import (  # noqa: F401
     InferenceProgram, load_inference_model, normalize_program,
     save_inference_model,
@@ -24,5 +34,11 @@ __all__ = [
     "default_startup_program", "program_guard", "enable_static",
     "disable_static", "in_dynamic_mode", "in_static_mode",
     "save_inference_model", "load_inference_model", "normalize_program",
-    "set_program_state",
+    "set_program_state", "append_backward", "gradients", "name_scope",
+    "py_func", "Print", "create_global_var", "ExponentialMovingAverage",
+    "WeightNormParamAttr", "BuildStrategy", "ExecutionStrategy", "save",
+    "load", "load_program_state", "serialize_program",
+    "serialize_persistables", "save_to_file", "deserialize_program",
+    "deserialize_persistables", "load_from_file", "cpu_places", "cuda_places",
+    "xpu_places", "ipu_shard_guard", "IpuCompiledProgram", "IpuStrategy",
 ]

@@ -1,0 +1,417 @@
+"""Remaining paddle.static surface (python/paddle/static/__init__.py):
+backward/gradients, program serialization, EMA, name scopes, py_func/Print,
+places, build/execution strategies, IPU stubs."""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import framework as fw
+from .framework import GradientRecord, Program, Variable, default_main_program
+
+__all__ = [
+    "append_backward", "gradients", "name_scope", "py_func", "Print",
+    "create_global_var", "ExponentialMovingAverage", "WeightNormParamAttr",
+    "BuildStrategy", "ExecutionStrategy", "save", "load", "load_program_state",
+    "serialize_program", "serialize_persistables", "save_to_file",
+    "deserialize_program", "deserialize_persistables", "load_from_file",
+    "cpu_places", "cuda_places", "xpu_places", "ipu_shard_guard",
+    "IpuCompiledProgram", "IpuStrategy",
+]
+
+
+# ---- backward (python/paddle/fluid/backward.py append_backward) ----
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Record gradient computation for `loss`; returns [(param_name,
+    grad_name)] where each grad is fetchable as `<param>@GRAD`."""
+    prog = default_main_program()
+    if parameter_list is not None:
+        names = [p.name if isinstance(p, Variable) else
+                 (prog.capture(p) if isinstance(p, Tensor) else str(p))
+                 for p in parameter_list]
+    else:
+        names = list(prog.captured.keys())
+    if no_grad_set:
+        drop = {getattr(v, "name", v) for v in no_grad_set}
+        names = [n for n in names if n not in drop]
+    prog.global_block().append_op(GradientRecord(loss.name, names))
+    return [(n, n + "@GRAD") for n in names]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(sum(targets))/d(inputs) as fetchable `@GRAD` variables
+    (python/paddle/static/gradients)."""
+    prog = default_main_program()
+    tgt = targets[0] if isinstance(targets, (list, tuple)) else targets
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    names = [v.name if isinstance(v, Variable) else str(v) for v in ins]
+    prog.global_block().append_op(GradientRecord(tgt.name, names))
+    return [Variable(n + "@GRAD", shape=getattr(v, "shape", None),
+                     dtype=getattr(v, "dtype", "float32"))
+            for n, v in zip(names, ins)]
+
+
+# ---- misc graph utilities ----
+
+_name_scopes: List[str] = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Hierarchical op-name prefix (reference framework name_scope); purely
+    cosmetic here — XLA owns scheduling — but kept for profiler grouping."""
+    _name_scopes.append(prefix or "")
+    try:
+        yield
+    finally:
+        _name_scopes.pop()
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Embed a host-python callable in the graph via jax.pure_callback (the
+    XLA-native replacement for the reference's py_func op)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(o.dtype))
+              for o in outs]
+
+    from ..ops.dispatch import apply
+
+    def f(*vals):
+        res = jax.pure_callback(
+            lambda *a: func(*[np.asarray(v) for v in a]),
+            shapes if len(shapes) > 1 else shapes[0], *vals)
+        return res
+    result = apply(f, *xs, op_name="py_func")
+    return result
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug-print a tensor at execution time (reference static.Print) via
+    jax.debug.print — works inside compiled programs."""
+    from ..ops.dispatch import apply
+
+    msg = message or getattr(input, "name", "var")
+
+    def f(v):
+        jax.debug.print(msg + ": {}", v)
+        return v
+    return apply(f, input, op_name="print")
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Persistable captured variable with a constant initial value."""
+    from ..core import dtype as dtypes
+    prog = default_main_program()
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        dtypes.convert_dtype(dtype)))
+    t.persistable = persistable
+    vname = prog.capture(t) if name is None else name
+    if name is not None:
+        prog.captured[name] = t
+    return Variable(vname, shape=list(shape), dtype=dtype)
+
+
+# ---- EMA (python/paddle/static/ema.py ExponentialMovingAverage) ----
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters with apply()/restore() swap contexts; the
+    update itself is one fused XLA step over the param pytree."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema: Dict[int, jax.Array] = {}
+        self._backup: Dict[int, jax.Array] = {}
+        self._params = []
+        self._step = 0
+
+    def _tracked(self):
+        if not self._params:
+            from .framework import default_main_program
+            self._params = [t for t in
+                            default_main_program().captured.values()
+                            if not t.stop_gradient]
+            if not self._params:
+                raise RuntimeError("no trainable parameters to track; build "
+                                   "the program (or pass params) first")
+        return self._params
+
+    def track(self, parameters):
+        self._params = list(parameters)
+
+    def update(self):
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._tracked():
+            prev = self._ema.get(id(p), p._value)
+            self._ema[id(p)] = d * prev + (1 - d) * p._value
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._tracked():
+            self._backup[id(p)] = p._value
+            if id(p) in self._ema:
+                p._value = self._ema[id(p)].astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._tracked():
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+
+class WeightNormParamAttr:
+    """ParamAttr requesting weight normalization (reference
+    WeightNormParamAttr): consumed by nn.utils.weight_norm-style wrapping;
+    carries dim + the usual ParamAttr fields."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+class BuildStrategy:
+    """Graph-build knobs (reference BuildStrategy). XLA performs the fusion /
+    memory-optimization passes these toggled; the attributes are accepted and
+    recorded so reference configs construct unchanged."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.fuse_all_reduce_ops = True
+        self.enable_addto = False
+        self.build_cinn_pass = False
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+
+
+# ---- program/persistables serialization (static/io.py) ----
+
+def serialize_program(feed_vars, fetch_vars, program=None) -> bytes:
+    prog = program or default_main_program()
+    from .io import normalize_program
+    return pickle.dumps(normalize_program(prog, feed_vars, fetch_vars))
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None) -> bytes:
+    prog = program or default_main_program()
+    state = {n: np.asarray(t._value) for n, t in prog.captured.items()}
+    return pickle.dumps(state)
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data: bytes):
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    state = pickle.loads(data)
+    fw.set_program_state(program, state)
+    return state
+
+
+def save(program, model_prefix: str, protocol=4):
+    """static.save: persist the program's parameter state (pdparams) +
+    program structure (pdmodel)."""
+    state = {n: np.asarray(t._value) for n, t in program.captured.items()}
+    with open(model_prefix + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    with open(model_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(program, f, protocol=protocol)
+
+
+def load(program, model_prefix: str, executor=None, var_list=None):
+    state = load_program_state(model_prefix, var_list)
+    fw.set_program_state(program, state)
+
+
+def load_program_state(model_prefix: str, var_list=None):
+    path = model_prefix + ".pdparams" \
+        if not model_prefix.endswith(".pdparams") else model_prefix
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    if var_list is not None:
+        keep = {getattr(v, "name", v) for v in var_list}
+        state = {k: v for k, v in state.items() if k in keep}
+    return state
+
+
+# ---- places ----
+
+def cpu_places(device_count=None):
+    from ..framework_compat import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..framework_compat import CUDAPlace
+    ids = device_ids if device_ids is not None else range(
+        max(len(jax.devices()), 1))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+# ---- IPU (reference-only hardware: explicit N/A stubs) ----
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError(
+        "IPU sharding targets Graphcore hardware; on TPU use "
+        "paddle_tpu.distributed.shard_tensor / pipeline stages instead")
+    yield  # pragma: no cover
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError(
+            "IpuStrategy targets Graphcore IPUs; this framework targets TPU "
+            "(use DistributedStrategy / Mesh sharding)")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IpuCompiledProgram targets Graphcore IPUs; programs here compile "
+            "through XLA automatically")
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone trainable parameter registered with the current program
+    (reference static.create_parameter)."""
+    from ..core import dtype as dtypes
+    from ..core.tensor import Parameter
+    from ..nn.initializer import Constant, XavierNormal
+    init = default_initializer
+    if attr is not None and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierNormal()
+    p = Parameter(jnp.zeros(tuple(int(s) for s in shape),
+                            dtypes.convert_dtype(dtype)))
+    init(p)
+    if attr is not None and getattr(attr, "name", None):
+        p.name = attr.name
+    prog = default_main_program()
+    prog.capture(p)
+    return p
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Batch top-k accuracy op (reference static/nn/metric.py accuracy)."""
+    from ..ops.dispatch import apply
+
+    def f(pred, lab):
+        topk = jnp.argsort(pred, axis=-1)[..., -k:]
+        lab2 = lab.reshape(-1, 1)
+        hit = jnp.any(topk == lab2, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return apply(f, input, label, op_name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Batch AUC op via threshold-bucketed rank statistic (reference
+    static/nn/metric.py auc). Returns (auc_value, batch_auc, states) with the
+    states kept as opaque tensors for API shape parity."""
+    from ..ops.dispatch import apply
+
+    def f(pred, lab):
+        pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+            else pred.reshape(-1)
+        labf = lab.reshape(-1).astype(jnp.float32)
+        bucket = jnp.clip((pos_score * num_thresholds).astype(jnp.int32),
+                          0, num_thresholds)
+        pos = jnp.zeros(num_thresholds + 1).at[bucket].add(labf)
+        neg = jnp.zeros(num_thresholds + 1).at[bucket].add(1.0 - labf)
+        # trapezoid over descending thresholds
+        tp = jnp.cumsum(pos[::-1])
+        fp = jnp.cumsum(neg[::-1])
+        tot_pos = tp[-1]
+        tot_neg = fp[-1]
+        tpr = tp / jnp.maximum(tot_pos, 1.0)
+        fpr = fp / jnp.maximum(tot_neg, 1.0)
+        return jnp.trapezoid(tpr, fpr)
+    a = apply(f, input, label, op_name="auc")
+    return a, a, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metrics bundle (reference static/nn/metric.py ctr_metric_bundle):
+    returns (sqrerr, abserr, prob, q, pos, total) batch sums."""
+    from ..ops.dispatch import apply
+
+    def f(pred, lab):
+        p = pred.reshape(-1)
+        l2 = lab.reshape(-1).astype(p.dtype)
+        sqrerr = jnp.sum(jnp.square(p - l2))
+        abserr = jnp.sum(jnp.abs(p - l2))
+        prob = jnp.sum(p)
+        q = jnp.sum(p * p)
+        pos = jnp.sum(l2)
+        total = jnp.asarray(p.shape[0], p.dtype)
+        return sqrerr, abserr, prob, q, pos, total
+    return apply(f, input, label, op_name="ctr_metric_bundle")
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference device_guard pins ops to cpu/gpu inside a program; under
+    PJRT/XLA placement is whole-program, so this validates and no-ops."""
+    if device is not None and device.split(":")[0] not in (
+            "cpu", "gpu", "xpu", "tpu", "npu"):
+        raise ValueError(f"unsupported device {device!r} in device_guard")
+    yield
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    raise NotImplementedError(
+        "set_ipu_shard targets Graphcore IPUs; use pipeline-parallel stage "
+        "assignment (fleet hybrid_configs pp_degree) on TPU")

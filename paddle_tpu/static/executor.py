@@ -22,8 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
-from .framework import (BackwardRecord, Operator, Program, Variable,
-                        default_main_program)
+from .framework import (BackwardRecord, GradientRecord, Operator, Program,
+                        Variable, default_main_program)
 
 __all__ = ["Scope", "global_scope", "scope_guard", "Executor"]
 
@@ -143,7 +143,11 @@ class Executor:
         compiled, bw = entry
 
         param_vals = {n: scope.vars[n] for n in program.captured}
-        if bw is not None:
+        if isinstance(bw, GradientRecord):
+            # gradients only — no optimizer state / lr involved
+            fetches, new_params, _ = compiled(param_vals, {}, feed_arrays,
+                                              jnp.float32(0), jnp.int32(0))
+        elif bw is not None:
             scope.step += 1
             opt = bw.optimizer
             opt_state = {n: scope.opt_states[n] for n in bw.param_names}
@@ -186,10 +190,12 @@ class Executor:
     def _compile(self, program: Program, scope: Scope, fetch_names):
         ops = list(program.ops)
         bw_idx = next((i for i, o in enumerate(ops)
-                       if isinstance(o, BackwardRecord)), None)
-        if bw_idx is not None and any(isinstance(o, BackwardRecord)
-                                      for o in ops[bw_idx + 1:]):
-            raise NotImplementedError("one minimize() per Program")
+                       if isinstance(o, (BackwardRecord, GradientRecord))),
+                      None)
+        if bw_idx is not None and any(
+                isinstance(o, (BackwardRecord, GradientRecord))
+                for o in ops[bw_idx + 1:]):
+            raise NotImplementedError("one backward record per Program")
         bw = ops[bw_idx] if bw_idx is not None else None
 
         def fetch_from(env, params):
@@ -206,6 +212,29 @@ class Executor:
         if bw is None:
             def compiled(param_vals, opt_state, feeds, lr, step):
                 env = _replay(ops, param_vals, feeds)
+                return fetch_from(env, param_vals), param_vals, opt_state
+        elif isinstance(bw, GradientRecord):
+            fwd_ops = ops[:bw_idx]
+            tail_ops = ops[bw_idx + 1:]
+            wrt = list(bw.wrt_names)
+
+            def compiled(param_vals, opt_state, feeds, lr, step):
+                def loss_fn(wrt_vals):
+                    p2 = dict(param_vals)
+                    f2 = dict(feeds)
+                    for k, v in wrt_vals.items():
+                        (p2 if k in p2 else f2)[k] = v
+                    env = _replay(fwd_ops, p2, f2)
+                    return env[bw.loss_name], env
+
+                wrt_vals = {n: (param_vals[n] if n in param_vals else feeds[n])
+                            for n in wrt}
+                (_, env), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(wrt_vals)
+                for n in wrt:
+                    env[n + "@GRAD"] = grads[n]
+                if tail_ops:
+                    env = _replay(tail_ops, param_vals, feeds, env=env)
                 return fetch_from(env, param_vals), param_vals, opt_state
         else:
             opt = bw.optimizer

@@ -148,6 +148,22 @@ class BackwardRecord:
         return f"<backward+update loss={self.loss_name} params={len(self.param_names)}>"
 
 
+class GradientRecord:
+    """append_backward()/gradients() marker: compute d(loss)/d(wrt) and
+    publish each gradient under `<name>@GRAD` (fetchable), WITHOUT an
+    optimizer update — the analog of bare append_backward
+    (python/paddle/fluid/backward.py append_backward)."""
+    __slots__ = ("loss_name", "wrt_names", "type")
+
+    def __init__(self, loss_name, wrt_names):
+        self.loss_name = loss_name
+        self.wrt_names = list(wrt_names)
+        self.type = "gradients"
+
+    def __repr__(self):
+        return f"<gradients loss={self.loss_name} wrt={len(self.wrt_names)}>"
+
+
 class Block:
     """Analog of framework.py:3799 Block (single-block programs only; control
     flow lives inside ops as lax.cond/scan, the XLA-idiomatic form)."""

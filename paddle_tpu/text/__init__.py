@@ -6,4 +6,4 @@ has no egress, so dataset classes accept a local `data_file` and raise a
 clear error otherwise (same class/API shape).
 """
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
-from .datasets import Imdb, Conll05st, Movielens, UCIHousing, WMT14, WMT16  # noqa: F401
+from .datasets import Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16  # noqa: F401

@@ -99,3 +99,45 @@ class WMT14(_LocalOnlyDataset):
 
 class WMT16(WMT14):
     _NAME = "WMT16 en-de"
+
+
+class Imikolov(_LocalOnlyDataset):
+    """PTB n-gram dataset (reference text/datasets/imikolov.py): yields
+    data_type='NGRAM' windows or 'SEQ' sequences over a whitespace-tokenized
+    corpus file."""
+
+    _NAME = "imikolov (PTB)"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, **kw):
+        self.data_type = data_type
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        super().__init__(data_file=data_file, mode=mode, **kw)
+
+    def _build_vocab(self, lines):
+        from collections import Counter
+        freq = Counter(w for ln in lines for w in ln.split())
+        words = sorted(w for w, c in freq.items() if c >= self.min_word_freq)
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        self.word_idx["<e>"] = len(self.word_idx)
+
+    def _load(self):
+        with open(self.data_file, encoding="utf-8") as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        self._build_vocab(lines)
+        unk = self.word_idx["<unk>"]
+        end = self.word_idx["<e>"]
+        records = []
+        for ln in lines:
+            ids = [self.word_idx.get(w, unk) for w in ln.split()] + [end]
+            if self.data_type.upper() == "SEQ":
+                records.append(ids)
+            else:
+                n = self.window_size
+                if n <= 0:
+                    n = 5
+                for i in range(len(ids) - n + 1):
+                    records.append(tuple(ids[i:i + n]))
+        return records

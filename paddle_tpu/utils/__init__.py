@@ -13,3 +13,4 @@ def run_check():
     print(f"paddle_tpu is installed and working on {d.platform}:{d.id} "
           f"({float(y[0, 0])} == 128.0)")
     return True
+from .compat import deprecated, require_version, try_import  # noqa: E402,F401

@@ -121,6 +121,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "pt_trace_clear": (None, []),
         "pt_trace_count": (c.c_long, []),
         "pt_trace_dump": (c.c_long, [c.POINTER(c.c_void_p)]),
+        "pt_rpc_server_start": (c.c_void_p, [c.c_char_p, c.c_char_p, c.c_int]),
+        "pt_rpc_server_port": (c.c_int, [c.c_void_p]),
+        "pt_rpc_next_request": (c.c_long, [c.c_void_p, c.POINTER(c.c_void_p),
+                                           c.POINTER(c.c_long), c.c_double]),
+        "pt_rpc_send_response": (None, [c.c_void_p, c.c_long, c.c_char_p,
+                                        c.c_long]),
+        "pt_rpc_server_stop": (None, [c.c_void_p]),
+        "pt_rpc_server_free": (None, [c.c_void_p]),
+        "pt_rpc_call": (c.c_long, [c.c_char_p, c.c_int, c.c_char_p, c.c_int,
+                                   c.c_char_p, c.c_long, c.POINTER(c.c_void_p),
+                                   c.c_double]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)

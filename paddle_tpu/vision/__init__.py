@@ -1,5 +1,49 @@
 """paddle_tpu.vision: datasets, transforms, models
 (analog of python/paddle/vision/)."""
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401
 from .datasets import *  # noqa: F401,F403
 from .models import *  # noqa: F401,F403
+
+# ---- image backend (reference python/paddle/vision/image.py) ----
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    """Select the image-decode backend for datasets/image_load: 'pil', 'cv2'
+    or 'tensor' (decoded straight to a CHW float Tensor)."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"image backend must be 'pil', 'cv2' or 'tensor', got {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file with the selected backend
+    (reference vision/image.py image_load)."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        try:
+            import cv2
+        except ImportError as e:
+            raise RuntimeError(
+                "cv2 backend requested but OpenCV is not installed; "
+                "use set_image_backend('pil')") from e
+        return cv2.imread(str(path), cv2.IMREAD_UNCHANGED)
+    from PIL import Image
+    img = Image.open(path)
+    img.load()
+    if backend == "pil":
+        return img
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    arr = np.asarray(img, dtype="float32")
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return Tensor(arr.transpose(2, 0, 1))  # CHW

@@ -1,0 +1,677 @@
+"""Detection operators (analog of python/paddle/vision/ops.py).
+
+TPU-first split: dense per-pixel math (roi_align/roi_pool/psroi_pool,
+deform_conv2d, yolo_box/yolo_loss, prior_box, box_coder) is pure jnp —
+gathers + matmuls that fuse under XLA; selection-style post-processing with
+data-dependent output sizes (nms, generate_proposals,
+distribute_fpn_proposals) runs host-side in numpy, the same place it runs in
+a TPU serving stack (dynamic shapes don't belong in compiled programs).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply
+
+__all__ = [
+    "yolo_loss", "yolo_box", "prior_box", "box_coder", "deform_conv2d",
+    "DeformConv2D", "distribute_fpn_proposals", "generate_proposals",
+    "read_file", "decode_jpeg", "roi_pool", "RoIPool", "psroi_pool",
+    "PSRoIPool", "roi_align", "RoIAlign", "nms", "matrix_nms",
+]
+
+
+def _np(x):
+    return np.asarray(x.numpy()) if isinstance(x, Tensor) else np.asarray(x)
+
+
+# ---------------- RoI ops ----------------
+
+def _roi_grid_sample(feat, boxes, output_size, spatial_scale, sampling_ratio,
+                     aligned, reducer):
+    """Shared RoI sampler: per-RoI bin grid, bilinear taps, reduce.
+    feat (C,H,W); boxes (N,4) x1,y1,x2,y2. Returns (N,C,oh,ow)."""
+    oh, ow = output_size
+    c, h, w = feat.shape
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - off
+    y1 = boxes[:, 1] * spatial_scale - off
+    x2 = boxes[:, 2] * spatial_scale - off
+    y2 = boxes[:, 3] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1e-4 if aligned else 1.0)
+    rh = jnp.maximum(y2 - y1, 1e-4 if aligned else 1.0)
+    bin_w = rw / ow
+    bin_h = rh / oh
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    iy = jnp.arange(oh)
+    ix = jnp.arange(ow)
+    sy = (jnp.arange(sr) + 0.5) / sr
+    ys = y1[:, None, None] + (iy[None, :, None] + sy[None, None, :]) \
+        * bin_h[:, None, None]
+    xs = x1[:, None, None] + (ix[None, :, None] + sy[None, None, :]) \
+        * bin_w[:, None, None]
+
+    def bilinear(yy, xx):
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        wy = yy - y0
+        wx = xx - x0
+
+        def tap(yi, xi):
+            yc = jnp.clip(yi, 0, h - 1)
+            xc = jnp.clip(xi, 0, w - 1)
+            v = feat[:, yc, xc]  # (C, ...)
+            inside = (yi >= -1) & (yi <= h) & (xi >= -1) & (xi <= w)
+            return jnp.where(inside, v, 0.0)
+        return (tap(y0, x0) * (1 - wy) * (1 - wx)
+                + tap(y0, x0 + 1) * (1 - wy) * wx
+                + tap(y0 + 1, x0) * wy * (1 - wx)
+                + tap(y0 + 1, x0 + 1) * wy * wx)
+
+    yy = ys[:, :, :, None, None]
+    xx = xs[:, None, None, :, :]
+    n_roi = ys.shape[0]
+    yyb = jnp.broadcast_to(yy, (n_roi, oh, sr, ow, sr))
+    xxb = jnp.broadcast_to(xx, (n_roi, oh, sr, ow, sr))
+    vals = bilinear(yyb, xxb)          # (C, N, oh, sr, ow, sr)
+    vals = jnp.moveaxis(vals, 0, 1)    # (N, C, oh, sr, ow, sr)
+    return reducer(vals)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (vision/ops.py roi_align): average of bilinear taps per bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bn = _np(boxes_num)
+    batch_of_roi = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat, bxs):
+        outs = []
+        for i, b in enumerate(batch_of_roi):
+            outs.append(_roi_grid_sample(
+                feat[b], bxs[i:i + 1], output_size, spatial_scale,
+                sampling_ratio, aligned,
+                lambda v: jnp.mean(v, axis=(3, 5)))[0])
+        return jnp.stack(outs) if outs else jnp.zeros(
+            (0, feat.shape[1], *output_size), feat.dtype)
+    return apply(f, x, boxes, op_name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool: max over bins (vision/ops.py roi_pool)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bn = _np(boxes_num)
+    batch_of_roi = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat, bxs):
+        outs = []
+        for i, b in enumerate(batch_of_roi):
+            outs.append(_roi_grid_sample(
+                feat[b], bxs[i:i + 1], output_size, spatial_scale,
+                sampling_ratio=2, aligned=False,
+                reducer=lambda v: jnp.max(v, axis=(3, 5)))[0])
+        return jnp.stack(outs) if outs else jnp.zeros(
+            (0, feat.shape[1], *output_size), feat.dtype)
+    return apply(f, x, boxes, op_name="roi_pool")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (vision/ops.py psroi_pool): channel
+    group (i,j) feeds output bin (i,j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bn = _np(boxes_num)
+    batch_of_roi = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat, bxs):
+        c = feat.shape[1]
+        out_c = c // (oh * ow)
+        outs = []
+        for i, b in enumerate(batch_of_roi):
+            full = _roi_grid_sample(
+                feat[b], bxs[i:i + 1], output_size, spatial_scale,
+                sampling_ratio=2, aligned=False,
+                reducer=lambda v: jnp.mean(v, axis=(3, 5)))[0]  # (C, oh, ow)
+            g = full.reshape(out_c, oh, ow, oh, ow)
+            iy = jnp.arange(oh)[:, None]
+            ix = jnp.arange(ow)[None, :]
+            outs.append(g[:, iy, ix, iy, ix])
+        return jnp.stack(outs) if outs else jnp.zeros(
+            (0, c // (oh * ow), oh, ow), feat.dtype)
+    return apply(f, x, boxes, op_name="psroi_pool")
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._a[0], self._a[1])
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._a[0], self._a[1])
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._a[0], self._a[1])
+
+
+# ---------------- NMS family (host-side selection) ----------------
+
+def _iou_matrix(b):
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    ix1 = np.maximum(x1[:, None], x1[None, :])
+    iy1 = np.maximum(y1[:, None], y1[None, :])
+    ix2 = np.minimum(x2[:, None], x2[None, :])
+    iy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy hard NMS, optionally per-category (vision/ops.py nms). Returns
+    kept indices sorted by score."""
+    b = _np(boxes).astype(np.float64)
+    n = b.shape[0]
+    s = _np(scores).astype(np.float64) if scores is not None \
+        else np.arange(n, 0, -1, dtype=np.float64)
+    iou = _iou_matrix(b)
+
+    def greedy(idxs):
+        order = idxs[np.argsort(-s[idxs])]
+        keep = []
+        while order.size:
+            i = order[0]
+            keep.append(i)
+            order = order[1:][iou[i, order[1:]] <= iou_threshold]
+        return keep
+
+    if category_idxs is None:
+        keep = greedy(np.arange(n))
+    else:
+        cats = _np(category_idxs)
+        keep = []
+        for cval in (categories if categories is not None
+                     else np.unique(cats)):
+            keep += greedy(np.nonzero(cats == cval)[0])
+        keep = sorted(keep, key=lambda i: -s[i])
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(np.asarray(keep, np.int64)))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; vision/ops.py matrix_nms): decay each box's score
+    by its overlap with higher-scored same-class boxes — vectorized, no
+    suppression loop."""
+    bb = _np(bboxes)
+    sc = _np(scores)
+    outs, out_idx, rois_num = [], [], []
+    for b in range(bb.shape[0]):
+        per = []
+        for cls in range(sc.shape[1]):
+            if cls == background_label:
+                continue
+            s = sc[b, cls]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            sel = sel[np.argsort(-s[sel])][:nms_top_k]
+            boxes_c = bb[b, sel]
+            s_c = s[sel]
+            iou = np.triu(_iou_matrix(boxes_c), 1)
+            max_over = iou.max(axis=0)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - max_over[None, :] ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - max_over[None, :],
+                                                1e-10)).min(axis=0)
+            dec_s = s_c * decay
+            for j in np.nonzero(dec_s >= post_threshold)[0]:
+                per.append((cls, dec_s[j], boxes_c[j], sel[j]))
+        per.sort(key=lambda r: -r[1])
+        per = per[:keep_top_k]
+        rois_num.append(len(per))
+        for cls, scv, box, oi in per:
+            outs.append([cls, scv, *box])
+            out_idx.append(oi)
+    out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(out_idx, np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+# ---------------- YOLO ----------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.005,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode a YOLOv3 head to boxes+scores (vision/ops.py yolo_box)."""
+    na = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+
+    def f(v, imgs):
+        n, _, h, w = v.shape
+        v = v.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        sx = jax.nn.sigmoid(v[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(v[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        bx = (gx[None, None, None, :] + sx) / w
+        by = (gy[None, None, :, None] + sy) / h
+        bw = jnp.exp(v[:, :, 2]) * anc[None, :, 0, None, None] \
+            / (downsample_ratio * w)
+        bh = jnp.exp(v[:, :, 3]) * anc[None, :, 1, None, None] \
+            / (downsample_ratio * h)
+        obj = jax.nn.sigmoid(v[:, :, 4])
+        cls = jax.nn.sigmoid(v[:, :, 5:])
+        imgs_f = imgs.astype(jnp.float32)
+        ih = imgs_f[:, 0][:, None, None, None]
+        iw = imgs_f[:, 1][:, None, None, None]
+        x1 = (bx - bw / 2) * iw
+        y1 = (by - bh / 2) * ih
+        x2 = (bx + bw / 2) * iw
+        y2 = (by + bh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        score = (obj[:, :, None] * cls).transpose(0, 1, 3, 4, 2) \
+            .reshape(n, -1, class_num)
+        mask = (obj.reshape(n, -1) > conf_thresh)[..., None]
+        return boxes * mask, score * mask
+    return apply(f, x, img_size, op_name="yolo_box")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (vision/ops.py yolo_loss): xy BCE + wh L1 on assigned
+    anchors, objectness BCE, class BCE — one fused jnp computation."""
+    na = len(anchor_mask)
+    all_anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    anc = all_anc[jnp.asarray(anchor_mask)]
+
+    def bce(p, t):
+        pr = jnp.clip(jax.nn.sigmoid(p), 1e-7, 1 - 1e-7)
+        return -(t * jnp.log(pr) + (1 - t) * jnp.log(1 - pr))
+
+    def f(v, gbox, glab, *gs):
+        n, _, h, w = v.shape
+        v = v.reshape(n, na, 5 + class_num, h, w)
+        stride = downsample_ratio
+        in_w, in_h = w * stride, h * stride
+        gx = gbox[..., 0] * w
+        gy = gbox[..., 1] * h
+        gw = gbox[..., 2] * in_w
+        gh = gbox[..., 3] * in_h
+        inter = jnp.minimum(gw[..., None], all_anc[:, 0]) \
+            * jnp.minimum(gh[..., None], all_anc[:, 1])
+        union = gw[..., None] * gh[..., None] \
+            + all_anc[:, 0] * all_anc[:, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)  # (N, B)
+        valid = (gbox[..., 2] > 0) & (gbox[..., 3] > 0)
+
+        loss = jnp.zeros((n,), v.dtype)
+        obj_target = jnp.zeros((n, na, h, w), v.dtype)
+        bi = jnp.arange(n)[:, None]
+        for k in range(na):                     # static small loop (≤3)
+            a_id = anchor_mask[k]
+            sel = valid & (best == a_id)
+            ci = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+            cj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+            tx = gx - ci
+            ty = gy - cj
+            tw = jnp.log(jnp.maximum(gw / anc[k, 0], 1e-9))
+            th = jnp.log(jnp.maximum(gh / anc[k, 1], 1e-9))
+            scale = 2.0 - gbox[..., 2] * gbox[..., 3]
+            px = v[:, k, 0][bi, cj, ci]
+            py = v[:, k, 1][bi, cj, ci]
+            pw = v[:, k, 2][bi, cj, ci]
+            ph = v[:, k, 3][bi, cj, ci]
+            m = sel.astype(v.dtype)
+            loss = loss + jnp.sum(m * scale * (bce(px, tx) + bce(py, ty)), -1)
+            loss = loss + jnp.sum(
+                m * scale * (jnp.abs(pw - tw) + jnp.abs(ph - th)), -1)
+            obj_target = obj_target.at[bi, k, cj, ci].max(m)
+            pcls = v[:, k, 5:][bi, :, cj, ci]   # (N, B, class)
+            smooth = 1.0 / class_num if use_label_smooth else 0.0
+            tcls = jax.nn.one_hot(glab, class_num, dtype=v.dtype) \
+                * (1 - 2 * smooth) + smooth
+            loss = loss + jnp.sum(m[..., None] * bce(pcls, tcls), (-1, -2))
+        pobj = v[:, :, 4]
+        loss = loss + jnp.sum(obj_target * bce(pobj, 1.0), (1, 2, 3))
+        loss = loss + jnp.sum((1 - obj_target) * bce(pobj, 0.0), (1, 2, 3))
+        return loss
+    args = (x, gt_box, gt_label) + ((gt_score,) if gt_score is not None else ())
+    return apply(f, *args, op_name="yolo_loss")
+
+
+# ---------------- anchors / coding ----------------
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (vision/ops.py prior_box)."""
+    def f(feat, img):
+        h, w = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        sw = steps[0] or iw / w
+        sh = steps[1] or ih / h
+        ars = [1.0]
+        for ar in aspect_ratios:
+            if ar != 1.0:
+                ars.append(float(ar))
+                if flip:
+                    ars.append(1.0 / float(ar))
+        boxes = []
+        for ms in min_sizes:
+            boxes.append((ms, ms))
+            if max_sizes:
+                for mx in max_sizes:
+                    s = math.sqrt(ms * mx)
+                    boxes.append((s, s))
+            for ar in ars:
+                if ar == 1.0:
+                    continue
+                boxes.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        nb = len(boxes)
+        cx = (jnp.arange(w) + offset) * sw
+        cy = (jnp.arange(h) + offset) * sh
+        bw = jnp.asarray([bx[0] for bx in boxes], jnp.float32)
+        bh = jnp.asarray([bx[1] for bx in boxes], jnp.float32)
+        x1 = (cx[None, :, None] - bw / 2) / iw
+        y1 = (cy[:, None, None] - bh / 2) / ih
+        x2 = (cx[None, :, None] + bw / 2) / iw
+        y2 = (cy[:, None, None] + bh / 2) / ih
+        out = jnp.stack([jnp.broadcast_to(a, (h, w, nb))
+                         for a in (x1, y1, x2, y2)], -1)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               (h, w, nb, 4))
+        return out, var
+    return apply(f, input, image, op_name="prior_box")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (vision/ops.py box_coder)."""
+    def core(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            dw = jnp.log(tw[:, None] / pw[None, :])
+            dh = jnp.log(th[:, None] / ph[None, :])
+            out = jnp.stack([dx, dy, dw, dh], -1)
+            if pbv is not None:
+                out = out / pbv[None, :, :]
+            return out
+        deltas = tb
+        if pbv is not None:
+            deltas = deltas * (pbv[None, :, :] if pbv.ndim == 2 else pbv)
+        if axis == 0:
+            pw_, ph_ = pw[None, :], ph[None, :]
+            pcx_, pcy_ = pcx[None, :], pcy[None, :]
+        else:
+            pw_, ph_ = pw[:, None], ph[:, None]
+            pcx_, pcy_ = pcx[:, None], pcy[:, None]
+        cx = deltas[..., 0] * pw_ + pcx_
+        cy = deltas[..., 1] * ph_ + pcy_
+        w2 = jnp.exp(deltas[..., 2]) * pw_
+        h2 = jnp.exp(deltas[..., 3]) * ph_
+        return jnp.stack([cx - w2 / 2, cy - h2 / 2,
+                          cx + w2 / 2 - norm, cy + h2 / 2 - norm], -1)
+
+    if prior_box_var is None:
+        return apply(lambda pb, tb: core(pb, None, tb), prior_box, target_box,
+                     op_name="box_coder")
+    pbv = prior_box_var if isinstance(prior_box_var, Tensor) \
+        else Tensor(jnp.broadcast_to(
+            jnp.asarray(prior_box_var, jnp.float32),
+            (_np(prior_box).shape[0], 4)))
+    return apply(core, prior_box, pbv, target_box, op_name="box_coder")
+
+
+# ---------------- FPN / proposals (host-side selection) ----------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (vision/ops.py
+    distribute_fpn_proposals)."""
+    rois = _np(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+                    * np.maximum(rois[:, 3] - rois[:, 1] + off, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, restore = [], np.zeros(len(rois), np.int64)
+    rois_num_per = []
+    pos = 0
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        restore[idx] = np.arange(pos, pos + len(idx))
+        rois_num_per.append(
+            Tensor(jnp.asarray(np.asarray([len(idx)], np.int32))))
+        pos += len(idx)
+    if rois_num is not None:
+        return multi_rois, Tensor(jnp.asarray(restore.reshape(-1, 1))), \
+            rois_num_per
+    return multi_rois, Tensor(jnp.asarray(restore.reshape(-1, 1)))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (vision/ops.py generate_proposals): decode
+    anchors, clip, filter small, NMS — selection is host-side."""
+    sc = _np(scores)
+    bd = _np(bbox_deltas)
+    ims = _np(img_size)
+    anc = _np(anchors).reshape(-1, 4)
+    var = _np(variances).reshape(-1, 4)
+    n = sc.shape[0]
+    out_rois, out_probs, out_num = [], [], []
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anc[order], var[order]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w2 = np.exp(np.minimum(v[:, 2] * d[:, 2], 10)) * aw
+        h2 = np.exp(np.minimum(v[:, 3] * d[:, 3], 10)) * ah
+        boxes = np.stack([cx - w2 / 2, cy - h2 / 2,
+                          cx + w2 / 2, cy + h2 / 2], -1)
+        ih, iw = ims[b, 0], ims[b, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0]) >= min_size) \
+            & ((boxes[:, 3] - boxes[:, 1]) >= min_size)
+        boxes, s = boxes[keep_sz], s[keep_sz]
+        keep = _np(nms(Tensor(jnp.asarray(boxes)), nms_thresh,
+                       scores=Tensor(jnp.asarray(s))))[:post_nms_top_n]
+        out_rois.append(boxes[keep])
+        out_probs.append(s[keep])
+        out_num.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(out_rois)
+                              if out_rois else np.zeros((0, 4), np.float32)))
+    probs = Tensor(jnp.asarray(np.concatenate(out_probs)
+                               if out_probs else np.zeros(0, np.float32)))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(out_num, np.int32)))
+    return rois, probs
+
+
+# ---------------- deformable conv ----------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (vision/ops.py deform_conv2d): bilinear-sample
+    each kernel tap at its learned offset, then one big matmul — the
+    gather+MXU formulation of the reference's CUDA kernel."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+
+    def f(xv, off, wv, *rest):
+        mk = rest[0] if mask is not None else None
+        n, cin, h, w = xv.shape
+        cout, cin_g, kh, kw = wv.shape
+        oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        base_y = jnp.arange(oh) * sh - ph
+        base_x = jnp.arange(ow) * sw - pw
+        ky = jnp.arange(kh) * dh
+        kx = jnp.arange(kw) * dw
+        py = base_y[:, None, None, None] + ky[None, None, :, None]
+        px = base_x[None, :, None, None] + kx[None, None, None, :]
+        off = off.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+        oy = off[:, :, :, 0].reshape(n, deformable_groups, kh, kw, oh, ow)
+        ox = off[:, :, :, 1].reshape(n, deformable_groups, kh, kw, oh, ow)
+        yy = py.transpose(2, 3, 0, 1)[None, None] + oy
+        xx = px.transpose(2, 3, 0, 1)[None, None] + ox
+
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        wy = yy - y0
+        wx = xx - x0
+
+        ch_per_dg = cin // deformable_groups
+        xg = xv.reshape(n, deformable_groups, ch_per_dg, h, w)
+        xf = xg.reshape(n, deformable_groups, ch_per_dg, h * w)
+
+        def tap(yi, xi):
+            yc = jnp.clip(yi, 0, h - 1)
+            xc = jnp.clip(xi, 0, w - 1)
+            flat = yc * w + xc                   # (n, dg, kh, kw, oh, ow)
+            v = jnp.take_along_axis(
+                xf, flat.reshape(n, deformable_groups, 1, -1), axis=3)
+            v = v.reshape(n, deformable_groups, ch_per_dg, kh, kw, oh, ow)
+            inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            return v * inside[:, :, None].astype(v.dtype)
+
+        sampled = (tap(y0, x0) * ((1 - wy) * (1 - wx))[:, :, None]
+                   + tap(y0, x0 + 1) * ((1 - wy) * wx)[:, :, None]
+                   + tap(y0 + 1, x0) * (wy * (1 - wx))[:, :, None]
+                   + tap(y0 + 1, x0 + 1) * (wy * wx)[:, :, None])
+        if mk is not None:
+            m = mk.reshape(n, deformable_groups, kh, kw, oh, ow)
+            sampled = sampled * m[:, :, None]
+        cols = sampled.reshape(n, cin, kh, kw, oh, ow)
+        if groups == 1:
+            out = jnp.einsum("ncklhw,ockl->nohw", cols,
+                             wv.reshape(cout, cin_g, kh, kw))
+        else:
+            cols_g = cols.reshape(n, groups, cin // groups, kh, kw, oh, ow)
+            wg = wv.reshape(groups, cout // groups, cin_g, kh, kw)
+            out = jnp.einsum("ngcklhw,gockl->ngohw", cols_g, wg) \
+                .reshape(n, cout, oh, ow)
+        if bias is not None:
+            out = out + rest[-1][None, :, None, None]
+        return out
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, op_name="deform_conv2d")
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else kernel_size
+        self._a = (stride, padding, dilation, deformable_groups, groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._a
+        return deform_conv2d(x, offset, self.weight, self.bias, s, p, d, dg,
+                             g, mask)
+
+
+# ---------------- file IO ----------------
+
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode an encoded image byte tensor to CHW uint8 (PIL-backed — the
+    host decode step of the input pipeline)."""
+    import io as _io
+
+    from PIL import Image
+    raw = bytes(_np(x).astype(np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

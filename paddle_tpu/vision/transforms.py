@@ -365,3 +365,285 @@ class Grayscale(BaseTransform):
                                                    np.float32))[..., None]
             g = g.astype(img.dtype)
         return np.repeat(g, self.n, axis=2) if self.n > 1 else g
+
+
+# ---- functional batch 2 (transforms/functional.py parity) ----
+
+def _affine_sample(img, mat_inv, fill=0, interpolation="nearest",
+                   out_size=None):
+    """Sample img at inverse-affine-mapped coordinates (shared by affine /
+    rotate / perspective). mat_inv maps OUTPUT (x, y, 1) -> input (x, y[, w]).
+    out_size=(oh, ow) renders onto a different canvas (rotate expand=True)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    oh, ow = out_size if out_size is not None else (h, w)
+    yy, xx = np.mgrid[0:oh, 0:ow]
+    ones = np.ones_like(xx)
+    coords = np.stack([xx, yy, ones], 0).reshape(3, -1).astype(np.float64)
+    mapped = mat_inv @ coords
+    if mapped.shape[0] == 3 and not np.allclose(mat_inv[2], [0, 0, 1]):
+        mapped = mapped[:2] / np.maximum(np.abs(mapped[2:3]), 1e-9) \
+            * np.sign(mapped[2:3])
+    xs = mapped[0].reshape(oh, ow)
+    ys = mapped[1].reshape(oh, ow)
+    if interpolation == "bilinear":
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        wy = (ys - y0)[..., None]
+        wx = (xs - x0)[..., None]
+
+        def tap(yi, xi):
+            inside = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+            v = img[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)]
+            return np.where(inside[..., None], v.astype(np.float64), fill)
+        out = (tap(y0, x0) * (1 - wy) * (1 - wx)
+               + tap(y0, x0 + 1) * (1 - wy) * wx
+               + tap(y0 + 1, x0) * wy * (1 - wx)
+               + tap(y0 + 1, x0 + 1) * wy * wx)
+        if img.dtype == np.uint8:
+            out = np.clip(np.round(out), 0, 255)
+        return out.astype(img.dtype)
+    ryi = np.round(ys)
+    rxi = np.round(xs)
+    yi = np.clip(ryi.astype(int), 0, h - 1)
+    xi = np.clip(rxi.astype(int), 0, w - 1)
+    # validity on the ROUNDED tap (nearest): float fuzz at the border must
+    # not erase edge pixels on identity warps
+    valid = (ryi >= 0) & (ryi <= h - 1) & (rxi >= 0) & (rxi <= w - 1)
+    out = np.where(valid[..., None], img[yi, xi], fill)
+    return out.astype(img.dtype)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine transform (transforms/functional.py affine): rotate+translate+
+    scale+shear about the center."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None \
+        else (center[1], center[0])
+    rad = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(shear, (list, tuple))
+                                      else (shear, 0.0))]
+    # forward matrix: T(center) R S Shear T(-center) + translate
+    a = np.cos(rad - sy) / np.cos(sy)
+    b = -np.cos(rad - sy) * np.tan(sx) / np.cos(sy) - np.sin(rad)
+    c = np.sin(rad - sy) / np.cos(sy)
+    d = -np.sin(rad - sy) * np.tan(sx) / np.cos(sy) + np.cos(rad)
+    m = scale * np.array([[a, b], [c, d]])
+    mfull = np.eye(3)
+    mfull[:2, :2] = m
+    mfull[0, 2] = cx + translate[0] - m[0] @ [cx, cy]
+    mfull[1, 2] = cy + translate[1] - m[1] @ [cx, cy]
+    return _affine_sample(img, np.linalg.inv(mfull), fill, interpolation)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    if not expand:
+        return affine(img, angle, (0, 0), 1.0, (0.0, 0.0), interpolation,
+                      fill, center)
+    # expand=True: enlarge the canvas to hold the whole rotated image
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    rad = np.deg2rad(angle)
+    ow = int(np.ceil(abs(w * np.cos(rad)) + abs(h * np.sin(rad))))
+    oh = int(np.ceil(abs(w * np.sin(rad)) + abs(h * np.cos(rad))))
+    cy_in, cx_in = (h - 1) / 2, (w - 1) / 2
+    cy_out, cx_out = (oh - 1) / 2, (ow - 1) / 2
+    m = np.array([[np.cos(rad), -np.sin(rad)], [np.sin(rad), np.cos(rad)]])
+    mfull = np.eye(3)
+    mfull[:2, :2] = m
+    # map output center back onto input center
+    mfull[0, 2] = cx_in - m[0] @ [cx_out, cy_out]
+    mfull[1, 2] = cy_in - m[1] @ [cx_out, cy_out]
+    return _affine_sample(img, mfull, fill, interpolation, out_size=(oh, ow))
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Projective warp mapping startpoints -> endpoints
+    (transforms/functional.py perspective)."""
+    src = np.asarray(startpoints, np.float64)
+    dst = np.asarray(endpoints, np.float64)
+    # solve homography dst -> src (inverse map for sampling)
+    A, bvec = [], []
+    for (xs, ys), (xd, yd) in zip(src, dst):
+        A.append([xd, yd, 1, 0, 0, 0, -xs * xd, -xs * yd])
+        bvec.append(xs)
+        A.append([0, 0, 0, xd, yd, 1, -ys * xd, -ys * yd])
+        bvec.append(ys)
+    coef = np.linalg.lstsq(np.asarray(A), np.asarray(bvec), rcond=None)[0]
+    hmat = np.append(coef, 1.0).reshape(3, 3)
+    return _affine_sample(img, hmat, fill, interpolation)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pt = pr = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(img, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kw)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)._apply_image(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    img = _as_hwc(img)
+    out = img.astype(np.float32) * brightness_factor
+    return np.clip(out, 0, 255 if img.dtype == np.uint8 else out.max()) \
+        .astype(img.dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    img = _as_hwc(img)
+    mean = img.astype(np.float32).mean()
+    out = (img.astype(np.float32) - mean) * contrast_factor + mean
+    return np.clip(out, 0, 255 if img.dtype == np.uint8 else out.max()) \
+        .astype(img.dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor in [-0.5, 0.5] turns (functional.py
+    adjust_hue) via RGB->HSV->RGB on the host."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    img = _as_hwc(img)
+    arr = img.astype(np.float32) / (255.0 if img.dtype == np.uint8 else 1.0)
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    mx = arr[..., :3].max(-1)
+    mn = arr[..., :3].min(-1)
+    diff = mx - mn + 1e-10
+    hch = np.where(mx == r, (g - b) / diff % 6,
+                   np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6
+    s = np.where(mx > 0, diff / (mx + 1e-10), 0)
+    v = mx
+    hch = (hch + hue_factor) % 1.0
+    i = np.floor(hch * 6)
+    f = hch * 6 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(int) % 6
+    conds = [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+             np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+             np.stack([t, p, v], -1), np.stack([v, p, q], -1)]
+    out = np.select([(i == k)[..., None] for k in range(6)],
+                    [conds[k] for k in range(6)])
+    if img.dtype == np.uint8:
+        out = (out * 255).round().astype(np.uint8)
+    else:
+        out = out.astype(img.dtype)
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase the region [i:i+h, j:j+w] with value(s) v (functional.py
+    erase). Accepts HWC numpy or CHW Tensors."""
+    from ..core.tensor import Tensor as _T
+    if isinstance(img, _T):
+        import jax.numpy as jnp
+        arr = img._value
+        val = v._value if isinstance(v, _T) else v
+        arr = arr.at[..., i:i + h, j:j + w].set(val)
+        if inplace:
+            img._set_value(arr)
+            return img
+        return _T(arr)
+    out = img if inplace else np.array(img, copy=True)
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+class RandomAffine(BaseTransform):
+    """Random affine (transforms.py RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = (random.uniform(-self.shear, self.shear)
+              if isinstance(self.shear, numbers.Number)
+              else (random.uniform(*self.shear) if self.shear else 0.0))
+        return affine(img, angle, (tx, ty), sc, (sh, 0.0), fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if random.random() > self.prob:
+            return img
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        d = self.distortion_scale
+        tl = (random.uniform(0, d) * w, random.uniform(0, d) * h)
+        tr = (w - 1 - random.uniform(0, d) * w, random.uniform(0, d) * h)
+        br = (w - 1 - random.uniform(0, d) * w, h - 1 - random.uniform(0, d) * h)
+        bl = (random.uniform(0, d) * w, h - 1 - random.uniform(0, d) * h)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return perspective(img, start, [tl, tr, br, bl], fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Random cutout (transforms.py RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if random.random() > self.prob:
+            return img
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                return erase(arr, i, j, eh, ew, self.value,
+                             inplace=self.inplace)
+        return img
+
+
+__all__ += ["RandomAffine", "RandomPerspective", "RandomErasing", "pad",
+            "affine", "rotate", "perspective", "to_grayscale",
+            "adjust_brightness", "adjust_contrast", "adjust_hue", "erase"]

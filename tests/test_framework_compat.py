@@ -103,3 +103,33 @@ def test_set_grad_enabled():
     with P.set_grad_enabled(True):
         z = x * 3
     assert not z.stop_gradient
+
+
+# ---- paddle.device surface (device/__init__.py + device/cuda, L0 runtime) ----
+
+def test_device_memory_stats_api():
+    import paddle_tpu.device as D
+    s = D.memory_stats()
+    assert isinstance(s, dict)  # real counters on TPU; {} on plain CPU
+    assert D.memory_allocated() >= 0
+    assert D.max_memory_allocated() >= D.memory_allocated() or \
+        D.max_memory_allocated() == 0
+    D.synchronize()
+    D.empty_cache()
+    assert "cpu" in D.get_all_device_type()
+    assert D.get_available_device()
+    props = D.cuda.get_device_properties()
+    assert hasattr(props, "total_memory")
+
+
+def test_device_stream_event_api():
+    import paddle_tpu.device as D
+    s1, s2 = D.Stream(), D.Stream(priority=1)
+    ev = s1.record_event()
+    assert ev.query()
+    s2.wait_event(ev)
+    s2.wait_stream(s1)
+    with D.stream_guard(s2) as cur:
+        assert cur is s2
+        assert D.current_stream() is s2
+    assert D.current_stream() is not s2
