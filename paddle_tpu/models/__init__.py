@@ -11,3 +11,4 @@ from .bert import (  # noqa: F401
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
 from .deepfm import DeepFM  # noqa: F401
 from .ocr import DBNet, CRNN, db_loss, ctc_rec_loss  # noqa: F401
+from .detection import YOLOv3, TinyDarknet  # noqa: F401

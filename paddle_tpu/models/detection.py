@@ -1,0 +1,125 @@
+"""Detection model family: a YOLOv3-style single-stage detector.
+
+Fills the detection slot of the reference's model zoo (PaddleDetection's
+yolov3 configs; ops from python/paddle/vision/ops.py). TPU-first: the whole
+forward + loss is one fused jnp graph (conv backbone -> two yolo heads ->
+vision.ops.yolo_loss); box decoding + NMS post-processing run host-side via
+vision.ops.yolo_box/nms, as in a TPU serving stack.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..vision import ops as vops
+
+
+class ConvBNLeaky(nn.Layer):
+    def __init__(self, cin, cout, k=3, stride=1):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride,
+                              padding=k // 2, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = nn.LeakyReLU(0.1)
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class TinyDarknet(nn.Layer):
+    """Small darknet-style backbone: stride-32 and stride-16 feature maps."""
+
+    def __init__(self, width=16):
+        super().__init__()
+        w = width
+        self.stem = nn.Sequential(
+            ConvBNLeaky(3, w), nn.MaxPool2D(2, 2),
+            ConvBNLeaky(w, 2 * w), nn.MaxPool2D(2, 2),
+            ConvBNLeaky(2 * w, 4 * w), nn.MaxPool2D(2, 2),
+            ConvBNLeaky(4 * w, 8 * w), nn.MaxPool2D(2, 2),
+        )
+        self.mid = ConvBNLeaky(8 * w, 16 * w)        # stride 16
+        self.down = nn.MaxPool2D(2, 2)
+        self.deep = ConvBNLeaky(16 * w, 32 * w)      # stride 32
+
+    def forward(self, x):
+        c4 = self.stem(x)
+        p16 = self.mid(c4)
+        p32 = self.deep(self.down(p16))
+        return p16, p32
+
+
+class YOLOv3(nn.Layer):
+    """Two-scale YOLOv3 head on TinyDarknet.
+
+    anchors: flat [w0,h0,w1,h1,...] in input pixels (reference yolo config);
+    anchor_masks: per-scale index lists, deep scale first."""
+
+    def __init__(self, num_classes=20, width=16,
+                 anchors=(10, 14, 23, 27, 37, 58, 81, 82, 135, 169, 344, 319),
+                 anchor_masks=((3, 4, 5), (0, 1, 2)), ignore_thresh=0.7):
+        super().__init__()
+        self.num_classes = num_classes
+        self.anchors = list(anchors)
+        self.anchor_masks = [list(m) for m in anchor_masks]
+        self.ignore_thresh = ignore_thresh
+        self.backbone = TinyDarknet(width)
+        w = width
+        per_anchor = 5 + num_classes
+        self.head32 = nn.Sequential(
+            ConvBNLeaky(32 * w, 16 * w, 1),
+            nn.Conv2D(16 * w, len(anchor_masks[0]) * per_anchor, 1))
+        self.head16 = nn.Sequential(
+            ConvBNLeaky(16 * w, 8 * w, 1),
+            nn.Conv2D(8 * w, len(anchor_masks[1]) * per_anchor, 1))
+
+    def forward(self, x):
+        p16, p32 = self.backbone(x)
+        return [self.head32(p32), self.head16(p16)]  # deep scale first
+
+    def loss(self, outputs, gt_box, gt_label, gt_score=None):
+        """Sum of per-scale yolo_loss (reference yolov3 training loss)."""
+        total = None
+        for out, mask, ds in zip(outputs, self.anchor_masks, (32, 16)):
+            part = vops.yolo_loss(out, gt_box, gt_label, self.anchors, mask,
+                                  self.num_classes, self.ignore_thresh, ds,
+                                  gt_score=gt_score)
+            total = part if total is None else total + part
+        return total.mean()
+
+    def predict(self, x, img_size, conf_thresh=0.1, nms_thresh=0.45,
+                top_k=100):
+        """Decode + per-class NMS (host-side post-processing).
+
+        Returns per-image lists of (class_id, score, x1, y1, x2, y2)."""
+        from ..autograd.grad_mode import no_grad
+        with no_grad():
+            outputs = self(x)
+        boxes_all, scores_all = [], []
+        for out, mask, ds in zip(outputs, self.anchor_masks, (32, 16)):
+            sub_anchors = []
+            for i in mask:
+                sub_anchors += self.anchors[2 * i:2 * i + 2]
+            b, s = vops.yolo_box(out, img_size, sub_anchors, self.num_classes,
+                                 conf_thresh=conf_thresh, downsample_ratio=ds)
+            boxes_all.append(np.asarray(b.numpy()))
+            scores_all.append(np.asarray(s.numpy()))
+        boxes = np.concatenate(boxes_all, axis=1)     # (N, M, 4)
+        scores = np.concatenate(scores_all, axis=1)   # (N, M, C)
+        results = []
+        for n in range(boxes.shape[0]):
+            # multiclass: every (box, class) pair above threshold is a
+            # candidate (reference multiclass_nms), then per-class NMS
+            bi, ci = np.nonzero(scores[n] > conf_thresh)
+            if bi.size == 0:
+                results.append([])
+                continue
+            bx = boxes[n][bi]
+            sc = scores[n][bi, ci]
+            keep = np.asarray(vops.nms(
+                Tensor(bx), nms_thresh, scores=Tensor(sc),
+                category_idxs=Tensor(ci), top_k=top_k).numpy())
+            results.append([(int(ci[k]), float(sc[k]), *bx[k].tolist())
+                            for k in keep])
+        return results

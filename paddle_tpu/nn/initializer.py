@@ -27,6 +27,23 @@ class Initializer:
         raise NotImplementedError
 
 
+def _np_rng(key):
+    """Host-side RNG derived from a jax PRNG key.
+
+    Initialization runs ONCE per parameter but with a distinct shape each
+    time; sampling via jax.random would XLA-compile a kernel per shape
+    (~30s of compiles for a mobilenet on a 1-core host). numpy sampling is
+    instant, and seeding from the key keeps the init chain deterministic
+    under P.seed."""
+    raw = np.asarray(jax.random.key_data(key)).astype(np.uint32).ravel()
+    return np.random.Generator(np.random.Philox(raw.tolist()))
+
+
+def _put(param, arr):
+    param._set_value(jnp.asarray(arr, param._value.dtype))
+    return param
+
+
 class Constant(Initializer):
     def __init__(self, value=0.0):
         self.value = value
@@ -51,10 +68,9 @@ class Uniform(Initializer):
         self.low, self.high = low, high
 
     def __call__(self, param):
-        k = gen.next_key()
-        param._set_value(jax.random.uniform(
-            k, param._value.shape, param._value.dtype, self.low, self.high))
-        return param
+        r = _np_rng(gen.next_key())
+        return _put(param, r.uniform(self.low, self.high,
+                                     param._value.shape))
 
 
 class Normal(Initializer):
@@ -62,10 +78,9 @@ class Normal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, param):
-        k = gen.next_key()
-        v = jax.random.normal(k, param._value.shape, param._value.dtype)
-        param._set_value(self.mean + self.std * v)
-        return param
+        r = _np_rng(gen.next_key())
+        return _put(param, self.mean
+                    + self.std * r.standard_normal(param._value.shape))
 
 
 class TruncatedNormal(Initializer):
@@ -73,11 +88,16 @@ class TruncatedNormal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, param):
-        k = gen.next_key()
-        v = jax.random.truncated_normal(k, -2.0, 2.0, param._value.shape,
-                                        param._value.dtype)
-        param._set_value(self.mean + self.std * v)
-        return param
+        r = _np_rng(gen.next_key())
+        v = r.standard_normal(param._value.shape)
+        # resample out-of-range draws (rejection, matches truncation to 2σ)
+        for _ in range(8):
+            bad = np.abs(v) > 2.0
+            if not bad.any():
+                break
+            v = np.where(bad, r.standard_normal(param._value.shape), v)
+        v = np.clip(v, -2.0, 2.0)
+        return _put(param, self.mean + self.std * v)
 
 
 class XavierUniform(Initializer):
@@ -89,10 +109,8 @@ class XavierUniform(Initializer):
         fi = self.fan_in or fi
         fo = self.fan_out or fo
         limit = self.gain * math.sqrt(6.0 / (fi + fo))
-        k = gen.next_key()
-        param._set_value(jax.random.uniform(
-            k, param._value.shape, param._value.dtype, -limit, limit))
-        return param
+        r = _np_rng(gen.next_key())
+        return _put(param, r.uniform(-limit, limit, param._value.shape))
 
 
 class XavierNormal(Initializer):
@@ -104,10 +122,8 @@ class XavierNormal(Initializer):
         fi = self.fan_in or fi
         fo = self.fan_out or fo
         std = self.gain * math.sqrt(2.0 / (fi + fo))
-        k = gen.next_key()
-        param._set_value(std * jax.random.normal(k, param._value.shape,
-                                                 param._value.dtype))
-        return param
+        r = _np_rng(gen.next_key())
+        return _put(param, std * r.standard_normal(param._value.shape))
 
 
 class KaimingUniform(Initializer):
@@ -120,10 +136,8 @@ class KaimingUniform(Initializer):
         fi = self.fan_in or fi
         gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
         limit = gain * math.sqrt(3.0 / fi)
-        k = gen.next_key()
-        param._set_value(jax.random.uniform(
-            k, param._value.shape, param._value.dtype, -limit, limit))
-        return param
+        r = _np_rng(gen.next_key())
+        return _put(param, r.uniform(-limit, limit, param._value.shape))
 
 
 class KaimingNormal(Initializer):
@@ -136,10 +150,8 @@ class KaimingNormal(Initializer):
         fi = self.fan_in or fi
         gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
         std = gain / math.sqrt(fi)
-        k = gen.next_key()
-        param._set_value(std * jax.random.normal(k, param._value.shape,
-                                                 param._value.dtype))
-        return param
+        r = _np_rng(gen.next_key())
+        return _put(param, std * r.standard_normal(param._value.shape))
 
 
 class Orthogonal(Initializer):

@@ -132,6 +132,27 @@ def _scan_nan_inf(out, multi, name):
                 f"(FLAGS_check_nan_inf is enabled)")
 
 
+def _op_error(name, vals, exc):
+    """Re-raise an op failure with the enforce-style context the reference's
+    PADDLE_ENFORCE adds (paddle/common/enforce.h): op name + input summary.
+    The original exception stays chained for the full jax detail."""
+    def sig(v):
+        try:
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                return f"{v.dtype}{list(v.shape)}"
+            return repr(v)[:40]
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            return "<unprintable>"
+    ins = ", ".join(sig(v) for v in vals)
+    msg = (f"(InvalidArgument) operator {name!r} failed on inputs ({ins}): "
+           f"{exc}")
+    try:
+        wrapped = type(exc)(msg)
+    except Exception:  # noqa: BLE001 — exc type with a custom constructor
+        wrapped = ValueError(msg)
+    raise wrapped from exc
+
+
 def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
     """Execute `jax_fn(*arrays, **static_kwargs)` over Tensor args with tape recording.
 
@@ -164,7 +185,10 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
         _t0 = _time.perf_counter_ns()
 
     if not diff_idx or not is_grad_enabled():
-        raw = jax_fn(*vals, **static_kwargs)
+        try:
+            raw = jax_fn(*vals, **static_kwargs)
+        except (TypeError, ValueError, IndexError) as e:
+            _op_error(name, vals, e)
         out, multi = _wrap_outputs(raw, name)
         if prof is not None:
             prof(name, _t0, _time.perf_counter_ns())
@@ -180,7 +204,10 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
             vv[i] = dv[k]
         return jax_fn(*vv, **static_kwargs)
 
-    raw, vjp_fn = jax.vjp(f, *diff_vals)
+    try:
+        raw, vjp_fn = jax.vjp(f, *diff_vals)
+    except (TypeError, ValueError, IndexError) as e:
+        _op_error(name, vals, e)
     out, multi = _wrap_outputs(raw, name)
 
     outs_list = list(out) if multi else [out]

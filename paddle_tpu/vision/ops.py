@@ -363,6 +363,8 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             pw = v[:, k, 2][bi, cj, ci]
             ph = v[:, k, 3][bi, cj, ci]
             m = sel.astype(v.dtype)
+            if gs:  # mixup: fractional gt confidence weights the positives
+                m = m * gs[0].astype(v.dtype)
             loss = loss + jnp.sum(m * scale * (bce(px, tx) + bce(py, ty)), -1)
             loss = loss + jnp.sum(
                 m * scale * (jnp.abs(pw - tw) + jnp.abs(ph - th)), -1)
@@ -372,9 +374,42 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             tcls = jax.nn.one_hot(glab, class_num, dtype=v.dtype) \
                 * (1 - 2 * smooth) + smooth
             loss = loss + jnp.sum(m[..., None] * bce(pcls, tcls), (-1, -2))
+        # objectness: positives to 1; negatives to 0 EXCEPT cells whose best
+        # decoded-box IoU with any gt exceeds ignore_thresh (reference
+        # yolov3_loss ignore region)
         pobj = v[:, :, 4]
+        gx_c = jnp.arange(w, dtype=v.dtype)
+        gy_c = jnp.arange(h, dtype=v.dtype)
+        px_c = (jax.nn.sigmoid(v[:, :, 0]) + gx_c[None, None, None, :]) / w
+        py_c = (jax.nn.sigmoid(v[:, :, 1]) + gy_c[None, None, :, None]) / h
+        pw_c = jnp.exp(jnp.clip(v[:, :, 2], -10, 10)) \
+            * anc[None, :, 0, None, None] / in_w
+        ph_c = jnp.exp(jnp.clip(v[:, :, 3], -10, 10)) \
+            * anc[None, :, 1, None, None] / in_h
+        # IoU of every predicted cell box vs every gt (normalized coords)
+        px1, px2 = px_c - pw_c / 2, px_c + pw_c / 2
+        py1, py2 = py_c - ph_c / 2, py_c + ph_c / 2
+        gx1 = (gbox[..., 0] - gbox[..., 2] / 2)
+        gx2 = (gbox[..., 0] + gbox[..., 2] / 2)
+        gy1 = (gbox[..., 1] - gbox[..., 3] / 2)
+        gy2 = (gbox[..., 1] + gbox[..., 3] / 2)
+        def gt_last(a):  # (N, B) -> (N, 1, 1, 1, B) for cell-vs-gt broadcast
+            return a[:, None, None, None, :]
+        ix1 = jnp.maximum(px1[..., None], gt_last(gx1))
+        ix2 = jnp.minimum(px2[..., None], gt_last(gx2))
+        iy1 = jnp.maximum(py1[..., None], gt_last(gy1))
+        iy2 = jnp.minimum(py2[..., None], gt_last(gy2))
+        inter_a = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+        area_p = (px2 - px1) * (py2 - py1)
+        area_g = gt_last(gbox[..., 2] * gbox[..., 3])
+        iou = inter_a / jnp.maximum(area_p[..., None] + area_g - inter_a,
+                                    1e-10)
+        iou = jnp.where(gt_last(valid), iou, 0.0)
+        best_iou = jnp.max(iou, axis=-1)           # (N, na, h, w)
+        ignore = (best_iou > ignore_thresh).astype(v.dtype)
         loss = loss + jnp.sum(obj_target * bce(pobj, 1.0), (1, 2, 3))
-        loss = loss + jnp.sum((1 - obj_target) * bce(pobj, 0.0), (1, 2, 3))
+        loss = loss + jnp.sum((1 - obj_target) * (1 - ignore)
+                              * bce(pobj, 0.0), (1, 2, 3))
         return loss
     args = (x, gt_box, gt_label) + ((gt_score,) if gt_score is not None else ())
     return apply(f, *args, op_name="yolo_loss")

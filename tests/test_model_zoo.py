@@ -167,3 +167,37 @@ def test_dbnet_non_multiple_of_32_input():
     model = DBNet(in_channels=3, base=8)
     out = model(P.randn([1, 3, 72, 72]))
     assert out["maps"].shape[0] == 1
+
+
+def test_yolov3_trains_and_predicts():
+    """Detection family (PaddleDetection yolov3 slot): the fused
+    yolo_loss must decrease under training on a fixed synthetic batch, and
+    predict() must run decode+NMS end to end."""
+    import numpy as np
+
+    import paddle_tpu as P
+    from paddle_tpu.models import YOLOv3
+
+    P.seed(0)
+    rng = np.random.RandomState(0)
+    model = YOLOv3(num_classes=4, width=4)
+    opt = P.optimizer.Adam(learning_rate=2e-3,
+                           parameters=model.parameters())
+    x = P.to_tensor(rng.rand(2, 3, 64, 64).astype("f"))
+    gt_box = P.to_tensor(rng.rand(2, 3, 4).astype("f") * 0.4 + 0.3)
+    gt_label = P.to_tensor(rng.randint(0, 4, (2, 3)))
+    losses = []
+    for _ in range(8):
+        loss = model.loss(model(x), gt_box, gt_label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses
+    model.eval()
+    dets = model.predict(x, P.to_tensor(np.array([[64, 64], [64, 64]])),
+                         conf_thresh=0.0, top_k=5)
+    assert len(dets) == 2
+    for per_img in dets:
+        for cls_id, score, x1, y1, x2, y2 in per_img[:3]:
+            assert 0 <= cls_id < 4 and np.isfinite(score)

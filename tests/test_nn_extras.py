@@ -347,3 +347,57 @@ def test_dynamic_decode_lengths_align_with_beams():
                 assert seq[L - 1] == 3
             else:
                 assert L == len(seq)
+
+
+# ---- numeric-gradient OpTests for the heavy new functionals ----
+
+from op_test import OpTest  # noqa: E402
+
+
+def test_grid_sample_grad_numeric():
+    rng2 = np.random.RandomState(3)
+    img = rng2.randn(1, 2, 5, 5).astype("f")
+    # keep sample points interior so finite differences stay smooth
+    grid = (rng2.rand(1, 3, 3, 2).astype("f") - 0.5) * 1.2
+    OpTest.check_grad(F.grid_sample, [img, grid], wrt=(0, 1), eps=1e-4)
+
+
+def test_max_unpool2d_grad_numeric():
+    rng2 = np.random.RandomState(4)
+    x = rng2.randn(1, 2, 4, 4).astype("f")
+    p, idx = F.max_pool2d(t(x), 2, 2, return_mask=True)
+
+    def op(pv):
+        return F.max_unpool2d(pv, idx, 2, 2)
+    OpTest.check_grad(op, [p.numpy()], wrt=(0,), eps=1e-4)
+
+
+def test_rnnt_loss_grad_numeric():
+    rng2 = np.random.RandomState(5)
+    acts = rng2.randn(1, 3, 3, 4).astype("f") * 0.5
+
+    def op(a):
+        return F.rnnt_loss(a, t([[1, 2]]), t([3]), t([2]),
+                           fastemit_lambda=0.0, reduction="sum")
+    OpTest.check_grad(op, [acts], wrt=(0,), eps=1e-3, rtol=8e-2)
+
+
+def test_deform_conv2d_grad_numeric():
+    from paddle_tpu.vision.ops import deform_conv2d
+    rng2 = np.random.RandomState(6)
+    x = rng2.randn(1, 1, 5, 5).astype("f")
+    w = rng2.randn(2, 1, 3, 3).astype("f")
+    off = (rng2.rand(1, 18, 3, 3).astype("f") - 0.5) * 0.3
+    OpTest.check_grad(deform_conv2d, [x, off, w], wrt=(0, 2), eps=1e-4)
+
+
+def test_pairwise_and_losses_grad_numeric():
+    rng2 = np.random.RandomState(7)
+    a, b = rng2.randn(3, 4).astype("f"), rng2.randn(3, 4).astype("f")
+    OpTest.check_grad(F.pairwise_distance, [a, b], wrt=(0, 1), eps=1e-4)
+    x = rng2.randn(5).astype("f")
+    y = np.sign(rng2.randn(5)).astype("f")
+    OpTest.check_grad(F.soft_margin_loss, [x, y], wrt=(0,), eps=1e-4)
+    v = rng2.rand(5).astype("f") + 0.5
+    OpTest.check_grad(lambda p, l, vv: F.gaussian_nll_loss(p, l, vv),
+                      [x, y, v], wrt=(0, 2), eps=1e-4)

@@ -182,3 +182,15 @@ def test_pad():
     out = P.nn.functional.pad(P.to_tensor(x), [1, 1, 1, 1])
     assert out.shape == [1, 1, 4, 4]
     assert out.numpy().sum() == 4.0
+
+
+def test_op_errors_carry_enforce_context():
+    """Enforce-style diagnostics (paddle/common/enforce.h analog): failed ops
+    name themselves and summarize input signatures, chaining the jax error."""
+    import pytest
+
+    with pytest.raises((TypeError, ValueError)) as ei:
+        P.matmul(P.ones([2, 3]), P.ones([2, 3]))
+    msg = str(ei.value)
+    assert "matmul" in msg and "float32[2, 3]" in msg
+    assert ei.value.__cause__ is not None  # original jax error chained
