@@ -121,3 +121,62 @@ def send_uv(x, y, src_index, dst_index, message_op: str = "add"):
             jnp.take(u, src.astype(jnp.int32), axis=0),
             jnp.take(v, dst.astype(jnp.int32), axis=0))
     return apply(f, x, y, src_index, dst_index, op_name="send_uv")
+
+
+# ---- graph reindex/sampling surface (reference python/paddle/geometric/
+# reindex.py, sampling/neighbors.py) — shared with incubate graph ops ----
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    from ..incubate.ops import graph_reindex
+    return graph_reindex(x, neighbors, count)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: per-type neighbor/count lists reindexed against
+    one shared node table (reference geometric/reindex.py:214)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    xs = np.asarray(x.numpy() if isinstance(x, Tensor) else x).ravel()
+    order = {int(v): i for i, v in enumerate(xs)}
+    all_src, all_dst = [], []
+    for nb, ct in zip(neighbors, count):
+        nbv = np.asarray(nb.numpy() if isinstance(nb, Tensor) else nb).ravel()
+        ctv = np.asarray(ct.numpy() if isinstance(ct, Tensor) else ct).ravel()
+        for v in nbv:
+            order.setdefault(int(v), len(order))
+        all_src.append(np.asarray([order[int(v)] for v in nbv], np.int64))
+        all_dst.append(np.repeat(np.arange(len(ctv), dtype=np.int64), ctv))
+    nodes = np.asarray(sorted(order, key=order.get), np.int64)
+    return (Tensor(jnp.asarray(np.concatenate(all_src))),
+            Tensor(jnp.asarray(np.concatenate(all_dst))),
+            Tensor(jnp.asarray(nodes)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    from ..incubate.ops import graph_sample_neighbors
+    return graph_sample_neighbors(row, colptr, input_nodes,
+                                  sample_size=sample_size, eids=eids,
+                                  return_eids=return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional neighbor sampling
+    (reference geometric/sampling/neighbors.py weighted_sample_neighbors):
+    zero-weight edges are never selected. Delegates to the shared incubate
+    sampler (one CSC loop for both entry points)."""
+    from ..incubate.ops import graph_sample_neighbors
+    return graph_sample_neighbors(row, colptr, input_nodes,
+                                  sample_size=sample_size, eids=eids,
+                                  return_eids=return_eids,
+                                  edge_weight=edge_weight)
+
+
+__all__ += ["reindex_graph", "reindex_heter_graph", "sample_neighbors",
+            "weighted_sample_neighbors"]

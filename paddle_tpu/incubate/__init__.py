@@ -2,6 +2,8 @@
 transformer layers/functionals, incl. fused_rotary_position_embedding and
 masked_multihead_attention decode)."""
 from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import optimizer  # noqa: F401
 from . import nn  # noqa: F401
 from .ops import (  # noqa: F401
     LookAhead, ModelAverage, graph_khop_sampler, graph_reindex,

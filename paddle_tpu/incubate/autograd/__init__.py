@@ -1,0 +1,44 @@
+"""paddle.incubate.autograd (reference python/paddle/incubate/autograd/):
+functional transforms + the prim switch.
+
+The "prim" program transform decomposes big ops into primitives so the
+compiler stack can differentiate/fuse them — under XLA that decomposition IS
+how every op already executes (jax primitives), so enable/disable_prim are
+honest no-op toggles kept for API parity."""
+from ...autograd.functional import Hessian, Jacobian, jvp, vjp  # noqa: F401
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+_prim_enabled = False
+
+
+def enable_prim():
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
+
+
+def prim_enabled():
+    return _prim_enabled
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode AD (incubate/autograd/primapi.py forward_grad): jvp of
+    a callable w.r.t. inputs."""
+    if callable(outputs):
+        _, tangents = jvp(outputs, inputs, grad_inputs)
+        return tangents
+    raise NotImplementedError(
+        "forward_grad over recorded static programs: use the functional "
+        "form forward_grad(fn, inputs, tangents)")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Reverse-mode grad (incubate/autograd/primapi.py grad)."""
+    from ...autograd.backward import grad as _grad
+    return _grad(outputs, inputs, grad_outputs)

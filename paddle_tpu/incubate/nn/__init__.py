@@ -8,6 +8,9 @@ from .layer import (  # noqa: F401
     FusedMultiTransformer,
     FusedTransformerEncoderLayer,
 )
+from .layer.fused_misc import (  # noqa: F401
+    FusedDropoutAdd, FusedEcMoe, FusedLinear,
+)
 
 __all__ = [
     "functional",

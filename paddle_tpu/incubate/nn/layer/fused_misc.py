@@ -1,0 +1,96 @@
+"""Fused misc layers (reference python/paddle/incubate/nn/layer/
+fused_linear.py:19, fused_dropout_add.py:19, fused_ec_moe.py:19).
+
+On TPU "fused" means expressed as one jnp composition so XLA fuses it; the
+EcMoe layer additionally keeps the expert dim as a single batched einsum so
+all experts ride one MXU matmul."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.generator import default_generator
+from ....nn.layer.layers import Layer
+from ....ops.dispatch import apply
+
+
+class FusedLinear(Layer):
+    """Linear whose matmul+bias lowers as one fused op
+    (incubate/nn/layer/fused_linear.py)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = [out_features, in_features] if transpose_weight \
+            else [in_features, out_features]
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        from ....incubate.nn.functional import fused_linear
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """dropout(x) + y in one fused computation
+    (incubate/nn/layer/fused_dropout_add.py)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        if not self.training or self.p == 0:
+            from ....ops.math import add
+            return add(x, y)
+        key = default_generator().next_key()
+        p, mode = self.p, self.mode
+
+        def f(xv, yv):
+            keep = jax.random.bernoulli(key, 1.0 - p, xv.shape)
+            if mode == "upscale_in_train":
+                xd = jnp.where(keep, xv / (1.0 - p), 0.0)
+            else:
+                xd = jnp.where(keep, xv, 0.0)
+            return xd + yv
+        return apply(f, x, y, op_name="fused_dropout_add")
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedEcMoe(Layer):
+    """Expert-choice MoE feed-forward as ONE pair of batched einsums over the
+    expert dim (incubate/nn/layer/fused_ec_moe.py): gate-weighted mixture of
+    per-expert FFNs, no token routing scatter."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"unsupported act_type {act_type!r}")
+        self.act_type = act_type
+        self.bmm_weight0 = self.create_parameter(
+            [num_experts, hidden_size, inter_size], attr=weight_attr)
+        self.bmm_bias0 = self.create_parameter(
+            [num_experts, 1, inter_size], attr=bias_attr, is_bias=True)
+        self.bmm_weight1 = self.create_parameter(
+            [num_experts, inter_size, hidden_size], attr=weight_attr)
+        self.bmm_bias1 = self.create_parameter(
+            [num_experts, 1, hidden_size], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate):
+        act = jax.nn.gelu if self.act_type == "gelu" else jax.nn.relu
+
+        def f(xv, gv, w0, b0, w1, b1):
+            probs = jax.nn.softmax(gv, -1)                    # (B, S, E)
+            h = jnp.einsum("bsd,edi->bsei", xv, w0) + b0[:, 0]
+            h = act(h)
+            out = jnp.einsum("bsei,eih->bseh", h, w1) + b1[:, 0]
+            return jnp.einsum("bseh,bse->bsh", out, probs)
+        return apply(f, x, gate, self.bmm_weight0, self.bmm_bias0,
+                     self.bmm_weight1, self.bmm_bias1, op_name="fused_ec_moe")

@@ -102,25 +102,61 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum",
 
 def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                            eids=None, return_eids=False, perm_buffer=None,
-                           flag_perm_buffer=False, name=None):
+                           flag_perm_buffer=False, edge_weight=None,
+                           name=None):
     """Sample up to `sample_size` neighbors per input node from a CSC graph
     (incubate/operators/graph_sample_neighbors.py). Host-side (numpy): graph
-    sampling is an input-pipeline step, not a device kernel, on TPU."""
-    rown = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
-    cptr = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
-    nodes = np.asarray(input_nodes.numpy()
-                       if isinstance(input_nodes, Tensor) else input_nodes)
-    out_neighbors, out_count = [], []
-    rng = np.random.RandomState()
+    sampling is an input-pipeline step, not a device kernel, on TPU.
+
+    edge_weight: optional per-edge weights — sampling is weight-proportional
+    and zero-weight edges are never selected (the weighted_sample_neighbors
+    semantics; both geometric entry points delegate here).
+    Returns (neighbors, counts) or (neighbors, counts, out_eids) when
+    return_eids=True (eids aligned with `row`)."""
+    def _arr(x):
+        return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+    rown = _arr(row)
+    cptr = _arr(colptr)
+    nodes = _arr(input_nodes)
+    wts = _arr(edge_weight).astype(np.float64) \
+        if edge_weight is not None else None
+    eid_arr = _arr(eids) if eids is not None else None
+    if return_eids and eid_arr is None:
+        raise ValueError("return_eids=True requires eids")
+    # deterministic under P.seed, like nn/initializer._np_rng
+    from ..core.generator import default_generator
+    import jax as _jax
+    raw = np.asarray(_jax.random.key_data(
+        default_generator().next_key())).astype(np.uint32).ravel()
+    rng = np.random.Generator(np.random.Philox(raw.tolist()))
+
+    out_neighbors, out_count, out_eids = [], [], []
     for n in nodes.ravel():
         beg, end = int(cptr[n]), int(cptr[n + 1])
-        neigh = rown[beg:end]
-        if 0 <= sample_size < len(neigh):
-            neigh = rng.choice(neigh, size=sample_size, replace=False)
-        out_neighbors.append(neigh)
-        out_count.append(len(neigh))
-    flat = np.concatenate(out_neighbors) if out_neighbors else np.zeros(0, rown.dtype)
-    return Tensor(jnp.asarray(flat)), Tensor(jnp.asarray(np.asarray(out_count)))
+        idx = np.arange(beg, end)
+        if wts is not None:
+            idx = idx[wts[beg:end] > 0]  # zero-weight edges never sampled
+        k = sample_size
+        if 0 <= k < len(idx):
+            if wts is not None:
+                w = wts[idx]
+                idx = rng.choice(idx, size=k, replace=False, p=w / w.sum())
+            else:
+                idx = rng.choice(idx, size=k, replace=False)
+        out_neighbors.append(rown[idx])
+        out_count.append(len(idx))
+        if return_eids:
+            out_eids.append(eid_arr[idx])
+    flat = np.concatenate(out_neighbors) if out_neighbors \
+        else np.zeros(0, rown.dtype)
+    result = (Tensor(jnp.asarray(flat)),
+              Tensor(jnp.asarray(np.asarray(out_count))))
+    if return_eids:
+        flat_e = np.concatenate(out_eids) if out_eids \
+            else np.zeros(0, np.int64)
+        result = result + (Tensor(jnp.asarray(flat_e)),)
+    return result
 
 
 def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,

@@ -1,0 +1,8 @@
+"""paddle.incubate.optimizer (reference python/paddle/incubate/optimizer/):
+LBFGS (promoted to paddle.optimizer in newer reference versions; exported
+here for incubate-path imports), plus the lookahead/model-average wrappers
+living at paddle.incubate top level."""
+from ...optimizer.lbfgs import LBFGS  # noqa: F401
+from ..ops import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["LBFGS"]

@@ -8,7 +8,8 @@ collected by the native C++ tracer (csrc/runtime.cc); device-side profiling
 rides jax.profiler (XPlane) when a trace dir is given.
 """
 from .profiler import (  # noqa: F401
-    Profiler, ProfilerState, ProfilerTarget, RecordEvent, make_scheduler,
-    export_chrome_tracing, load_profiler_result,
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, SortedKeys,
+    SummaryView, export_chrome_tracing, export_protobuf,
+    load_profiler_result, make_scheduler,
 )
 from .timer import benchmark  # noqa: F401

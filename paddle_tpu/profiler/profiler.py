@@ -274,3 +274,49 @@ class Profiler:
                 thread_sep: bool = False, time_unit: str = "ms"):
         from .statistic import summary as _summary
         return _summary(self._events, sorted_by=sorted_by, time_unit=time_unit)
+
+
+class SortedKeys:
+    """Summary-sort keys (reference profiler/profiler.py SortedKeys enum)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """Summary-view selector (reference profiler/profiler.py SummaryView)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory writing the trace in a protobuf-style binary
+    container (reference export_protobuf). The chrome-trace JSON remains the
+    primary format; this wraps the same events length-prefixed so external
+    tooling gets a stable binary artifact."""
+    import os
+    import struct
+    import time as _time
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(_time.time())}.pb")
+        data = json.dumps({"traceEvents": prof.events(),
+                           "displayTimeUnit": "ms"}).encode()
+        with open(path, "wb") as f:
+            f.write(b"PTPF\x01" + struct.pack("<Q", len(data)) + data)
+        return path
+    return handler

@@ -7,7 +7,8 @@ XLA fuses into adjacent ops; int8 deployment is a compiler concern.
 """
 from .config import QuantConfig  # noqa: F401
 from .quanters import (  # noqa: F401
-    FakeQuanterWithAbsMaxObserver, AbsmaxObserver, fake_quant_abs_max,
+    AbsmaxObserver, BaseObserver, BaseQuanter, FakeQuanterWithAbsMaxObserver,
+    fake_quant_abs_max, quanter,
 )
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401

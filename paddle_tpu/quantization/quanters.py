@@ -74,3 +74,64 @@ class FakeQuanterWithAbsMaxObserver(Layer):
                 self.scale._value = new[None].astype(jnp.float32)
         return fake_quant_abs_max(x, Tensor(self.scale._value),
                                   self.bit_length)
+
+
+class BaseObserver(Layer):
+    """Observer base (reference quantization/base_observer.py): tracks
+    statistics during calibration; subclasses implement forward + scales."""
+
+    def __init__(self):
+        super().__init__()
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+
+class BaseQuanter(Layer):
+    """Quanter base (reference quantization/base_quanter.py): fake-quant
+    layers used in QAT; subclasses implement forward + scales."""
+
+    def __init__(self):
+        super().__init__()
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+
+class _QuanterFactory:
+    """Partial-construction wrapper produced by @quanter (reference
+    quantization/factory.py): holds the layer class + deferred args; QAT
+    instantiates per-layer via _instance()."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def __call__(self, *args, **kwargs):
+        return _QuanterFactory(self.cls, *args, **kwargs)
+
+    def _instance(self, layer=None):
+        return self.cls(*self.args, **self.kwargs)
+
+
+def quanter(class_name):
+    """Declare a factory alias for a quanter class (factory.py:76): the
+    decorated class stays usable directly, and `class_name` becomes a
+    factory constructible with deferred args."""
+    import sys
+
+    def decorator(cls):
+        factory = _QuanterFactory(cls)
+        mod = sys.modules[cls.__module__]
+        setattr(mod, class_name, factory)
+        import paddle_tpu.quantization as qmod
+        setattr(qmod, class_name, factory)
+        return cls
+    return decorator

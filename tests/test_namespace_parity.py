@@ -235,6 +235,11 @@ def test_vision_image_backend(tmp_path):
 
 SECONDARY = [
     ("incubate", "incubate"), ("utils", "utils"),
+    ("incubate/nn", "incubate.nn"), ("incubate/autograd", "incubate.autograd"),
+    ("incubate/optimizer", "incubate.optimizer"),
+    ("quantization", "quantization"), ("geometric", "geometric"),
+    ("profiler", "profiler"), ("distribution/transform",
+                               "distribution.transform"),
     ("nn/initializer", "nn.initializer"), ("nn/utils", "nn.utils"),
     ("hub", "hub"), ("inference", "inference"), ("callbacks", "callbacks"),
     ("vision/transforms", "vision.transforms"), ("vision/ops", "vision.ops"),
@@ -381,3 +386,68 @@ def test_callbacks_reduce_lr_and_visualdl(tmp_path):
     v = C.VisualDL(log_dir=str(tmp_path))
     v.on_train_batch_end(0, {"loss": 0.5})
     assert (tmp_path / "scalars.jsonl").exists()
+
+
+def test_quanter_factory_and_incubate_nn():
+    from paddle_tpu.quantization import BaseQuanter, quanter
+
+    @quanter("ParityQ")
+    class ParityQuanterLayer(BaseQuanter):
+        def __init__(self, bits=8):
+            super().__init__()
+            self.bits = bits
+
+        def forward(self, x):
+            return x
+
+    import paddle_tpu.quantization as Q
+    assert Q.ParityQ(bits=4)._instance().bits == 4
+    import paddle_tpu.incubate as I
+    fl = I.nn.FusedLinear(4, 3)
+    x = P.randn([2, 4])
+    np.testing.assert_allclose(
+        fl(x).numpy(), x.numpy() @ fl.weight.numpy() + fl.bias.numpy(),
+        rtol=1e-5)
+    moe = I.nn.FusedEcMoe(8, 16, 4, act_type="gelu")
+    out = moe(P.randn([2, 3, 8]), P.zeros([2, 3, 4]))
+    out.sum().backward()
+    assert moe.bmm_weight0.grad is not None
+
+
+def test_geometric_sampling_delegates():
+    colptr = P.to_tensor(np.array([0, 2, 3, 4]))
+    row = P.to_tensor(np.array([1, 2, 0, 1]))
+    nb, cnt = P.geometric.sample_neighbors(row, colptr,
+                                           P.to_tensor(np.array([0])))
+    assert sorted(nb.numpy().tolist()) == [1, 2]
+    w = P.to_tensor(np.array([1.0, 0.0, 1.0, 1.0]))
+    nbw, _ = P.geometric.weighted_sample_neighbors(
+        row, colptr, w, P.to_tensor(np.array([0])), sample_size=1)
+    assert nbw.numpy().tolist() == [1]  # zero-weight edge never sampled
+
+
+def test_graph_sampling_weighted_degenerate_and_eids():
+    colptr = P.to_tensor(np.array([0, 3, 4, 5]))
+    row = P.to_tensor(np.array([1, 2, 0, 1, 0]))
+    w = P.to_tensor(np.array([1.0, 0.0, 0.0, 1.0, 1.0]))
+    # fewer positive-weight neighbors than sample_size: all positives, no crash
+    nb, cnt = P.geometric.weighted_sample_neighbors(
+        row, colptr, w, P.to_tensor(np.array([0])), sample_size=2)
+    assert nb.numpy().tolist() == [1] and cnt.numpy().tolist() == [1]
+    # deterministic under P.seed
+    P.seed(11)
+    a = P.geometric.sample_neighbors(row, colptr, P.to_tensor(np.array([0])),
+                                     sample_size=2)[0].numpy().tolist()
+    P.seed(11)
+    b = P.geometric.sample_neighbors(row, colptr, P.to_tensor(np.array([0])),
+                                     sample_size=2)[0].numpy().tolist()
+    assert a == b
+    # eids round-trip + loud error without them
+    eids = P.to_tensor(np.arange(5) + 100)
+    _, _, oe = P.geometric.sample_neighbors(
+        row, colptr, P.to_tensor(np.array([1])), eids=eids, return_eids=True)
+    assert oe.numpy().tolist() == [103]
+    with pytest.raises(ValueError, match="eids"):
+        P.geometric.sample_neighbors(row, colptr,
+                                     P.to_tensor(np.array([1])),
+                                     return_eids=True)
