@@ -19,6 +19,4 @@ from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, ParallelMode, get_hcg, set_hcg,
 )
 
-# paddle-compat: fleet.utils.recompute
-class utils:  # noqa: N801
-    from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import utils  # noqa: F401  (fleet.utils: LocalFS/recompute/...)

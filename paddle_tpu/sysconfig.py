@@ -1,0 +1,17 @@
+"""paddle.sysconfig (python/paddle/sysconfig.py): header/library dirs for
+building extensions against the framework (here: the csrc flat-C-ABI dir)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    return os.path.join(_PKG, "csrc")
+
+
+def get_lib() -> str:
+    return os.path.join(_PKG, "csrc")

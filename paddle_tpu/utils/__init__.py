@@ -14,3 +14,4 @@ def run_check():
           f"({float(y[0, 0])} == 128.0)")
     return True
 from .compat import deprecated, require_version, try_import  # noqa: E402,F401
+from . import dlpack  # noqa: E402,F401

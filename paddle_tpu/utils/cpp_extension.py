@@ -38,8 +38,7 @@ def _compile(name: str, sources: Sequence[str], extra_cxx_cflags=(),
              verbose: bool = False) -> str:
     import hashlib
     import tempfile
-    build_dir = build_directory or os.path.join(
-        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions", name)
+    build_dir = build_directory or os.path.join(get_build_directory(), name)
     os.makedirs(build_dir, exist_ok=True)
     srcs = [os.path.abspath(s) for s in sources]
     # flags AND source paths participate in the cache key so a same-named
@@ -228,3 +227,14 @@ class CppExtension:
 
 
 CUDAExtension = CppExtension  # CUDA sources are rejected at compile time
+
+
+def get_build_directory(verbose=False):
+    """Extension build/cache dir (reference utils/cpp_extension/extension_utils.py
+    get_build_directory): honors PADDLE_EXTENSION_DIR; _compile() uses this
+    as its default root so the reported dir IS the one used."""
+    root = os.environ.get(
+        "PADDLE_EXTENSION_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu_extensions"))
+    os.makedirs(root, exist_ok=True)
+    return root

@@ -1,0 +1,20 @@
+"""paddle.utils.dlpack (python/paddle/utils/dlpack.py): zero-copy tensor
+exchange via the DLPack protocol — jax arrays speak DLPack natively."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x: Tensor):
+    """Export as a DLPack capsule (zero-copy where the consumer allows)."""
+    v = x._value if isinstance(x, Tensor) else x
+    return v.__dlpack__()
+
+
+def from_dlpack(capsule) -> Tensor:
+    """Import a DLPack capsule or any __dlpack__-capable object (torch/numpy
+    arrays included)."""
+    import jax.numpy as jnp
+    return Tensor(jnp.from_dlpack(capsule))

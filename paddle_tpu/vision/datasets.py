@@ -17,7 +17,7 @@ import numpy as np
 from ..io.dataset import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
-           "DatasetFolder", "ImageFolder"]
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
 
 class MNIST(Dataset):
@@ -183,3 +183,67 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers (reference vision/datasets/flowers.py). Offline
+    environment: construct from a local directory of class-subfoldered
+    images (the reference downloads + reads .mat labels)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        if download:
+            raise RuntimeError("no network egress; pass data_file=<local dir>")
+        if data_file is None or not os.path.isdir(str(data_file)):
+            raise RuntimeError(
+                "Flowers: the reference downloads the 102flowers archive; "
+                "here pass data_file=<directory with class subfolders>")
+        if mode != "train" and (label_file is None or setid_file is None):
+            import warnings
+            warnings.warn(
+                f"Flowers(mode={mode!r}) without label_file/setid_file has "
+                "no split info for a plain image folder — returning ALL "
+                "samples; provide per-split folders or the .mat files",
+                stacklevel=2)
+        self._folder = DatasetFolder(data_file, transform=transform)
+
+    def __len__(self):
+        return len(self._folder)
+
+    def __getitem__(self, idx):
+        return self._folder[idx]
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference vision/datasets/voc2012.py):
+    local VOCdevkit layout (JPEGImages/ + SegmentationClass/ +
+    ImageSets/Segmentation/<mode>.txt)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download:
+            raise RuntimeError("no network egress; pass data_file=<local dir>")
+        root = str(data_file or "")
+        lst = os.path.join(root, "ImageSets", "Segmentation", f"{mode}.txt")
+        if not os.path.isfile(lst):
+            raise RuntimeError(
+                "VOC2012: expected a local VOCdevkit/VOC2012 directory "
+                f"(missing {lst}); the reference downloads the archive")
+        with open(lst) as f:
+            self._ids = [ln.strip() for ln in f if ln.strip()]
+        self._root = root
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._ids)
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        name = self._ids[idx]
+        img = np.asarray(Image.open(
+            os.path.join(self._root, "JPEGImages", name + ".jpg")))
+        lab = np.asarray(Image.open(
+            os.path.join(self._root, "SegmentationClass", name + ".png")))
+        if self._transform is not None:
+            img = self._transform(img)
+        return img, lab

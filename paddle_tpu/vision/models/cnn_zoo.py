@@ -1,0 +1,702 @@
+"""CNN model zoo batch 2 (analogs of python/paddle/vision/models/
+{resnet resnext variants, mobilenetv1/v3, densenet, inception, squeezenet,
+googlenet, shufflenetv2}.py).
+
+All pure Layer compositions over the conv/norm/pool library; on TPU each
+forward is one fused XLA program via to_static. `pretrained=True` raises
+(no network egress) like the rest of the zoo."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manip
+from .resnet import BottleneckBlock, ResNet
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise RuntimeError(
+            "pretrained weights require network egress, unavailable in this "
+            "environment; construct with pretrained=False and load local "
+            "weights via set_state_dict")
+
+
+# ---------------- ResNeXt ----------------
+
+def _resnext(depth, groups, width, pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    layer_cfg = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+    return ResNet(BottleneckBlock, layers=layer_cfg[depth], groups=groups,
+                  width_per_group=width, **kw)
+
+
+def resnext50_32x4d(pretrained=False, **kw):
+    return _resnext(50, 32, 4, pretrained, **kw)
+
+
+def resnext50_64x4d(pretrained=False, **kw):
+    return _resnext(50, 64, 4, pretrained, **kw)
+
+
+def resnext101_32x4d(pretrained=False, **kw):
+    return _resnext(101, 32, 4, pretrained, **kw)
+
+
+def resnext101_64x4d(pretrained=False, **kw):
+    return _resnext(101, 64, 4, pretrained, **kw)
+
+
+def resnext152_32x4d(pretrained=False, **kw):
+    return _resnext(152, 32, 4, pretrained, **kw)
+
+
+def resnext152_64x4d(pretrained=False, **kw):
+    return _resnext(152, 64, 4, pretrained, **kw)
+
+
+# ---------------- MobileNetV1 ----------------
+
+class _ConvBNReLU(nn.Layer):
+    def __init__(self, cin, cout, k=3, stride=1, padding=None, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride,
+                              padding=k // 2 if padding is None else padding,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = {"relu": nn.ReLU(), "relu6": nn.ReLU6(),
+                    "hardswish": nn.Hardswish(),
+                    "swish": nn.Swish(), None: None}[act]
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class MobileNetV1(nn.Layer):
+    """Depthwise-separable stack (models/mobilenetv1.py)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [  # (in, out, stride of depthwise)
+            (32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+            (256, 256, 1), (256, 512, 2), (512, 512, 1), (512, 512, 1),
+            (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 1024, 2),
+            (1024, 1024, 1)]
+        blocks = [_ConvBNReLU(3, c(32), 3, stride=2)]
+        for cin, cout, s in cfg:
+            blocks.append(_ConvBNReLU(c(cin), c(cin), 3, stride=s,
+                                      groups=c(cin)))      # depthwise
+            blocks.append(_ConvBNReLU(c(cin), c(cout), 1)) # pointwise
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(manip.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kw)
+
+
+# ---------------- MobileNetV3 ----------------
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, squeeze=4):
+        super().__init__()
+        mid = max(ch // squeeze, 8)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, cin, exp, cout, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if exp != cin:
+            layers.append(_ConvBNReLU(cin, exp, 1, act=act))
+        layers.append(_ConvBNReLU(exp, exp, k, stride=stride, groups=exp,
+                                  act=act))
+        if se:
+            layers.append(_SqueezeExcite(exp))
+        layers.append(_ConvBNReLU(exp, cout, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return out + x if self.use_res else out
+
+
+_MBV3_LARGE = [  # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1)]
+_MBV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1)]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale + 4) // 8 * 8, 8)
+
+        blocks = [_ConvBNReLU(3, c(16), 3, stride=2, act="hardswish")]
+        cin = c(16)
+        for k, exp, out, se, act, s in cfg:
+            blocks.append(_MBV3Block(cin, c(exp), c(out), k, s, se, act))
+            cin = c(out)
+        blocks.append(_ConvBNReLU(cin, c(last_exp), 1, act="hardswish"))
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(c(last_exp), last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(manip.flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_LARGE, 960, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_SMALL, 576, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+# ---------------- DenseNet ----------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, cin, growth, bn_size):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(cin)
+        self.conv1 = nn.Conv2D(cin, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        return manip.concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(cin)
+        self.conv = nn.Conv2D(cin, cout, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_DENSE_CFG = {121: (32, [6, 12, 24, 16], 64), 161: (48, [6, 12, 36, 24], 96),
+              169: (32, [6, 12, 32, 32], 64), 201: (32, [6, 12, 48, 32], 64),
+              264: (32, [6, 12, 64, 48], 64)}
+
+
+class DenseNet(nn.Layer):
+    """DenseNet (models/densenet.py): dense blocks with channel concat."""
+
+    def __init__(self, layers=121, growth_rate=None, num_classes=1000,
+                 with_pool=True, bn_size=4, dropout=0.0):
+        super().__init__()
+        growth, block_cfg, init_ch = _DENSE_CFG[layers]
+        growth = growth_rate or growth
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [_ConvBNReLU(3, init_ch, 7, stride=2, padding=3),
+                 nn.MaxPool2D(3, 2, padding=1)]
+        ch = init_ch
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if i != len(block_cfg) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats.append(nn.BatchNorm2D(ch))
+        feats.append(nn.ReLU())
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(manip.flatten(x, 1))
+        return x
+
+
+def _densenet(layers, pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(layers=layers, **kw)
+
+
+def densenet121(pretrained=False, **kw):
+    return _densenet(121, pretrained, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return _densenet(161, pretrained, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return _densenet(169, pretrained, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return _densenet(201, pretrained, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return _densenet(264, pretrained, **kw)
+
+
+# ---------------- SqueezeNet ----------------
+
+class _Fire(nn.Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(cin, squeeze, 1)
+        self.e1 = nn.Conv2D(squeeze, e1, 1)
+        self.e3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        s = self.relu(self.squeeze(x))
+        return manip.concat([self.relu(self.e1(s)), self.relu(self.e3(s))],
+                            axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """SqueezeNet 1.0/1.1 (models/squeezenet.py)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            feats = [nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                     nn.MaxPool2D(3, 2),
+                     _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                     _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                     _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                     _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                     nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256)]
+        else:
+            feats = [nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                     nn.MaxPool2D(3, 2),
+                     _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                     nn.MaxPool2D(3, 2),
+                     _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                     nn.MaxPool2D(3, 2),
+                     _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                     _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256)]
+        self.features = nn.Sequential(*feats)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return manip.flatten(x, 1)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.1", **kw)
+
+
+# ---------------- GoogLeNet (Inception v1) ----------------
+
+class _InceptionV1Block(nn.Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(cin, c1, 1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(cin, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b5 = nn.Sequential(nn.Conv2D(cin, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.bp = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                nn.Conv2D(cin, pp, 1), nn.ReLU())
+
+    def forward(self, x):
+        return manip.concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)],
+                            axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """GoogLeNet / Inception v1 (models/googlenet.py). Returns
+    (main, aux1, aux2) like the reference; auxes share the main head when
+    eval to keep the signature."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.i3a = _InceptionV1Block(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _InceptionV1Block(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = _InceptionV1Block(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _InceptionV1Block(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _InceptionV1Block(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _InceptionV1Block(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _InceptionV1Block(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = _InceptionV1Block(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _InceptionV1Block(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = nn.Sequential(nn.AdaptiveAvgPool2D(4),
+                                      nn.Flatten(),
+                                      nn.Linear(512 * 16, num_classes))
+            self.aux2 = nn.Sequential(nn.AdaptiveAvgPool2D(4),
+                                      nn.Flatten(),
+                                      nn.Linear(528 * 16, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        a1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        a2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(manip.flatten(x, 1)))
+            return x, a1, a2
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kw)
+
+
+# ---------------- InceptionV3 ----------------
+
+class _IncA(nn.Layer):
+    def __init__(self, cin, pool_ch):
+        super().__init__()
+        self.b1 = _ConvBNReLU(cin, 64, 1)
+        self.b5 = nn.Sequential(_ConvBNReLU(cin, 48, 1),
+                                _ConvBNReLU(48, 64, 5))
+        self.b3 = nn.Sequential(_ConvBNReLU(cin, 64, 1),
+                                _ConvBNReLU(64, 96, 3), _ConvBNReLU(96, 96, 3))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBNReLU(cin, pool_ch, 1))
+
+    def forward(self, x):
+        return manip.concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                            axis=1)
+
+
+class _IncB(nn.Layer):  # grid reduction
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _ConvBNReLU(cin, 384, 3, stride=2, padding=0)
+        self.b33 = nn.Sequential(_ConvBNReLU(cin, 64, 1),
+                                 _ConvBNReLU(64, 96, 3),
+                                 _ConvBNReLU(96, 96, 3, stride=2, padding=0))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return manip.concat([self.b3(x), self.b33(x), self.pool(x)], axis=1)
+
+
+class _IncC(nn.Layer):  # 7x1/1x7 factorized
+    def __init__(self, cin, ch7):
+        super().__init__()
+        self.b1 = _ConvBNReLU(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBNReLU(cin, ch7, 1),
+            _ConvBNReLU(ch7, ch7, (1, 7), padding=(0, 3)),
+            _ConvBNReLU(ch7, 192, (7, 1), padding=(3, 0)))
+        self.b77 = nn.Sequential(
+            _ConvBNReLU(cin, ch7, 1),
+            _ConvBNReLU(ch7, ch7, (7, 1), padding=(3, 0)),
+            _ConvBNReLU(ch7, ch7, (1, 7), padding=(0, 3)),
+            _ConvBNReLU(ch7, ch7, (7, 1), padding=(3, 0)),
+            _ConvBNReLU(ch7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBNReLU(cin, 192, 1))
+
+    def forward(self, x):
+        return manip.concat([self.b1(x), self.b7(x), self.b77(x), self.bp(x)],
+                            axis=1)
+
+
+class _IncD(nn.Layer):  # grid reduction 2
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBNReLU(cin, 192, 1),
+                                _ConvBNReLU(192, 320, 3, stride=2, padding=0))
+        self.b7 = nn.Sequential(
+            _ConvBNReLU(cin, 192, 1),
+            _ConvBNReLU(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBNReLU(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBNReLU(192, 192, 3, stride=2, padding=0))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return manip.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncE(nn.Layer):  # expanded filter bank
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _ConvBNReLU(cin, 320, 1)
+        self.b3_stem = _ConvBNReLU(cin, 384, 1)
+        self.b3_a = _ConvBNReLU(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBNReLU(384, 384, (3, 1), padding=(1, 0))
+        self.b33_stem = nn.Sequential(_ConvBNReLU(cin, 448, 1),
+                                      _ConvBNReLU(448, 384, 3))
+        self.b33_a = _ConvBNReLU(384, 384, (1, 3), padding=(0, 1))
+        self.b33_b = _ConvBNReLU(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBNReLU(cin, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        t = self.b33_stem(x)
+        return manip.concat(
+            [self.b1(x), self.b3_a(s), self.b3_b(s),
+             self.b33_a(t), self.b33_b(t), self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Inception v3 (models/inceptionv3.py)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBNReLU(3, 32, 3, stride=2, padding=0),
+            _ConvBNReLU(32, 32, 3, padding=0),
+            _ConvBNReLU(32, 64, 3),
+            nn.MaxPool2D(3, 2),
+            _ConvBNReLU(64, 80, 1),
+            _ConvBNReLU(80, 192, 3, padding=0),
+            nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160), _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(manip.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return InceptionV3(**kw)
+
+
+# ---------------- ShuffleNetV2 ----------------
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = manip.reshape(x, [n, groups, c // groups, h, w])
+    x = manip.transpose(x, [0, 2, 1, 3, 4])
+    return manip.reshape(x, [n, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, cin, cout, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            in_branch = cin // 2
+        else:
+            in_branch = cin
+            self.short = nn.Sequential(
+                _ConvBNReLU(cin, cin, 3, stride=2, groups=cin, act=None),
+                _ConvBNReLU(cin, branch, 1, act=act))
+        self.main = nn.Sequential(
+            _ConvBNReLU(in_branch, branch, 1, act=act),
+            _ConvBNReLU(branch, branch, 3, stride=stride, groups=branch,
+                        act=None),
+            _ConvBNReLU(branch, branch, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = manip.concat([x1, self.main(x2)], axis=1)
+        else:
+            out = manip.concat([self.short(x), self.main(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_SHUFFLE_CH = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+               0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+               1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048]}
+
+
+class ShuffleNetV2(nn.Layer):
+    """ShuffleNetV2 (models/shufflenetv2.py)."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        ch = _SHUFFLE_CH[scale]
+        self.stem = nn.Sequential(_ConvBNReLU(3, ch[0], 3, stride=2, act=act),
+                                  nn.MaxPool2D(3, 2, padding=1))
+        stages = []
+        cin = ch[0]
+        for stage_i, repeat in zip((1, 2, 3), (4, 8, 4)):
+            stages.append(_ShuffleUnit(cin, ch[stage_i], 2, act))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(ch[stage_i], ch[stage_i], 1, act))
+            cin = ch[stage_i]
+        self.stages = nn.Sequential(*stages)
+        self.last = _ConvBNReLU(ch[3], ch[4], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(ch[4], num_classes)
+
+    def forward(self, x):
+        x = self.last(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(manip.flatten(x, 1))
+        return x
+
+
+def _shufflenet(scale, act="relu", pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(scale=scale, act=act, **kw)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _shufflenet(0.25, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _shufflenet(0.33, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _shufflenet(0.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _shufflenet(1.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _shufflenet(1.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _shufflenet(2.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return _shufflenet(1.0, act="swish", pretrained=pretrained, **kw)

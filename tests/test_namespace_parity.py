@@ -451,3 +451,60 @@ def test_graph_sampling_weighted_degenerate_and_eids():
         P.geometric.sample_neighbors(row, colptr,
                                      P.to_tensor(np.array([1])),
                                      return_eids=True)
+
+
+def test_leaf_namespace_parity():
+    for ref_path, mod in [
+        ("vision/models", "vision.models"),
+        ("vision/datasets", "vision.datasets"),
+        ("utils/dlpack", "utils.dlpack"),
+        ("utils/cpp_extension", "utils.cpp_extension"),
+        ("sysconfig", "sysconfig"),
+        ("nn/quant", "nn.quant"),
+        ("distributed/fleet/utils", "distributed.fleet.utils"),
+    ]:
+        ref = _ref_all(REF + ref_path + "/__init__.py", REF + ref_path + ".py")
+        assert ref, f"no reference __all__ for {ref_path}"
+        ours = importlib.import_module("paddle_tpu." + mod)
+        missing = [n for n in ref if not hasattr(ours, n)]
+        assert not missing, f"paddle.{mod} gaps: {missing}"
+
+
+def test_cnn_zoo_forwards():
+    from paddle_tpu.vision import models as M
+    x = P.to_tensor(np.random.randn(1, 3, 64, 64).astype("f"))
+    for builder in [
+        lambda: M.mobilenet_v1(scale=0.25, num_classes=7),
+        lambda: M.mobilenet_v3_small(scale=0.5, num_classes=7),
+        lambda: M.shufflenet_v2_x0_25(num_classes=7),
+        lambda: M.squeezenet1_1(num_classes=7),
+        lambda: M.densenet121(num_classes=7, growth_rate=8),
+        lambda: M.resnext50_32x4d(num_classes=7),
+    ]:
+        net = P.to_static(builder())
+        assert net(x).shape == [1, 7]
+    g = M.googlenet(num_classes=5)
+    main, a1, a2 = g(x)
+    assert main.shape == [1, 5] and a1.shape == [1, 5]
+    inc = P.to_static(M.inception_v3(num_classes=5))
+    x75 = P.to_tensor(np.random.randn(1, 3, 75, 75).astype("f"))
+    assert inc(x75).shape == [1, 5]
+    with pytest.raises(RuntimeError, match="pretrained"):
+        M.densenet121(pretrained=True)
+
+
+def test_dlpack_and_weight_only_quant():
+    import torch
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    x = P.utils.dlpack.from_dlpack(t)
+    np.testing.assert_allclose(x.numpy(), t.numpy())
+    back = torch.utils.dlpack.from_dlpack(
+        P.utils.dlpack.to_dlpack(P.ones([2, 2])))
+    assert tuple(back.shape) == (2, 2)
+    from paddle_tpu.nn.quant import weight_only_linear, weight_quantize
+    w = P.randn([8, 16])
+    q, s = weight_quantize(w)
+    xq = P.randn([2, 8])
+    out = weight_only_linear(xq, q, weight_scale=s)
+    ref = xq.numpy() @ w.numpy()
+    assert np.abs(out.numpy() - ref).max() / np.abs(ref).max() < 0.02
