@@ -21,6 +21,7 @@
 // but never corrupt it.
 #include <Python.h>
 
+#include <cctype>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -61,11 +62,28 @@ bool ensure_interpreter(const char* platform) {
   }
   GIL gil;
   if (platform && platform[0]) {
-    std::string code =
-        "import jax\n"
-        "jax.config.update('jax_platforms', '" + std::string(platform) + "')\n";
-    if (PyRun_SimpleString(code.c_str()) != 0) {
-      g_last_error = "failed to set jax platform";
+    // Never interpolate caller strings into Python source: pass the value as
+    // a PyUnicode argument to jax.config.update instead (a quote/newline in
+    // `platform` would otherwise break out of the statement).
+    std::string p(platform);
+    for (char c : p) {
+      if (!(std::isalnum(static_cast<unsigned char>(c)) || c == ',' ||
+            c == '_' || c == '-')) {
+        g_last_error = "invalid platform string";
+        return false;
+      }
+    }
+    PyObject* jax = PyImport_ImportModule("jax");
+    PyObject* cfg = jax ? PyObject_GetAttrString(jax, "config") : nullptr;
+    PyObject* r = cfg ? PyObject_CallMethod(cfg, "update", "ss",
+                                            "jax_platforms", p.c_str())
+                      : nullptr;
+    Py_XDECREF(r);
+    Py_XDECREF(cfg);
+    Py_XDECREF(jax);
+    if (!r) {
+      set_error_from_python();
+      if (g_last_error.empty()) g_last_error = "failed to set jax platform";
       return false;
     }
   }

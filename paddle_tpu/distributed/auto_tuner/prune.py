@@ -113,38 +113,18 @@ def prune_by_memory_estimate(tuner_cfg, cur, history=None):
 @register_prune
 def prune_by_schedule_tradeoff(tuner_cfg, cur, history=None):
     """Schedule choice from the measured tradeoff (tools/schedule_bench.py,
-    SCHEDULE_BENCH.json): the switch-based 1F1B runs exactly one unit per
-    tick (no redundant compute) but pays lax.switch dispatch overhead
-    (~1.07-1.14x step time vs gpipe at bench sizes), while stashing only
-    min(pp, M) microbatch activations vs gpipe's M+pp-1. Hence: prefer
-    gpipe when its stash fits the HBM budget; prefer 1f1b when only its
-    smaller stash fits."""
-    budget = tuner_cfg.get("hbm_bytes")
-    n_params = tuner_cfg.get("num_params")
-    if not budget or not n_params:
-        return False
-    schedule = cur.get("schedule", "gpipe")
+    SCHEDULE_BENCH.json): the fused-round 1F1B runs 0.62-0.83x gpipe's step
+    time across bench configs while stashing min(2*pp-1, M) microbatch
+    activations vs gpipe's M+pp-1 — gpipe is dominated whenever a pipeline
+    exists, so it is pruned at pp>1; 1f1b machinery is pure cost at pp<=1.
+    Applies only to candidates that explicitly carry a schedule choice."""
+    schedule = cur.get("schedule")
     if schedule not in ("gpipe", "1f1b"):
         return False
-    mp = cur.get("mp_degree", 1)
     pp = cur.get("pp_degree", 1)
     if pp <= 1:
         return schedule == "1f1b"  # no pipeline, 1f1b machinery is pure cost
-    dp = cur.get("dp_degree", 1)
-    M = cur.get("micro_batches", 1)
-    gbs = tuner_cfg.get("global_batch_size", 1)
-    seq = tuner_cfg.get("seq_length", 1)
-    hidden = tuner_cfg.get("hidden_size", 1)
-    headroom = budget - _state_bytes(n_params, cur)
-    per_mb = 2.0 * (gbs / dp / M) * seq * hidden / mp  # one stage input
-    gpipe_stash = (M + pp - 1) * per_mb
-    f1b_stash = min(pp, M) * per_mb
-    if schedule == "1f1b" and gpipe_stash <= headroom:
-        return True   # gpipe fits: it is faster at equal correctness
-    if schedule == "gpipe" and gpipe_stash > headroom \
-            and f1b_stash <= headroom:
-        return True   # only 1f1b's bounded stash fits
-    return False
+    return schedule == "gpipe"     # dominated: slower AND bigger stash
 
 
 @register_prune

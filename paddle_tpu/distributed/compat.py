@@ -137,12 +137,14 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
 # CPU-side barrier service — here the TCPStore plays gloo's role ----
 
 _gloo_store = None
+_gloo_world = 1
 
 
 def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
-    global _gloo_store
+    global _gloo_store, _gloo_world
     from .store import TCPStore
     host, port = server_endpoint.rsplit(":", 1)
+    _gloo_world = int(rank_num)
     _gloo_store = TCPStore(host, int(port), is_master=(rank_id == 0),
                            world_size=rank_num)
 
@@ -150,9 +152,13 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
 def gloo_barrier():
     if _gloo_store is None:
         raise RuntimeError("call gloo_init_parallel_env first")
-    n = _gloo_store.add("gloo/barrier", 1)
+    _gloo_store.add("gloo/barrier", 1)
     import time
-    world = get_world_size()
+
+    # size the barrier by the rank_num given to gloo_init_parallel_env — the
+    # collective env is typically NOT initialized when the gloo API is used,
+    # so get_world_size() would default to 1 and the barrier would no-op
+    world = _gloo_world
     deadline = time.time() + 300
     while _gloo_store.add("gloo/barrier", 0) % max(world, 1) != 0 \
             and time.time() < deadline:

@@ -361,7 +361,7 @@ def _call_with_params(layer, names, vals, fn):
 
 def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                             n_microbatches: int = 1, remat: bool = True,
-                            amp: bool = False, schedule: str = "gpipe",
+                            amp: bool = False, schedule: str = "1f1b",
                             n_virtual: int = 1,
                             accumulate_steps: Optional[int] = None):
     """Build a fully-compiled hybrid train step.
@@ -389,6 +389,11 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
     cfg = model.config
     L = cfg.num_hidden_layers
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if schedule == "1f1b_fused":  # alias used by activation accounting
+        schedule = "1f1b"
+    if schedule not in ("gpipe", "1f1b", "1f1b_compact", "vpp"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         "(gpipe | 1f1b | 1f1b_compact | vpp)")
     if pp <= 1:
         schedule = "gpipe"
     if schedule == "vpp":
@@ -505,6 +510,7 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
 
     def loss_and_grads_1f1b(params, batch, rng):
         from ..parallel.pipeline import spmd_pipeline_1f1b
+        f1b_variant = "compact" if schedule == "1f1b_compact" else "fused"
 
         outer_vals, stacked_vals = params
         cast_outer = _amp_cast(outer_vals) if amp else list(outer_vals)
@@ -550,7 +556,8 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
             loss, g_stacked, g_head, dx_mb = spmd_pipeline_1f1b(
                 stage_fn, head_loss, cast_stacked,
                 [cast_outer[i] for i in head_pos], x_mb, labels_mb,
-                n_microbatches=n_microbatches, mesh=mesh, remat=remat)
+                n_microbatches=n_microbatches, mesh=mesh, remat=remat,
+                variant=f1b_variant)
             (g_embed,) = embed_vjp(dx_mb)
 
         # assemble grads positionally, cast back to master-param dtype
@@ -620,8 +627,11 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
             is_leaf=lambda x: hasattr(x, "shape") and not isinstance(x, dict))
     opt_state = init_state(params)
 
-    # ZeRO: shard optimizer-state leaves over the sharding axis (stage >= 1)
-    zero_axis = getattr(base_opt, "_shard_axis", None)
+    # ZeRO: shard optimizer-state leaves over the sharding axis (stage >= 1);
+    # with no 'sharding' mesh axis the shard rides dp (Fleet default
+    # sharding degree == dp degree — see _resolve_zero_axis)
+    from ..parallel.trainer import _resolve_zero_axis
+    zero_axis = _resolve_zero_axis(getattr(base_opt, "_shard_axis", None), mesh)
     zero_stage = getattr(base_opt, "_shard_stage", 0)
     if mesh is not None and zero_axis and zero_stage >= 1 \
             and mesh.shape.get(zero_axis, 1) > 1:
@@ -646,7 +656,7 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                      shard_states(opt_state[1], stacked_sh))
 
     def loss_and_grads(param_vals, batch, rng):
-        if schedule == "1f1b" and pp > 1:
+        if schedule in ("1f1b", "1f1b_compact") and pp > 1:
             return loss_and_grads_1f1b(param_vals, batch, rng)
         return jax.value_and_grad(loss_fn)(param_vals, batch, rng)
 

@@ -15,17 +15,16 @@ Three schedules:
 - ``gpipe``: forward fill-drain; backward comes from reverse-mode AD of the
   scan (inverted permutation). Activation liveness = scan residuals over all
   T = M+S-1 ticks (bounded via jax.checkpoint on the stage body).
-- ``1f1b``: a manually-scheduled forward/backward interleave in a single scan.
-  Schedule clock (S stages, M microbatches, global tick t): stage i runs
-  forward of microbatch m at tick  f_i(m) = m+i  while filling
-  (m < S-i) and at  f_i(m) = 2m+i  in steady state (throttled by the
-  in-flight limit S-i), and backward of m at  b_i(m) = 2S-1-i+2m .
-  All producer->consumer edges are exactly one tick apart, so each tick ends
-  with one down-stream ppermute (activations) and one up-stream ppermute
-  (cotangents). Backward units recompute the stage vjp from a stashed input
-  (recompute-style 1F1B, as the reference pairs recompute with 1F1B), so the
-  activation stash is a ring buffer of only  min(S, M)  microbatch inputs —
-  the 1F1B memory bound — versus GPipe's M.
+- ``1f1b``: a manually-scheduled forward/backward interleave in a single
+  scan, in two variants (see spmd_pipeline_1f1b). The default ``fused``
+  variant runs fwd(m) at round m+i and bwd(m) at round m+2(S-1)-i — in
+  steady state each round is one unconditional fwd+bwd pair (the last stage
+  fuses fwd(m)->bwd(m) of the same microbatch) — stashing min(2S-1, M)
+  microbatch inputs and matching/beating GPipe wall-time. The ``compact``
+  variant dispatches one unit per tick on a 2(M+S-1)-tick clock for the
+  tightest min(S, M) stash. Both recompute the stage vjp from the stash
+  (recompute-style 1F1B, as the reference pairs recompute with 1F1B);
+  GPipe's AD residuals hold M+S-1.
 - ``vpp``: interleaved virtual-stage schedule. Each rank holds v chunks;
   virtual stage vs = c*S + i lives on rank i. Microbatches are processed in
   groups of S: chunk c of rank i runs microbatch m = g*S + r at tick
@@ -178,14 +177,29 @@ def _spmd_pipeline_vpp(stage_fn, stage_params, microbatches, *,
 
 def spmd_pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
                        head_params, x_mb, labels_mb, *, n_microbatches: int,
-                       mesh, axis: str = PP_AXIS, remat: bool = True):
+                       mesh, axis: str = PP_AXIS, remat: bool = True,
+                       variant: str = "fused"):
     """One-program 1F1B training pipeline: loss AND gradients in one scan.
 
     Unlike `spmd_pipeline` (whose backward is AD of the forward scan), this
-    interleaves forward and backward microbatch units on the 1F1B clock, so
-    at most min(S, M) stage inputs are stashed per stage (ring buffer) — the
-    1F1B activation bound (pipeline_parallel.py:387 semantics). Backward
-    units recompute the stage vjp from the stashed input.
+    interleaves forward and backward microbatch units on the 1F1B clock.
+    Backward units recompute the stage vjp from a stashed input
+    (recompute-style 1F1B, as the reference pairs recompute with 1F1B).
+
+    Two scheduling variants (VERDICT r3 item 5 — measured in
+    tools/schedule_bench.py; SCHEDULE_BENCH.json records the tradeoff):
+
+    - ``fused`` (default): M + 2(S-1) rounds; in steady state EVERY round
+      runs one forward and one backward back-to-back with no dispatch branch
+      (the last stage fuses fwd(m) -> bwd(m) of the SAME microbatch in one
+      round, the classic 1F1B signature). Conditionals remain only at the
+      fill/drain edges, with rank-uniform predicates. Activation stash:
+      min(2S-1, M) microbatch inputs. Wall-clock matches the GPipe program
+      while GPipe stashes M+S-1.
+    - ``compact``: 2(M+S-1) unit ticks, one lax.switch-dispatched unit per
+      tick; activation stash min(S, M) — the tightest 1F1B bound
+      (pipeline_parallel.py:387 semantics), paying ~2 ticks per microbatch
+      of schedule length. Use when activation memory, not time, binds.
 
     Args:
       stage_fn(params, x) -> y           per-stage computation
@@ -202,6 +216,115 @@ def spmd_pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
     """
     M = n_microbatches
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    if variant not in ("fused", "compact"):
+        raise ValueError(f"unknown 1f1b variant {variant!r}")
+
+    def per_stage_fused(params, head, x_all, labels):
+        S = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        R = M + 2 * (S - 1)
+        stash_n = min(2 * (S - 1) + 1, M)
+        down = [(i, (i + 1) % S) for i in range(S)]
+        up = [(i, (i - 1) % S) for i in range(S)]
+        is_last = idx == S - 1
+
+        a0 = jnp.zeros_like(x_all[0])
+        carry0 = dict(
+            a_in=a0,
+            g_in=a0,
+            x_stash=jnp.zeros((stash_n,) + x_all.shape[1:], x_all.dtype),
+            g_stage=jax.tree_util.tree_map(jnp.zeros_like, params),
+            g_head=jax.tree_util.tree_map(jnp.zeros_like, head),
+            loss=jnp.zeros((), jnp.float32),
+            dx=jnp.zeros_like(x_all),
+        )
+
+        def round_(carry, r):
+            # ---- schedule clock: one fwd slot and one bwd slot per round.
+            # fwd of m at round m+idx; bwd of m at round m+2(S-1)-idx; on the
+            # last stage the two coincide (fwd(m) then bwd(m), fused). Edges
+            # are exactly one round apart in both directions.
+            m_f = r - idx
+            do_fwd = (m_f >= 0) & (m_f < M)
+            mf = jnp.clip(m_f, 0, M - 1)
+            m_b = r - 2 * (S - 1) + idx
+            do_bwd = (m_b >= 0) & (m_b < M)
+            mb = jnp.clip(m_b, 0, M - 1)
+
+            # ---- forward unit (cond only trims the fill/drain edges;
+            # in steady state the predicate is uniformly true)
+            x_in = jnp.where(idx == 0, x_all[mf], carry["a_in"])
+            slot_f = mf % stash_n
+            x_stash = carry["x_stash"].at[slot_f].set(
+                jnp.where(do_fwd, x_in, carry["x_stash"][slot_f]))
+            y = jax.lax.cond(do_fwd, lambda: fn(params, x_in),
+                             lambda: jnp.zeros_like(x_in))
+
+            # ---- backward unit (recompute vjp from the stash; the updated
+            # stash makes the last stage's same-round fwd input visible)
+            x_b = jnp.where(idx == 0, x_all[mb], x_stash[mb % stash_n])
+            lab = labels[mb]
+
+            def _bwd():
+                y2, stage_vjp = jax.vjp(fn, params, x_b)
+
+                def _with_loss(args):
+                    hp, yy, lab_ = args
+                    loss_val, loss_vjp = jax.vjp(
+                        lambda h_, y_: loss_fn(h_, y_, lab_), hp, yy)
+                    d_head, dy_last = loss_vjp(
+                        jnp.ones((), loss_val.dtype) / M)
+                    return loss_val.astype(jnp.float32), d_head, dy_last
+
+                def _no_loss(args):
+                    hp, yy, _ = args
+                    return (jnp.zeros((), jnp.float32),
+                            jax.tree_util.tree_map(jnp.zeros_like, hp),
+                            jnp.zeros_like(yy))
+
+                loss_val, d_head, dy_last = jax.lax.cond(
+                    is_last, _with_loss, _no_loss, (head, y2, lab))
+                dy = jnp.where(is_last, dy_last, carry["g_in"])
+                d_params, dx = stage_vjp(dy)
+                return loss_val, d_params, d_head, dx
+
+            def _bwd_idle():
+                return (jnp.zeros((), jnp.float32),
+                        jax.tree_util.tree_map(jnp.zeros_like, params),
+                        jax.tree_util.tree_map(jnp.zeros_like, head),
+                        jnp.zeros_like(x_b))
+
+            loss_val, d_params, d_head, dx = jax.lax.cond(
+                do_bwd, _bwd, _bwd_idle)
+
+            g_stage = jax.tree_util.tree_map(
+                lambda acc, g: acc + g, carry["g_stage"], d_params)
+            g_head = jax.tree_util.tree_map(
+                lambda acc, g: acc + g, carry["g_head"], d_head)
+            loss = carry["loss"] + jnp.where(
+                do_bwd & is_last, loss_val / M, 0.0)
+            dx_all = carry["dx"].at[mb].set(
+                jnp.where(do_bwd & (idx == 0), dx, carry["dx"][mb]))
+
+            a_next = jax.lax.ppermute(
+                jnp.where(do_fwd, y, jnp.zeros_like(y)), axis, down)
+            g_next = jax.lax.ppermute(
+                jnp.where(do_bwd, dx, jnp.zeros_like(dx)), axis, up)
+            return dict(a_in=a_next, g_in=g_next, x_stash=x_stash,
+                        g_stage=g_stage, g_head=g_head, loss=loss,
+                        dx=dx_all), None
+
+        carry, _ = jax.lax.scan(round_, carry0, jnp.arange(R))
+
+        loss = jax.lax.psum(jnp.where(idx == S - 1, carry["loss"], 0.0), axis)
+        g_head = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(
+                jnp.where(idx == S - 1, g, jnp.zeros_like(g)), axis),
+            carry["g_head"])
+        dx = jax.lax.psum(
+            jnp.where(idx == 0, carry["dx"], jnp.zeros_like(carry["dx"])),
+            axis)
+        return loss, carry["g_stage"], g_head, dx
 
     def per_stage(params, head, x_all, labels):
         S = jax.lax.axis_size(axis)
@@ -349,7 +472,8 @@ def spmd_pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
     head_spec = jax.tree_util.tree_map(lambda _: P(), head_params)
     in_specs = (stage_spec, head_spec, P(), P())
     out_specs = (P(), stage_spec, head_spec, P())
-    return jax.shard_map(per_stage, mesh=mesh, in_specs=in_specs,
+    body = per_stage_fused if variant == "fused" else per_stage
+    return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, axis_names={axis},
                          check_vma=False)(stage_params, head_params, x_mb,
                                           labels_mb)
@@ -359,7 +483,9 @@ def activation_stash_microbatches(schedule: str, pp: int, n_microbatches: int,
                                   n_virtual: int = 1) -> int:
     """Peak number of stashed microbatch activations per stage, by
     construction of each schedule (the 1F1B-vs-GPipe memory assertion)."""
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "1f1b_fused"):
+        return min(2 * pp - 1, n_microbatches)
+    if schedule == "1f1b_compact":
         return min(pp, n_microbatches)
     if schedule == "gpipe":
         return n_microbatches + pp - 1   # scan-carry residuals over T ticks

@@ -72,6 +72,22 @@ def _warn_dropped_spec(p, axis, dim):
         axis, key[0], dim)
 
 
+def _resolve_zero_axis(axis, mesh):
+    """Resolve the ZeRO sharding axis against the live mesh.  When the mesh
+    has no non-trivial axis of that name but DOES have dp > 1, alias to 'dp'
+    — the Fleet default "sharding degree == dp degree" (reference
+    dygraph_sharding_optimizer.py:39 shards over the dp comm group when no
+    separate sharding group is configured).  Returns None when no axis can
+    carry the shard (states stay replicated)."""
+    if axis is None or mesh is None:
+        return axis
+    if axis in mesh.axis_names and mesh.shape[axis] > 1:
+        return axis
+    if "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+        return "dp"
+    return None
+
+
 def _zero_state_spec(param_spec: PartitionSpec, shape, axis, mesh):
     """Shard an optimizer-state leaf over the ZeRO axis: pick the largest dim
     not already sharded and divisible by the axis size."""
@@ -146,6 +162,7 @@ class TrainStep:
             getattr(optimizer, "_shard_axis", None)
         zero_stage = getattr(base_opt, "_shard_stage", 0) or \
             getattr(optimizer, "_shard_stage", 0)
+        zero_axis = _resolve_zero_axis(zero_axis, mesh)
         if mesh is not None and zero_axis and zero_stage >= 1:
             self._state_shardings = []
             for p, ps, st in zip(self._params, self._param_shardings, self._opt_state):

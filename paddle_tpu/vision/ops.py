@@ -84,41 +84,57 @@ def _roi_grid_sample(feat, boxes, output_size, spatial_scale, sampling_ratio,
     return reducer(vals)
 
 
+def _per_image_spans(boxes_num):
+    """RoIs arrive grouped by image; yield (image, start, count) spans."""
+    bn = _np(boxes_num).astype(np.int64)
+    start = 0
+    for b, nb in enumerate(bn):
+        yield b, start, int(nb)
+        start += int(nb)
+
+
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
-    """RoIAlign (vision/ops.py roi_align): average of bilinear taps per bin."""
+    """RoIAlign (vision/ops.py roi_align): average of bilinear taps per bin.
+
+    Vectorized over the RoI axis: one sampler subgraph per batch image (all
+    of that image's boxes at once), not one per RoI — a 1000-proposal head
+    emits B subgraphs, not 1000."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
-    bn = _np(boxes_num)
-    batch_of_roi = np.repeat(np.arange(len(bn)), bn)
+    spans = list(_per_image_spans(boxes_num))
 
     def f(feat, bxs):
-        outs = []
-        for i, b in enumerate(batch_of_roi):
-            outs.append(_roi_grid_sample(
-                feat[b], bxs[i:i + 1], output_size, spatial_scale,
-                sampling_ratio, aligned,
-                lambda v: jnp.mean(v, axis=(3, 5)))[0])
-        return jnp.stack(outs) if outs else jnp.zeros(
+        outs = [_roi_grid_sample(
+            feat[b], bxs[s:s + n], output_size, spatial_scale,
+            sampling_ratio, aligned, lambda v: jnp.mean(v, axis=(3, 5)))
+            for b, s, n in spans if n]
+        return jnp.concatenate(outs) if outs else jnp.zeros(
             (0, feat.shape[1], *output_size), feat.dtype)
     return apply(f, x, boxes, op_name="roi_align")
 
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
-    """RoIPool: max over bins (vision/ops.py roi_pool)."""
+    """RoIPool: max over bins (vision/ops.py roi_pool).
+
+    DIVERGENCE from the reference kernel: the reference maxes over the exact
+    integer-quantized pixel bin (floor/ceil boundaries, data-dependent
+    extent); that shape is dynamic and does not compile under XLA, so this
+    maxes over a fixed 2x2 bilinear tap grid per bin instead.  Outputs differ
+    numerically for any box; pretrained detection heads relying on exact
+    RoIPool values should use roi_align (which IS reference-exact up to
+    sampling grid) or re-finetune."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
-    bn = _np(boxes_num)
-    batch_of_roi = np.repeat(np.arange(len(bn)), bn)
+    spans = list(_per_image_spans(boxes_num))
 
     def f(feat, bxs):
-        outs = []
-        for i, b in enumerate(batch_of_roi):
-            outs.append(_roi_grid_sample(
-                feat[b], bxs[i:i + 1], output_size, spatial_scale,
-                sampling_ratio=2, aligned=False,
-                reducer=lambda v: jnp.max(v, axis=(3, 5)))[0])
-        return jnp.stack(outs) if outs else jnp.zeros(
+        outs = [_roi_grid_sample(
+            feat[b], bxs[s:s + n], output_size, spatial_scale,
+            sampling_ratio=2, aligned=False,
+            reducer=lambda v: jnp.max(v, axis=(3, 5)))
+            for b, s, n in spans if n]
+        return jnp.concatenate(outs) if outs else jnp.zeros(
             (0, feat.shape[1], *output_size), feat.dtype)
     return apply(f, x, boxes, op_name="roi_pool")
 
@@ -126,27 +142,28 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                name=None):
     """Position-sensitive RoI pooling (vision/ops.py psroi_pool): channel
-    group (i,j) feeds output bin (i,j)."""
+    group (i,j) feeds output bin (i,j). Vectorized per batch image."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
-    bn = _np(boxes_num)
-    batch_of_roi = np.repeat(np.arange(len(bn)), bn)
+    spans = list(_per_image_spans(boxes_num))
 
     def f(feat, bxs):
         c = feat.shape[1]
         out_c = c // (oh * ow)
         outs = []
-        for i, b in enumerate(batch_of_roi):
+        iy = jnp.arange(oh)[:, None]
+        ix = jnp.arange(ow)[None, :]
+        for b, s, n in spans:
+            if not n:
+                continue
             full = _roi_grid_sample(
-                feat[b], bxs[i:i + 1], output_size, spatial_scale,
+                feat[b], bxs[s:s + n], output_size, spatial_scale,
                 sampling_ratio=2, aligned=False,
-                reducer=lambda v: jnp.mean(v, axis=(3, 5)))[0]  # (C, oh, ow)
-            g = full.reshape(out_c, oh, ow, oh, ow)
-            iy = jnp.arange(oh)[:, None]
-            ix = jnp.arange(ow)[None, :]
-            outs.append(g[:, iy, ix, iy, ix])
-        return jnp.stack(outs) if outs else jnp.zeros(
+                reducer=lambda v: jnp.mean(v, axis=(3, 5)))  # (n, C, oh, ow)
+            g = full.reshape(n, out_c, oh, ow, oh, ow)
+            outs.append(g[:, :, iy, ix, iy, ix])
+        return jnp.concatenate(outs) if outs else jnp.zeros(
             (0, c // (oh * ow), oh, ow), feat.dtype)
     return apply(f, x, boxes, op_name="psroi_pool")
 
@@ -246,15 +263,20 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             sel = sel[np.argsort(-s[sel])][:nms_top_k]
             boxes_c = bb[b, sel]
             s_c = s[sel]
+            # iou[i, j] for suppressor i ranked above target j (i < j);
+            # compensate_iou[i] = max IoU box i suffered from ITS suppressors
+            # (reference matrix_nms_kernel.cc: decay is indexed by the
+            # suppressor row, and the min runs over higher-ranked pairs only)
             iou = np.triu(_iou_matrix(boxes_c), 1)
-            max_over = iou.max(axis=0)
+            max_over = iou.max(axis=0)          # compensate per box
+            upper = np.triu(np.ones_like(iou, dtype=bool), 1)
             if use_gaussian:
-                decay = np.exp(-(iou ** 2 - max_over[None, :] ** 2)
-                               / gaussian_sigma).min(axis=0)
+                d = np.exp((max_over[:, None] ** 2 - iou ** 2)
+                           * gaussian_sigma)
             else:
-                decay = ((1 - iou) / np.maximum(1 - max_over[None, :],
-                                                1e-10)).min(axis=0)
-            dec_s = s_c * decay
+                d = (1 - iou) / np.maximum(1 - max_over[:, None], 1e-10)
+            decay = np.where(upper, d, 1.0).min(axis=0)
+            dec_s = s_c * np.minimum(decay, 1.0)
             for j in np.nonzero(dec_s >= post_threshold)[0]:
                 per.append((cls, dec_s[j], boxes_c[j], sel[j]))
         per.sort(key=lambda r: -r[1])

@@ -115,8 +115,10 @@ def _head_loss(head, y, labels):
     return jnp.mean((y @ head["wo"] - labels) ** 2)
 
 
-def test_1f1b_loss_and_grads_match_sequential():
-    """The manually-scheduled 1F1B program must reproduce plain AD exactly."""
+@pytest.mark.parametrize("variant", ["fused", "compact"])
+def test_1f1b_loss_and_grads_match_sequential(variant):
+    """The manually-scheduled 1F1B program must reproduce plain AD exactly —
+    in both the fused-round and the tick-switch variants."""
     dist.init_parallel_env({"pp": 4})
     mesh = mesh_mod.get_mesh()
     S, M = 4, 8
@@ -129,7 +131,7 @@ def test_1f1b_loss_and_grads_match_sequential():
 
     loss, g_stage, g_head, dx = spmd_pipeline_1f1b(
         _slice_stage_fn, _head_loss, params, head, x, labels,
-        n_microbatches=M, mesh=mesh)
+        n_microbatches=M, mesh=mesh, variant=variant)
 
     def ref_loss(params, head, x):
         y = _sequential(params, x, S)
@@ -150,7 +152,8 @@ def test_1f1b_loss_and_grads_match_sequential():
                                rtol=1e-4, atol=1e-5)
 
 
-def test_1f1b_more_microbatches_than_stages():
+@pytest.mark.parametrize("variant", ["fused", "compact"])
+def test_1f1b_more_microbatches_than_stages(variant):
     """M >> S exercises the steady-state throttle + ring-buffer reuse."""
     dist.init_parallel_env({"pp": 2})
     mesh = mesh_mod.get_mesh()
@@ -164,7 +167,7 @@ def test_1f1b_more_microbatches_than_stages():
 
     loss, g_stage, g_head, dx = spmd_pipeline_1f1b(
         _slice_stage_fn, _head_loss, params, head, x, labels,
-        n_microbatches=M, mesh=mesh)
+        n_microbatches=M, mesh=mesh, variant=variant)
 
     def ref_loss(params, head, x):
         y = _sequential(params, x, S)
@@ -180,11 +183,12 @@ def test_1f1b_more_microbatches_than_stages():
 
 
 def test_1f1b_activation_memory_bound():
-    """1F1B stashes min(S, M) microbatch inputs; GPipe's AD residuals hold
-    M+S-1 — the schedule's memory advantage (pipeline_parallel.py 1F1B
-    rationale)."""
+    """1F1B stashes min(2S-1, M) (fused) / min(S, M) (compact) microbatch
+    inputs; GPipe's AD residuals hold M+S-1 — the schedules' memory
+    advantage (pipeline_parallel.py 1F1B rationale)."""
     S, M = 4, 16
-    assert activation_stash_microbatches("1f1b", S, M) == 4
+    assert activation_stash_microbatches("1f1b", S, M) == 7
+    assert activation_stash_microbatches("1f1b_compact", S, M) == 4
     assert activation_stash_microbatches("gpipe", S, M) == 19
     assert (activation_stash_microbatches("1f1b", S, M)
             < activation_stash_microbatches("gpipe", S, M))
@@ -193,8 +197,11 @@ def test_1f1b_activation_memory_bound():
 def test_1f1b_no_redundant_compute():
     """VERDICT r2 weak #3 regression: every 1F1B tick used to execute BOTH a
     masked forward and a full vjp (~2x gpipe's FLOPs). The switch-based
-    schedule runs one unit per tick, so the whole-program analyzed FLOPs
-    must be clearly BELOW gpipe's fwd+AD-bwd program, not above it."""
+    compact schedule runs one unit per tick, so the whole-program analyzed
+    FLOPs must be clearly BELOW gpipe's fwd+AD-bwd program, not above it.
+    (Pinned to 'compact': XLA cost_analysis sums conditional branches, so
+    the fused variant's edge conds over-count — its check is the wall-time
+    measurement in tools/schedule_bench.py.)"""
     dist.init_parallel_env({"pp": 4})
     mesh = mesh_mod.get_mesh()
     S, M = 4, 8
@@ -207,7 +214,8 @@ def test_1f1b_no_redundant_compute():
 
     def f1b(params, head, x, labels):
         return spmd_pipeline_1f1b(_slice_stage_fn, _head_loss, params, head,
-                                  x, labels, n_microbatches=M, mesh=mesh)
+                                  x, labels, n_microbatches=M, mesh=mesh,
+                                  variant="compact")
 
     def gpipe(params, head, x, labels):
         def loss(params, head):
@@ -225,17 +233,19 @@ def test_1f1b_no_redundant_compute():
 
 
 def test_schedule_tradeoff_prune_rule():
-    """The measured gpipe-vs-1f1b tradeoff steers the auto-tuner: gpipe
-    preferred while its stash fits, 1f1b once only its bounded stash does."""
+    """The measured gpipe-vs-1f1b tradeoff steers the auto-tuner: the
+    fused-round 1F1B is faster AND smaller-stash than gpipe
+    (SCHEDULE_BENCH.json), so gpipe is dominated whenever a pipeline exists
+    and 1f1b is pure cost when none does."""
     from paddle_tpu.distributed.auto_tuner.prune import (
         prune_by_schedule_tradeoff)
     tuner = dict(hbm_bytes=0.6e9, num_params=50e6, global_batch_size=32,
                  seq_length=2048, hidden_size=4096)
     base = dict(dp_degree=1, mp_degree=1, pp_degree=4, micro_batches=8)
-    # plenty of headroom: 1f1b pruned, gpipe kept
-    roomy = dict(tuner, hbm_bytes=64e9)
-    assert prune_by_schedule_tradeoff(roomy, dict(base, schedule="1f1b"))
-    assert not prune_by_schedule_tradeoff(roomy, dict(base, schedule="gpipe"))
-    # tight: gpipe stash (M+pp-1=11 microbatches) over budget, 1f1b (4) fits
+    # pipeline present: gpipe dominated, 1f1b kept
     assert prune_by_schedule_tradeoff(tuner, dict(base, schedule="gpipe"))
     assert not prune_by_schedule_tradeoff(tuner, dict(base, schedule="1f1b"))
+    # no pipeline: 1f1b machinery is pure cost
+    flat = dict(base, pp_degree=1)
+    assert prune_by_schedule_tradeoff(tuner, dict(flat, schedule="1f1b"))
+    assert not prune_by_schedule_tradeoff(tuner, dict(flat, schedule="gpipe"))

@@ -1,0 +1,128 @@
+"""Behavioral tests for detection ops (ADVICE r3: matrix_nms decay was inert;
+RoI ops were per-RoI unrolled).  Reference semantics:
+matrix_nms  -> paddle/phi/kernels/cpu/matrix_nms_kernel.cc
+roi_align   -> paddle/phi/kernels/cpu/roi_align_kernel.cc
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.vision.ops import matrix_nms, psroi_pool, roi_align, roi_pool
+
+
+def _dup_boxes():
+    # two near-identical boxes + one distinct, single class (class 1)
+    bb = np.array([[[0, 0, 10, 10], [0, 0, 10, 10.2], [50, 50, 60, 60]]],
+                  np.float32)
+    sc = np.zeros((1, 2, 3), np.float32)
+    sc[0, 1] = [0.9, 0.85, 0.8]
+    return bb, sc
+
+
+class TestMatrixNMS:
+    def test_linear_decay_suppresses_duplicate(self):
+        bb, sc = _dup_boxes()
+        out = matrix_nms(P.to_tensor(bb), P.to_tensor(sc), 0.1,
+                         return_rois_num=False).numpy()
+        by_score = {round(float(r[1]), 6): r for r in out}
+        assert 0.9 in by_score                       # top box undecayed
+        dup = [r for r in out if 10.1 < r[5] < 20]   # the y2=10.2 duplicate
+        assert len(dup) == 1 and dup[0][1] < 0.1, dup
+        distinct = [r for r in out if r[2] > 40]
+        assert len(distinct) == 1 and distinct[0][1] > 0.75
+
+    def test_gaussian_decay_suppresses_duplicate(self):
+        bb, sc = _dup_boxes()
+        out = matrix_nms(P.to_tensor(bb), P.to_tensor(sc), 0.1,
+                         use_gaussian=True, gaussian_sigma=2.0,
+                         return_rois_num=False).numpy()
+        dup = [r for r in out if 10.1 < r[5] < 20]
+        assert len(dup) == 1 and dup[0][1] < 0.4, dup
+        distinct = [r for r in out if r[2] > 40]
+        assert len(distinct) == 1 and distinct[0][1] > 0.75
+
+    def test_compensation_uses_suppressor_row(self):
+        # box C overlaps B (rank 2) heavily but A (rank 1) barely; B itself
+        # overlaps A heavily, so B's decay of C is compensated by (1-iouAB):
+        # decay(C) = min(1-iouAC, (1-iouBC)/(1-iouAB)) — with the OLD
+        # target-column indexing the answer degenerates to exactly 1.0.
+        bb = np.array([[[0, 0, 10, 10],        # A
+                        [0, 3, 10, 13],        # B: iou(A,B)=7/13
+                        [0, 4.5, 10, 14.5]]],  # C: iou(B,C)=8.5/11.5, iou(A,C)~0.38
+                      np.float32)
+        sc = np.zeros((1, 2, 3), np.float32)
+        sc[0, 1] = [0.9, 0.8, 0.7]
+        out = matrix_nms(P.to_tensor(bb), P.to_tensor(sc), 0.01,
+                         return_rois_num=False).numpy()
+        iou_ab = 7 / 13
+        iou_ac = (10 * 5.5) / (10 * 10 + 10 * 10 - 10 * 5.5)
+        iou_bc = 8.5 / 11.5
+        expect_c = 0.7 * min(1 - iou_ac, (1 - iou_bc) / (1 - iou_ab))
+        (got_c,) = [float(r[1]) for r in out if abs(r[3] - 4.5) < 1e-3]
+        np.testing.assert_allclose(got_c, expect_c, rtol=1e-5)
+        # B's own decay has no compensation (its only suppressor is rank-1 A)
+        (got_b,) = [float(r[1]) for r in out if abs(r[3] - 3.0) < 1e-3]
+        np.testing.assert_allclose(got_b, 0.8 * (1 - iou_ab), rtol=1e-5)
+
+
+class TestRoIOps:
+    def _setup(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 16, 16).astype(np.float32)
+        boxes = np.array([[1, 1, 9, 9], [2, 3, 12, 13], [0, 0, 15, 15],
+                          [4, 4, 8, 8]], np.float32)
+        boxes_num = np.array([3, 1], np.int32)  # img0: 3 RoIs, img1: 1
+        return x, boxes, boxes_num
+
+    @pytest.mark.parametrize("op", [roi_align, roi_pool])
+    def test_batched_matches_per_roi(self, op):
+        """The vectorized (all-RoIs-per-image) path must equal running each
+        RoI alone — catches ordering/indexing bugs in the batched sampler."""
+        x, boxes, boxes_num = self._setup()
+        full = op(P.to_tensor(x), P.to_tensor(boxes), P.to_tensor(boxes_num),
+                  output_size=5).numpy()
+        assert full.shape == (4, 4, 5, 5)
+        img_of = [0, 0, 0, 1]
+        for i in range(4):
+            one = op(P.to_tensor(x[img_of[i]:img_of[i] + 1]),
+                     P.to_tensor(boxes[i:i + 1]),
+                     P.to_tensor(np.array([1], np.int32)),
+                     output_size=5).numpy()
+            np.testing.assert_allclose(full[i], one[0], rtol=1e-5, atol=1e-5)
+
+    def test_psroi_pool_shape_and_batching(self):
+        x, boxes, boxes_num = self._setup()
+        x8 = np.tile(x, (1, 2, 1, 1))  # 8 channels = out_c 2 for 2x2 bins
+        out = psroi_pool(P.to_tensor(x8), P.to_tensor(boxes),
+                         P.to_tensor(boxes_num), output_size=2).numpy()
+        assert out.shape == (4, 2, 2, 2)
+        one = psroi_pool(P.to_tensor(x8[1:2]), P.to_tensor(boxes[3:4]),
+                         P.to_tensor(np.array([1], np.int32)),
+                         output_size=2).numpy()
+        np.testing.assert_allclose(out[3], one[0], rtol=1e-5, atol=1e-5)
+
+    def test_roi_align_known_value(self):
+        """Constant feature map -> every aligned bin averages to the const."""
+        x = np.full((1, 1, 8, 8), 3.5, np.float32)
+        out = roi_align(P.to_tensor(x), P.to_tensor(
+            np.array([[1, 1, 6, 6]], np.float32)),
+            P.to_tensor(np.array([1], np.int32)), output_size=2).numpy()
+        np.testing.assert_allclose(out, np.full((1, 1, 2, 2), 3.5), rtol=1e-6)
+
+
+class TestSparseGuard:
+    def test_warn_above_threshold(self, monkeypatch):
+        import paddle_tpu.sparse as S
+        monkeypatch.setattr(S, "_DENSE_WARN_ELEMS", 100)
+        with pytest.warns(ResourceWarning, match="dense backing"):
+            S.sparse_coo_tensor(
+                np.array([[0, 1], [0, 1]]), np.array([1.0, 2.0]),
+                shape=[20, 20])
+
+    def test_error_above_hard_cap(self, monkeypatch):
+        import paddle_tpu.sparse as S
+        monkeypatch.setattr(S, "_DENSE_ERROR_ELEMS", 100)
+        with pytest.raises(ValueError, match="dense-backed"):
+            S.sparse_coo_tensor(
+                np.array([[0, 1], [0, 1]]), np.array([1.0, 2.0]),
+                shape=[20, 20])

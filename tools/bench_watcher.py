@@ -1,0 +1,113 @@
+"""Opportunistic on-chip benchmark capture (VERDICT r3 item 1a).
+
+The TPU relay in this environment wedges for hours at a time; a single
+capture attempt at round end has now failed two rounds running.  This
+watcher runs in the background for the whole round: every few minutes it
+probes the backend in a subprocess (a wedged relay HANGS jax.devices(), so
+in-process probing is unsafe), and the first time the chip answers it runs
+the full benchmark battery and commits the artifacts:
+
+  1. bench.py (7B-proxy config)      -> BENCH_SELF_<ts>.json
+  2. tools/op_benchmark.py --save    -> OPBENCH_<device>.json
+
+On success it commits the artifacts and exits; on a mid-battery relay death
+it keeps looping.  Usage: python tools/bench_watcher.py [--interval 300]
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import glob
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def log(msg: str):
+    ts = datetime.datetime.now().strftime("%H:%M:%S")
+    print(f"[{ts}] {msg}", flush=True)
+
+
+def probe(timeout=90) -> str | None:
+    """Returns device kind on success, None when the backend is unreachable."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    kind = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+    return kind or None
+
+
+def run_battery(kind: str) -> bool:
+    """Run the full bench battery. True if the headline bench succeeded."""
+    env = dict(os.environ, PT_BENCH_SKIP_PROBE="1", PT_BENCH_CONFIG="7b_proxy")
+    log(f"chip answered ({kind}) — running bench.py 7b_proxy")
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       env=env, capture_output=True, text=True, timeout=3600,
+                       cwd=REPO)
+    log(f"bench.py rc={r.returncode}\nstdout: {r.stdout}\nstderr: {r.stderr[-2000:]}")
+    ok = r.returncode == 0 and '"error"' not in r.stdout
+    if not ok:
+        return False
+
+    kind_slug = kind.replace(" ", "_").replace("/", "_")
+    opb = os.path.join(REPO, f"OPBENCH_{kind_slug}.json")
+    try:
+        r2 = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "op_benchmark.py"),
+             "--save", opb],
+            capture_output=True, text=True, timeout=1800, cwd=REPO)
+        log(f"op_benchmark rc={r2.returncode}\n{r2.stdout[-2000:]}\n{r2.stderr[-1000:]}")
+    except subprocess.TimeoutExpired:
+        log("op_benchmark timed out (relay died mid-run?)")
+    return True
+
+
+def commit_artifacts():
+    arts = (glob.glob(os.path.join(REPO, "BENCH_SELF_*.json"))
+            + glob.glob(os.path.join(REPO, "OPBENCH_*.json")))
+    if not arts:
+        return
+    subprocess.run(["git", "add", "--"] + arts, cwd=REPO, check=False)
+    msg = ("Record on-chip benchmark artifacts (7B-proxy MFU + op baseline)"
+           "\n\nNo-Verification-Needed: artifact-only data capture")
+    # pathspec-limited commit: never sweep up unrelated staged work
+    r = subprocess.run(["git", "commit", "-m", msg, "--"] + arts,
+                       cwd=REPO, check=False, capture_output=True, text=True)
+    log(f"artifact commit rc={r.returncode} {r.stdout.strip()[-200:]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=300)
+    ap.add_argument("--once", action="store_true",
+                    help="single probe+battery attempt, no loop")
+    args = ap.parse_args()
+
+    while True:
+        kind = probe()
+        if kind is None:
+            log("backend unreachable")
+        else:
+            try:
+                if run_battery(kind):
+                    commit_artifacts()
+                    log("capture complete — exiting")
+                    return
+            except Exception as e:  # noqa: BLE001 — keep the watch alive
+                log(f"battery failed: {e}")
+        if args.once:
+            return
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
