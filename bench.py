@@ -113,7 +113,7 @@ def _is_oom(e: Exception) -> bool:
 
 
 def _build_and_time(cfg_kwargs, layers, batch, seq, n_steps=20,
-                    warmup=3) -> dict:
+                    warmup=3, fused_loss=False) -> dict:
     """Build the compiled train step for one (layers, batch) point and time
     it.  Raises on OOM (caller adapts)."""
     import jax
@@ -128,7 +128,7 @@ def _build_and_time(cfg_kwargs, layers, batch, seq, n_steps=20,
     model = LlamaForCausalLM(cfg)
     opt = P.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
     step = build_hybrid_train_step(model, opt, n_microbatches=1, remat=True,
-                                   amp=True)
+                                   amp=True, fused_loss=fused_loss)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
     b = {"input_ids": P.to_tensor(ids[:, :-1]),
@@ -222,11 +222,16 @@ def main():
         candidates = [(4, 2, 2048), (3, 2, 2048), (2, 2, 2048),
                       (2, 1, 2048), (1, 1, 2048)]
 
+    # 7b_proxy defaults to the fused lm-head+CE Pallas kernel (skips the
+    # [B*S, 32k] logits + cotangent buffers); PT_BENCH_FUSED_LOSS=0 reverts
+    fused = (config == "7b_proxy"
+             and os.environ.get("PT_BENCH_FUSED_LOSS", "1") == "1")
     meas = None
     oom_log = []
     for layers, batch, seq in candidates:
         try:
-            meas = _build_and_time(cfg_kwargs, layers, batch, seq)
+            meas = _build_and_time(cfg_kwargs, layers, batch, seq,
+                                   fused_loss=fused)
             break
         except Exception as e:  # noqa: BLE001
             if _is_oom(e):
@@ -251,6 +256,7 @@ def main():
     mfu = achieved / peak
 
     detail = {"device": kind, "peak_bf16_tflops": peak, "config": config,
+              "fused_loss": fused,
               "measured": meas, "achieved_tflops": round(achieved, 2),
               "mfu": round(mfu, 4), "oom_log": oom_log}
 
@@ -260,7 +266,8 @@ def main():
         l2 = max(1, meas["layers"] // 2)
         try:
             meas2 = _build_and_time(cfg_kwargs, l2, meas["batch"],
-                                    meas["seq"], n_steps=10)
+                                    meas["seq"], n_steps=10,
+                                    fused_loss=fused)
             b_fit = (dt - meas2["step_time_s"]) / (meas["layers"] - l2)
             a_fit = dt - b_fit * meas["layers"]
             t32 = a_fit + 32 * b_fit

@@ -32,8 +32,9 @@ def disable_tensor_checker():
 def check_numerics(tensor, op_type: str = "tensor", var_name: str = "",
                    debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
     """Count (num_nan, num_inf, num_zero); raise on nan/inf when aborting."""
+    import jax.numpy as jnp
     val = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
-    if not np.issubdtype(np.dtype(val.dtype), np.floating):
+    if not jnp.issubdtype(val.dtype, jnp.floating):  # incl. bf16/fp8
         z = jnp.asarray(0)
         return Tensor(z), Tensor(z), Tensor(jnp.sum(val == 0))
     num_nan = jnp.sum(jnp.isnan(val))

@@ -69,7 +69,12 @@ def dtype_name(d) -> str:
 
 
 def is_floating(d) -> bool:
-    return np.issubdtype(np.dtype(d), np.floating)
+    # jax's dtype lattice, not numpy's: the ml_dtypes extended floats
+    # (bfloat16, float8_*) are NOT np.floating subtypes, and treating them
+    # as non-float silently disabled autograd for bf16 — the TPU training
+    # dtype (caught by the dtype-swept OpTest battery).
+    import jax
+    return jax.dtypes.issubdtype(np.dtype(d), np.floating)
 
 
 def is_complex(d) -> bool:

@@ -73,18 +73,35 @@ def _walk(tree, prefix=""):
 
 
 def _set_in(tree, name, value):
-    if isinstance(tree, dict) and name in tree:  # flat dict with dotted key
-        tree[name] = value
-        return
+    """Set `name` (the dot-joined path `_walk` produced) in `tree`.
+
+    Dict keys may themselves contain dots (parameter names like
+    'input_layernorm.weight' used as state keys), so dict navigation
+    matches the LONGEST dotted key first rather than splitting blindly."""
     parts = name.split(".")
     cur = tree
-    for p in parts[:-1]:
-        cur = cur[p] if isinstance(cur, dict) else cur[int(p)]
-    last = parts[-1]
-    if isinstance(cur, dict):
-        cur[last] = value
-    else:
-        cur[int(last)] = value
+    i = 0
+    while i < len(parts):
+        if isinstance(cur, dict):
+            for j in range(len(parts), i, -1):
+                k = ".".join(parts[i:j])
+                if k in cur:
+                    if j == len(parts):
+                        cur[k] = value
+                        return
+                    cur = cur[k]
+                    i = j
+                    break
+            else:
+                raise KeyError(f"{name!r}: no key matching "
+                               f"{'.'.join(parts[i:])!r} in {list(cur)[:8]}")
+        else:
+            k = int(parts[i])
+            if i == len(parts) - 1:
+                cur[k] = value
+                return
+            cur = cur[k]
+            i += 1
 
 
 def _spec_of(val) -> list:

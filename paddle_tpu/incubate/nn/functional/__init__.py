@@ -24,8 +24,23 @@ from .masked_multihead_attention import masked_multihead_attention
 
 fused_attention = fused_multi_head_attention
 
+
+def fused_linear_cross_entropy(h, weight, labels, name=None):
+    """Pallas-fused lm-head + softmax cross-entropy: per-row CE of
+    softmax(h @ weight) against integer labels WITHOUT materializing the
+    [N, V] logits or their cotangent (ops/pallas/fused_ce.py; reference
+    fused softmax_with_cross_entropy, paddle/phi/kernels/fusion/).
+    h: [N, H] Tensor; weight: [H, V] Tensor; labels: [N] int Tensor."""
+    from ....ops.dispatch import apply
+    from ....ops.pallas.fused_ce import (
+        fused_linear_cross_entropy as _flce)
+    return apply(_flce, h, weight, labels,
+                 op_name="fused_linear_cross_entropy")
+
+
 __all__ = [
     "fused_attention",
+    "fused_linear_cross_entropy",
     "fused_bias_dropout_residual_layer_norm",
     "fused_dropout_add",
     "fused_feedforward",

@@ -48,10 +48,12 @@ def _layer_norm(x, scale, bias, eps):
 
 
 def _rms_norm(x, scale, eps):
-    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    y = x.astype(jnp.float32) * jax.lax.rsqrt(ms + eps)
+    # accumulate in >= fp32 without DOWNcasting fp64 inputs
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    ms = jnp.mean(jnp.square(x.astype(acc)), axis=-1, keepdims=True)
+    y = x.astype(acc) * jax.lax.rsqrt(ms + eps)
     if scale is not None:
-        y = y * scale.astype(jnp.float32)
+        y = y * scale.astype(acc)
     return y.astype(x.dtype)
 
 

@@ -146,15 +146,34 @@ class LlamaAttention(Layer):
             k_cache, v_cache = kv_out[0], kv_out[1]
             s_max = k_cache.shape[1]
 
-            def mk_mask(_shape_ref):
-                j = jnp.arange(s_max)[None, :]
-                i = jnp.arange(s)[:, None] + jnp.asarray(off, jnp.int32)
-                allowed = j <= i
-                return jnp.where(allowed, 0.0, -1e30)[None, None]  # [1,1,s,S]
+            from ..parallel import mesh as mesh_mod
+            mesh = mesh_mod.get_mesh()
+            mp_active = mesh is not None and mesh.shape.get("mp", 1) > 1
+            q_dt = jnp.dtype(q._value.dtype).name
+            if s == 1 and not mp_active and q_dt in (
+                    "float32", "bfloat16", "float16"):
+                # single-token decode: ragged Pallas kernel walks only the
+                # live prefix of the cache (O(t) per token, no [B,H,S_max]
+                # probability tensor) — ops/pallas/decode_attention.py
+                def rag(qq, kc, vc):
+                    from ..ops.pallas.decode_attention import (
+                        ragged_decode_attention)
+                    lengths = jnp.full((qq.shape[0],),
+                                       jnp.asarray(off, jnp.int32) + 1)
+                    return ragged_decode_attention(qq, kc, vc, lengths)
 
-            mask = apply(mk_mask, q, op_name="decode_mask")
-            attn = F.scaled_dot_product_attention(q, k_cache, v_cache,
-                                                  attn_mask=mask)
+                attn = apply(rag, q, k_cache, v_cache,
+                             op_name="ragged_decode_attention")
+            else:
+                def mk_mask(_shape_ref):
+                    j = jnp.arange(s_max)[None, :]
+                    i = jnp.arange(s)[:, None] + jnp.asarray(off, jnp.int32)
+                    allowed = j <= i
+                    return jnp.where(allowed, 0.0, -1e30)[None, None]
+
+                mask = apply(mk_mask, q, op_name="decode_mask")
+                attn = F.scaled_dot_product_attention(q, k_cache, v_cache,
+                                                      attn_mask=mask)
             attn = manip.reshape(attn, [b, s, self.num_heads * self.head_dim])
             return self.o_proj(attn), (k_cache, v_cache)
         cp = self.config.context_parallel
@@ -363,7 +382,8 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                             n_microbatches: int = 1, remat: bool = True,
                             amp: bool = False, schedule: str = "1f1b",
                             n_virtual: int = 1,
-                            accumulate_steps: Optional[int] = None):
+                            accumulate_steps: Optional[int] = None,
+                            fused_loss: bool = False):
     """Build a fully-compiled hybrid train step.
 
     The decoder blocks' params are stacked on a leading dim of size L and
@@ -402,6 +422,37 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
     else:
         n_virtual = 1
     assert L % max(pp, 1) == 0, "layers must divide pp degree"
+
+    # fused lm-head+CE (Pallas, ops/pallas/fused_ce.py): skips the [B,S,V]
+    # logits materialization and its cotangent.  The mp>1 vocab-sharded head
+    # runs in GSPMD auto mode where a pallas_call would force a W gather, so
+    # the fusion is gated to mp==1 (the TP variant lives in
+    # fused_linear_cross_entropy_tp for shard_map callers).
+    use_fused_loss = fused_loss and (
+        mesh is None or mesh.shape.get("mp", 1) <= 1)
+
+    def _head_ce(h_val, labels_val):
+        """norm -> lm head -> CE for the full [B,S,H] h_val (model params
+        already installed by the caller's outer_apply)."""
+        h_out = model.llama.norm(Tensor(h_val))
+        if use_fused_loss:
+            from ..ops.pallas.fused_ce import fused_linear_cross_entropy
+            hv = h_out._value
+            wv = model.lm_head.weight._value
+            flat = labels_val.reshape(-1)
+            # F.cross_entropy semantics: ignore_index (-100) rows contribute
+            # nothing and the mean divides by the VALID count only
+            valid = flat != -100
+            losses = fused_linear_cross_entropy(
+                hv.reshape(-1, hv.shape[-1]), wv,
+                jnp.where(valid, flat, 0))
+            vf = valid.astype(losses.dtype)
+            return jnp.sum(losses * vf) / jnp.maximum(jnp.sum(vf), 1.0)
+        logits = model.lm_head(h_out)
+        if amp:  # softmax/CE in fp32 for numeric stability
+            logits = Tensor(logits._value.astype(jnp.float32))
+        return F.cross_entropy(logits, Tensor(labels_val),
+                               reduction="mean")._value
 
     block0 = model.llama.layers[0]
     block_names, _ = _tree_of_params(block0)
@@ -493,12 +544,7 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                     x2 = y_mb.reshape(b, s, h)
                 else:
                     x2 = blocks_scan(stacked_vals, x)
-                h_out = model.llama.norm(Tensor(x2))
-                logits = model.lm_head(h_out)
-                if amp:  # softmax/CE in fp32 for numeric stability
-                    logits = Tensor(logits._value.astype(jnp.float32))
-                loss = F.cross_entropy(logits, Tensor(labels), reduction="mean")
-                return loss._value
+                return _head_ce(x2, labels)
             return outer_apply(outer_vals, run)
 
     # --- 1F1B: loss AND grads from the manually-scheduled pipeline ---------
@@ -543,13 +589,7 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
                     full[i] = head_vals[k]
 
                 def run():
-                    h_out = model.llama.norm(Tensor(y))
-                    logits = model.lm_head(h_out)
-                    if amp:
-                        logits = Tensor(logits._value.astype(jnp.float32))
-                    loss = F.cross_entropy(logits, Tensor(labels_mb),
-                                           reduction="mean")
-                    return loss._value
+                    return _head_ce(y, labels_mb)
                 return outer_apply(full, run)
 
             labels_mb = labels.reshape(n_microbatches, mb, *labels.shape[1:])

@@ -34,10 +34,12 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
     if causal:
         qlen, klen = logits.shape[-2], logits.shape[-1]
         cmask = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
-        logits = jnp.where(cmask, logits, jnp.asarray(-1e30, logits.dtype))
+        logits = jnp.where(cmask, logits, jnp.finfo(logits.dtype).min)
     if mask is not None:
         logits = logits + mask.astype(logits.dtype)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    # softmax in >= fp32 (bf16/fp16 upcast) without DOWNcasting fp64
+    acc = jnp.promote_types(logits.dtype, jnp.float32)
+    probs = jax.nn.softmax(logits.astype(acc), axis=-1).astype(q.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vT)
     return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
 

@@ -55,7 +55,7 @@ def _max_pool_with_mask(x, kernel, stride, padding, n, name, ceil_mode=False):
     pad = _pad_cfg(padding, n)
 
     def f(v):
-        neg = -jnp.inf if np.issubdtype(v.dtype, np.floating) \
+        neg = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
             else np.iinfo(v.dtype).min
         spatial = v.shape[2:]
         eff_pad = _ceil_adjust(pad, spatial, ks, st) if ceil_mode else list(pad)
@@ -118,7 +118,7 @@ def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, name,
             strides = (1, 1) + st
             pads = ([(0, 0), (0, 0)] + list(sp_pad)) if not isinstance(sp_pad, str) else sp_pad
         if reducer == "max":
-            out = jax.lax.reduce_window(v, -jnp.inf if np.issubdtype(v.dtype, np.floating)
+            out = jax.lax.reduce_window(v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
                                         else np.iinfo(v.dtype).min,
                                         jax.lax.max, window, strides,
                                         pads if not isinstance(pads, str) else pads)

@@ -104,11 +104,19 @@ def _get_amp_hook():
 #   outputs of every eager op and raises on nan/inf.
 _profile_cb = None
 _nan_check = False
+# - _coverage_cb(name): op-name recorder for coverage enumeration
+#   (tools/op_coverage.py — drives the dtype-sweep test battery's top-op list)
+_coverage_cb = None
 
 
 def set_profile_cb(cb):
     global _profile_cb
     _profile_cb = cb
+
+
+def set_coverage_recorder(cb):
+    global _coverage_cb
+    _coverage_cb = cb
 
 
 def set_nan_check(on: bool):
@@ -117,12 +125,11 @@ def set_nan_check(on: bool):
 
 
 def _scan_nan_inf(out, multi, name):
-    import numpy as _np
     outs = out if multi else (out,)
     for o in outs:
         if not isinstance(o, Tensor) or isinstance(o._value, jax.core.Tracer):
             continue
-        if not _np.issubdtype(_np.dtype(o._value.dtype), _np.floating):
+        if not jnp.issubdtype(o._value.dtype, jnp.floating):
             continue
         bad = int(jnp.size(o._value)) - int(jnp.sum(jnp.isfinite(o._value)))
         if bad:
@@ -164,6 +171,8 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
       before execution (the eager_amp_auto_cast.h analog).
     """
     name = op_name or getattr(jax_fn, "__name__", "op")
+    if _coverage_cb is not None:
+        _coverage_cb(name)
     if _static_recorder is not None:
         rec = _static_recorder(jax_fn, args, static_kwargs, name)
         if rec is not NotImplemented:
@@ -172,9 +181,8 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
 
     amp_dt = _get_amp_hook()(name)
     if amp_dt is not None:
-        import numpy as _np
         for i, v in enumerate(vals):
-            if hasattr(v, "dtype") and _np.issubdtype(_np.dtype(v.dtype), _np.floating) \
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) \
                     and v.dtype != amp_dt:
                 vals[i] = v.astype(amp_dt)
     diff_idx = [i for i, a in enumerate(args) if _is_diff_tensor(a)]

@@ -1,0 +1,163 @@
+"""Ragged KV-cache decode attention as a Pallas TPU kernel.
+
+TPU-native equivalent of the reference's masked_multihead_attention decode
+kernel (paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu,
+incubate/nn/layer/fused_transformer.py FusedMultiTransformer decode path):
+one new query token per sequence attends over a static-length KV cache
+[B, S_max, H_kv, D] of which only the first `length[b]` positions are valid.
+
+The jnp composition builds a [B, H, 1, S_max] additive mask and softmaxes
+over the FULL padded S_max every step.  This kernel instead walks the cache
+in chunks with an online softmax and STOPS at the last valid chunk — a
+generation loop at position t does O(t) work, not O(S_max) — and never
+materializes the [B, H, S_max] probability tensor.
+
+Layout: q [B, 1, H, D] (the flash-attn API layout), caches
+[B, S_max, H_kv, D]; grouped-query (H > H_kv) handled by blocking q as
+[B, H_kv, group, D] so each grid cell attends one kv head's group of query
+heads.  `lengths` [B] int32 rides scalar prefetch so the chunk loop bound is
+known before the body runs.  Inference-only (no VJP): the decode path runs
+under no_grad.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _x32():
+    try:
+        from jax._src.config import enable_x64
+        return enable_x64(False)
+    except Exception:  # noqa: BLE001
+        return contextlib.nullcontext()
+
+
+def _interpret() -> bool:
+    from ...core.device import is_tpu_backend
+    return not is_tpu_backend()
+
+
+_NEG_INF = -1e30
+BLOCK_K = 256
+
+
+def _kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems, *,
+            scale, bk, s_max_pad):
+    """K/V stay in HBM; only chunks the length bound reaches are DMA'd into
+    the double-buffered VMEM scratch — HBM traffic per decode step is
+    O(length), not O(S_max) (a BlockSpec copy of the whole cache slice would
+    defeat the ragged point, since decode is bandwidth-bound)."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    length = len_ref[b]
+
+    q = q_ref[0, 0, :, :]                       # (group_pad, D)
+    gp, d = q.shape
+    hi = pl.cdiv(length, bk)                    # chunks with any valid key
+
+    def chunk_dma(ik, slot):
+        # K/V refs are UNBLOCKED (memory_space=ANY): index the full
+        # [B, S_pad, H_kv, D] arrays with the grid cell's (b, h)
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[b, pl.ds(ik * bk, bk), h, :], k_buf.at[slot],
+                sems.at[slot, 0]),
+            pltpu.make_async_copy(
+                v_hbm.at[b, pl.ds(ik * bk, bk), h, :], v_buf.at[slot],
+                sems.at[slot, 1]),
+        )
+
+    @pl.when(hi > 0)
+    def _():
+        for dma in chunk_dma(0, 0):
+            dma.start()
+
+    def body(ik, carry):
+        acc, m, l = carry
+        slot = jax.lax.rem(ik, 2)
+
+        @pl.when(ik + 1 < hi)
+        def _():  # prefetch next chunk into the other slot
+            for dma in chunk_dma(ik + 1, 1 - slot):
+                dma.start()
+
+        for dma in chunk_dma(ik, slot):
+            dma.wait()
+        k = k_buf[slot]
+        v = v_buf[slot]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kid = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (gp, bk), 1)
+        s = jnp.where(kid < length, s, jnp.float32(_NEG_INF))
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                        preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((gp, d), jnp.float32)
+    m0 = jnp.full((gp, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((gp, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(jnp.int32(0), hi, body, (acc0, m0, l0))
+    l = jnp.maximum(l, jnp.float32(1e-30))
+    o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
+
+
+def ragged_decode_attention(q, k_cache, v_cache, lengths, scale=None):
+    """q: [B, 1, H, D]; k_cache/v_cache: [B, S_max, H_kv, D]; lengths: [B]
+    int32 (positions j < lengths[b] are attended). Returns [B, 1, H, D]."""
+    B, one, H, D = q.shape
+    assert one == 1, "decode kernel takes exactly one query token"
+    Hkv, S_max = k_cache.shape[2], k_cache.shape[1]
+    group = H // Hkv
+    s = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+
+    # [B, Hkv, group, D], group padded to the fp32 sublane minimum
+    gp = max(8, group)
+    qg = q.reshape(B, Hkv, group, D)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    d_pad = (-D) % 128
+    if d_pad:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, 0), (0, d_pad)))
+        k_cache = jnp.pad(k_cache, ((0, 0), (0, 0), (0, 0), (0, d_pad)))
+        v_cache = jnp.pad(v_cache, ((0, 0), (0, 0), (0, 0), (0, d_pad)))
+    bk = min(BLOCK_K, max(128, S_max))
+    s_pad = (-S_max) % bk
+    if s_pad:
+        k_cache = jnp.pad(k_cache, ((0, 0), (0, s_pad), (0, 0), (0, 0)))
+        v_cache = jnp.pad(v_cache, ((0, 0), (0, s_pad), (0, 0), (0, 0)))
+    Sp, Dp = k_cache.shape[1], k_cache.shape[3]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, Hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, Dp), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),   # K cache stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # V cache stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, 1, gp, Dp), lambda b, h, *_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, bk, Dp), k_cache.dtype),
+            pltpu.VMEM((2, bk, Dp), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(_kernel, scale=s, bk=bk, s_max_pad=Sp)
+    with _x32():
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, Hkv, gp, Dp), q.dtype),
+            interpret=_interpret(),
+        )(lengths.astype(jnp.int32), qg, k_cache, v_cache)
+    return out[:, :, :group, :D].reshape(B, 1, H, D)

@@ -1,0 +1,464 @@
+"""Dtype-swept OpTest battery (VERDICT r3 item 4; reference protocol:
+test/legacy_test/eager_op_test.py:379 check_output_with_place over
+fp32/fp64/fp16/bf16 + test/white_list/op_accuracy_white_list.py governance).
+
+Three legs per op case:
+  1. forward sweep: op(dtype) vs op(float64) for float64/float32/bf16/fp16,
+     tolerances from tests/op_tolerances.py (per-op overrides recorded there);
+  2. float64 finite-difference gradient check: autograd vs central
+     differences — the formula-correctness leg, now across ~90 differentiable
+     ops instead of a few dozen;
+  3. low-precision gradient sweep: autograd(bf16/fp16) vs autograd(float64)
+     — bf16 is the TPU-native training dtype (this leg is what r3 lacked).
+
+test_top_ops_covered pins the battery to OP_COVERAGE.json (the dispatch-
+instrumented enumeration of what the model zoo executes): every enumerated
+op must have a sweep case or a recorded NOT_SWEPT reason.
+
+The whole module runs with jax x64 enabled (module fixture) so the float64
+reference is real, then restores the session default.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+from ml_dtypes import bfloat16
+
+import paddle_tpu as P
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from op_tolerances import fwd_tol, grad_tol, skip_reason
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DTYPES_FWD = ["float64", "float32", "bfloat16", "float16"]
+DTYPES_LOWP_GRAD = ["bfloat16", "float16"]
+_NP_DT = {"float64": np.float64, "float32": np.float32,
+          "bfloat16": bfloat16, "float16": np.float16}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _x64():
+    # paddle_tpu itself enables x64 at import (reference float64 parity);
+    # restore whatever the session had, don't force it off
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+
+class Case:
+    def __init__(self, op, gen, wrt=(0,), kwargs=None, out_index=0):
+        self.op = op
+        self.gen = gen            # gen(rng) -> list of np arrays (f64 base)
+        self.wrt = tuple(wrt)     # () = forward-only
+        self.kwargs = kwargs or {}
+        self.out_index = out_index
+
+
+def _r(seed):
+    return np.random.RandomState(seed)
+
+
+def _cast(arrays, dtype):
+    dt = _NP_DT[dtype]
+    return [a.astype(dt) if a.dtype.kind == "f" else a for a in arrays]
+
+
+def _run(case, arrays):
+    ts = [Tensor(jax.numpy.asarray(a)) for a in arrays]
+    out = case.op(*ts, **case.kwargs)
+    if isinstance(out, (tuple, list)):
+        out = out[case.out_index]
+    return out
+
+
+def _fwd(case, arrays):
+    out = _run(case, arrays)
+    return np.asarray(out.numpy()).astype(np.float64)
+
+
+def _autograd(case, arrays):
+    ts = [Tensor(jax.numpy.asarray(a)) for a in arrays]
+    for i in case.wrt:
+        ts[i].stop_gradient = False
+    out = case.op(*ts, **case.kwargs)
+    if isinstance(out, (tuple, list)):
+        out = out[case.out_index]
+    out.sum().backward()
+    return [np.asarray(ts[i].grad.numpy()).astype(np.float64)
+            for i in case.wrt]
+
+
+# ---------------------------------------------------------------------------
+# Case registry. Names match the dispatch op names where one exists.
+# ---------------------------------------------------------------------------
+
+def _pair(seed, shape=(3, 4)):
+    r = _r(seed)
+    return lambda rng=None: [r.randn(*shape).copy(), r.randn(*shape).copy()]
+
+
+CASES = {
+    # --- elementwise binary ---
+    "add": Case(P.add, lambda: [_r(0).randn(3, 4), _r(1).randn(3, 4)],
+                wrt=(0, 1)),
+    "subtract": Case(P.subtract,
+                     lambda: [_r(2).randn(3, 4), _r(3).randn(3, 4)],
+                     wrt=(0, 1)),
+    "multiply": Case(P.multiply,
+                     lambda: [_r(4).randn(3, 4), _r(5).randn(3, 4)],
+                     wrt=(0, 1)),
+    "divide": Case(P.divide,
+                   lambda: [_r(6).randn(3, 4), _r(7).rand(3, 4) + 0.5],
+                   wrt=(0, 1)),
+    "pow": Case(P.pow, lambda: [_r(8).rand(3, 4) + 0.5,
+                                _r(9).rand(3, 4) * 2], wrt=(0, 1)),
+    "maximum": Case(P.maximum,
+                    lambda: [_r(10).randn(3, 4), _r(11).randn(3, 4)],
+                    wrt=(0, 1)),
+    "minimum": Case(P.minimum,
+                    lambda: [_r(12).randn(3, 4), _r(13).randn(3, 4)],
+                    wrt=(0, 1)),
+    "atan2": Case(P.atan2,
+                  lambda: [_r(14).randn(3, 4), _r(15).rand(3, 4) + 0.5],
+                  wrt=(0, 1)),
+    "lerp": Case(lambda x, y: P.lerp(x, y, 0.3),
+                 lambda: [_r(16).randn(3, 4), _r(17).randn(3, 4)],
+                 wrt=(0, 1)),
+    # --- elementwise unary ---
+    "exp": Case(P.exp, lambda: [_r(20).randn(3, 4)]),
+    "expm1": Case(P.expm1, lambda: [_r(21).randn(3, 4)]),
+    "log": Case(P.log, lambda: [_r(22).rand(3, 4) + 0.5]),
+    "log1p": Case(P.log1p, lambda: [_r(23).rand(3, 4)]),
+    "log2": Case(P.log2, lambda: [_r(24).rand(3, 4) + 0.5]),
+    "log10": Case(P.log10, lambda: [_r(25).rand(3, 4) + 0.5]),
+    "sqrt": Case(P.sqrt, lambda: [_r(26).rand(3, 4) + 0.2]),
+    "rsqrt": Case(P.rsqrt, lambda: [_r(27).rand(3, 4) + 0.2]),
+    "abs": Case(P.abs, lambda: [_r(28).randn(3, 4) + 0.1]),
+    "floor": Case(P.floor, lambda: [_r(29).randn(3, 4) * 3]),
+    "ceil": Case(P.ceil, lambda: [_r(30).randn(3, 4) * 3]),
+    "round": Case(P.round, lambda: [_r(31).randn(3, 4) * 3]),
+    "sign": Case(P.sign, lambda: [_r(32).randn(3, 4)]),
+    "trunc": Case(P.trunc, lambda: [_r(33).randn(3, 4) * 3], wrt=()),
+    "sin": Case(P.sin, lambda: [_r(34).randn(3, 4)]),
+    "cos": Case(P.cos, lambda: [_r(35).randn(3, 4)]),
+    "tan": Case(P.tan, lambda: [_r(36).rand(3, 4) - 0.5]),
+    "asin": Case(P.asin, lambda: [_r(37).rand(3, 4) * 1.6 - 0.8]),
+    "acos": Case(P.acos, lambda: [_r(38).rand(3, 4) * 1.6 - 0.8]),
+    "atan": Case(P.atan, lambda: [_r(39).randn(3, 4)]),
+    "sinh": Case(P.sinh, lambda: [_r(40).randn(3, 4)]),
+    "cosh": Case(P.cosh, lambda: [_r(41).randn(3, 4)]),
+    "tanh": Case(P.tanh, lambda: [_r(42).randn(3, 4)]),
+    "erf": Case(P.erf, lambda: [_r(43).randn(3, 4)]),
+    "reciprocal": Case(P.reciprocal, lambda: [_r(44).rand(3, 4) + 0.5]),
+    "square": Case(P.square, lambda: [_r(45).randn(3, 4)]),
+    "sigmoid": Case(P.sigmoid, lambda: [_r(46).randn(3, 4)]),
+    "clip": Case(lambda x: P.clip(x, -0.6, 0.6),
+                 lambda: [_r(47).randn(3, 4)]),
+    # --- reductions ---
+    "sum": Case(lambda x: P.sum(x, axis=1), lambda: [_r(50).randn(3, 4)]),
+    "mean": Case(lambda x: P.mean(x, axis=0), lambda: [_r(51).randn(3, 4)]),
+    "max": Case(lambda x: P.max(x, axis=1), lambda: [_r(52).randn(3, 4)]),
+    "min": Case(lambda x: P.min(x, axis=1), lambda: [_r(53).randn(3, 4)]),
+    "prod": Case(lambda x: P.prod(x, axis=1),
+                 lambda: [_r(54).rand(3, 4) + 0.5]),
+    "std": Case(P.std, lambda: [_r(55).randn(3, 4)]),
+    "var": Case(P.var, lambda: [_r(56).randn(3, 4)]),
+    "logsumexp": Case(P.logsumexp, lambda: [_r(57).randn(3, 4)]),
+    "cumsum": Case(lambda x: P.cumsum(x, axis=1),
+                   lambda: [_r(58).randn(3, 4)]),
+    "cumprod": Case(lambda x: P.cumprod(x, dim=1),
+                    lambda: [_r(59).rand(3, 4) + 0.5]),
+    "norm": Case(P.norm, lambda: [_r(60).randn(3, 4)]),
+    # --- search / sort ---
+    "argmax": Case(lambda x: P.argmax(x, axis=1),
+                   lambda: [_r(61).randn(3, 4)], wrt=()),
+    "argmin": Case(lambda x: P.argmin(x, axis=1),
+                   lambda: [_r(62).randn(3, 4)], wrt=()),
+    "sort": Case(lambda x: P.sort(x, axis=1), lambda: [_r(63).randn(3, 4)]),
+    "argsort": Case(lambda x: P.argsort(x, axis=1),
+                    lambda: [_r(64).randn(3, 4)], wrt=()),
+    "topk": Case(lambda x: P.topk(x, 2, axis=1),
+                 lambda: [_r(65).randn(3, 4)], out_index=0),
+    "where": Case(lambda c, x, y: P.where(c, x, y),
+                  lambda: [_r(66).rand(3, 4) > 0.5, _r(67).randn(3, 4),
+                           _r(68).randn(3, 4)], wrt=(1, 2)),
+    # --- linalg-ish ---
+    "matmul": Case(P.matmul, lambda: [_r(70).randn(3, 5), _r(71).randn(5, 4)],
+                   wrt=(0, 1)),
+    "bmm": Case(P.bmm, lambda: [_r(72).randn(2, 3, 4), _r(73).randn(2, 4, 3)],
+                wrt=(0, 1)),
+    "dot": Case(P.dot, lambda: [_r(74).randn(6), _r(75).randn(6)],
+                wrt=(0, 1)),
+    "mv": Case(P.mv, lambda: [_r(76).randn(3, 4), _r(77).randn(4)],
+               wrt=(0, 1)),
+    "outer": Case(P.outer, lambda: [_r(78).randn(3), _r(79).randn(4)],
+                  wrt=(0, 1)),
+    "einsum": Case(lambda a, b: P.einsum("ij,jk->ik", a, b),
+                   lambda: [_r(80).randn(3, 5), _r(81).randn(5, 4)],
+                   wrt=(0, 1)),
+    "trace": Case(P.trace, lambda: [_r(82).randn(4, 4)]),
+    "diag": Case(P.diag, lambda: [_r(83).randn(4, 4)]),
+    "tril": Case(P.tril, lambda: [_r(84).randn(4, 4)]),
+    "triu": Case(P.triu, lambda: [_r(85).randn(4, 4)]),
+    "kron": Case(P.kron, lambda: [_r(86).randn(2, 2), _r(87).randn(2, 3)],
+                 wrt=(0, 1)),
+    "cross": Case(lambda a, b: P.cross(a, b, axis=1),
+                  lambda: [_r(88).randn(2, 3), _r(89).randn(2, 3)],
+                  wrt=(0, 1)),
+    # --- manip ---
+    "reshape": Case(lambda x: P.reshape(x, [4, 3]),
+                    lambda: [_r(90).randn(3, 4)]),
+    "transpose": Case(lambda x: P.transpose(x, [1, 0]),
+                      lambda: [_r(91).randn(3, 4)]),
+    "concat": Case(lambda a, b: P.concat([a, b], axis=0),
+                   lambda: [_r(92).randn(2, 4), _r(93).randn(3, 4)],
+                   wrt=(0, 1)),
+    "split": Case(lambda x: P.split(x, 2, axis=1),
+                  lambda: [_r(94).randn(3, 4)], out_index=0),
+    "stack": Case(lambda a, b: P.stack([a, b], axis=0),
+                  lambda: [_r(95).randn(3, 4), _r(96).randn(3, 4)],
+                  wrt=(0, 1)),
+    "squeeze": Case(lambda x: P.squeeze(x, axis=1),
+                    lambda: [_r(97).randn(3, 1, 4)]),
+    "unsqueeze": Case(lambda x: P.unsqueeze(x, axis=1),
+                      lambda: [_r(98).randn(3, 4)]),
+    "flip": Case(lambda x: P.flip(x, axis=[1]),
+                 lambda: [_r(99).randn(3, 4)]),
+    "roll": Case(lambda x: P.roll(x, 2, axis=1),
+                 lambda: [_r(100).randn(3, 4)]),
+    "tile": Case(lambda x: P.tile(x, [2, 1]), lambda: [_r(101).randn(3, 4)]),
+    "expand": Case(lambda x: P.expand(x, [3, 3, 4]),
+                   lambda: [_r(102).randn(1, 3, 4)]),
+    "flatten": Case(lambda x: P.flatten(x, start_axis=1),
+                    lambda: [_r(103).randn(2, 3, 4)]),
+    "gather": Case(lambda x, i: P.gather(x, i, axis=0),
+                   lambda: [_r(104).randn(5, 4),
+                            np.asarray([0, 2, 4], np.int64)], wrt=(0,)),
+    "index_select": Case(lambda x, i: P.index_select(x, i, axis=1),
+                         lambda: [_r(105).randn(3, 5),
+                                  np.asarray([1, 3], np.int64)], wrt=(0,)),
+    "one_hot": Case(lambda i: F.one_hot(i, 5),
+                    lambda: [np.asarray([0, 3, 4], np.int64)], wrt=()),
+    "pad": Case(lambda x: F.pad(x, [1, 1], value=0.0),
+                lambda: [_r(106).randn(3, 4)]),
+    # --- activations ---
+    "relu": Case(F.relu, lambda: [_r(110).randn(3, 4) + 0.05]),
+    # inputs kept >=0.3 away from the 0 and 6 kinks: bf16 rounding must not
+    # move any element across a gradient discontinuity
+    "relu6": Case(F.relu6, lambda: [np.where(
+        np.abs(_r(111).randn(3, 4) * 2) < 0.3,
+        np.sign(_r(111).randn(3, 4)) * 0.5,
+        _r(111).randn(3, 4) * 2)]),
+    "gelu": Case(F.gelu, lambda: [_r(112).randn(3, 4)]),
+    "silu": Case(F.silu, lambda: [_r(113).randn(3, 4)]),
+    "softplus": Case(F.softplus, lambda: [_r(114).randn(3, 4)]),
+    "softsign": Case(F.softsign, lambda: [_r(115).randn(3, 4)]),
+    "hardswish": Case(F.hardswish, lambda: [_r(116).randn(3, 4) * 3 + 0.1]),
+    "hardsigmoid": Case(F.hardsigmoid,
+                        lambda: [_r(117).randn(3, 4) * 3 + 0.1]),
+    "leaky_relu": Case(F.leaky_relu, lambda: [_r(118).randn(3, 4) + 0.05]),
+    "elu": Case(F.elu, lambda: [_r(119).randn(3, 4)]),
+    "selu": Case(F.selu, lambda: [_r(120).randn(3, 4)]),
+    "mish": Case(F.mish, lambda: [_r(121).randn(3, 4)]),
+    "tanhshrink": Case(F.tanhshrink, lambda: [_r(122).randn(3, 4)]),
+    "hardshrink": Case(F.hardshrink, lambda: [_r(123).randn(3, 4) * 2]),
+    "softshrink": Case(F.softshrink, lambda: [_r(124).randn(3, 4) * 2]),
+    "prelu": Case(F.prelu, lambda: [_r(125).randn(3, 4),
+                                    np.asarray([0.25])], wrt=(0, 1)),
+    "glu": Case(lambda x: F.glu(x, axis=-1), lambda: [_r(126).randn(3, 6)]),
+    "softmax": Case(lambda x: F.softmax(x, axis=-1),
+                    lambda: [_r(127).randn(3, 4)]),
+    "log_softmax": Case(lambda x: F.log_softmax(x, axis=-1),
+                        lambda: [_r(128).randn(3, 4)]),
+    # --- nn building blocks ---
+    "linear": Case(F.linear, lambda: [_r(130).randn(3, 5),
+                                      _r(131).randn(5, 4) * 0.5,
+                                      _r(132).randn(4) * 0.1],
+                   wrt=(0, 1, 2)),
+    "embedding": Case(lambda i, w: F.embedding(i, w),
+                      lambda: [np.asarray([[0, 2], [3, 1]], np.int64),
+                               _r(133).randn(5, 4)], wrt=(1,)),
+    "conv2d": Case(lambda x, w: F.conv2d(x, w, padding=1),
+                   lambda: [_r(134).randn(1, 2, 5, 5),
+                            _r(135).randn(3, 2, 3, 3) * 0.3], wrt=(0, 1)),
+    "conv2d_transpose": Case(lambda x, w: F.conv2d_transpose(x, w),
+                             lambda: [_r(136).randn(1, 2, 4, 4),
+                                      _r(137).randn(2, 3, 3, 3) * 0.3],
+                             wrt=(0, 1)),
+    "max_pool2d": Case(lambda x: F.max_pool2d(x, 2),
+                       lambda: [_r(138).randn(1, 2, 4, 4)]),
+    "avg_pool2d": Case(lambda x: F.avg_pool2d(x, 2),
+                       lambda: [_r(139).randn(1, 2, 4, 4)]),
+    "adaptive_avg_pool2d": Case(lambda x: F.adaptive_avg_pool2d(x, 2),
+                                lambda: [_r(140).randn(1, 2, 6, 6)]),
+    "interpolate": Case(lambda x: F.interpolate(x, scale_factor=2,
+                                                mode="bilinear"),
+                        lambda: [_r(141).randn(1, 2, 3, 3)]),
+    "pixel_shuffle": Case(lambda x: F.pixel_shuffle(x, 2),
+                          lambda: [_r(142).randn(1, 4, 3, 3)]),
+    "layer_norm": Case(lambda x, w, b: F.layer_norm(x, [4], weight=w,
+                                                    bias=b),
+                       lambda: [_r(143).randn(3, 4),
+                                _r(144).rand(4) + 0.5,
+                                _r(145).randn(4) * 0.1], wrt=(0, 1, 2)),
+    "group_norm": Case(lambda x: F.group_norm(x, 2),
+                       lambda: [_r(146).randn(2, 4, 3, 3)]),
+    "instance_norm": Case(F.instance_norm,
+                          lambda: [_r(147).randn(2, 3, 4, 4)]),
+    "batch_norm": Case(
+        lambda x, m, v, w, b: F.batch_norm(x, m, v, weight=w, bias=b,
+                                           training=False),
+        lambda: [_r(148).randn(2, 3, 4, 4), _r(149).randn(3) * 0.1,
+                 _r(150).rand(3) + 0.5, _r(151).rand(3) + 0.5,
+                 _r(152).randn(3) * 0.1], wrt=(0, 3, 4)),
+    "normalize": Case(lambda x: F.normalize(x, axis=1),
+                      lambda: [_r(153).randn(3, 4)]),
+    "cosine_similarity": Case(lambda a, b: F.cosine_similarity(a, b, axis=1),
+                              lambda: [_r(154).randn(3, 4),
+                                       _r(155).randn(3, 4)], wrt=(0, 1)),
+    "sdpa": Case(lambda q, k, v: F.scaled_dot_product_attention(
+        q, k, v, is_causal=True),
+        lambda: [_r(156).randn(1, 4, 2, 8) * 0.5,
+                 _r(157).randn(1, 4, 2, 8) * 0.5,
+                 _r(158).randn(1, 4, 2, 8) * 0.5], wrt=(0, 1, 2)),
+    "rms_norm": Case(
+        lambda x, w: __import__(
+            "paddle_tpu.incubate.nn.functional", fromlist=["x"]
+        ).fused_rms_norm(x, w),
+        lambda: [_r(159).randn(3, 8), _r(160).rand(8) + 0.5], wrt=(0, 1)),
+    # --- losses ---
+    "cross_entropy": Case(
+        lambda x, lab: F.cross_entropy(x, lab, reduction="mean"),
+        lambda: [_r(161).randn(4, 5), np.asarray([0, 2, 4, 1], np.int64)],
+        wrt=(0,)),
+    "nll_loss": Case(
+        lambda x, lab: F.nll_loss(x, lab),
+        lambda: [np.log(_r(162).rand(4, 5) + 0.1),
+                 np.asarray([0, 2, 4, 1], np.int64)], wrt=(0,)),
+    "mse_loss": Case(F.mse_loss, lambda: [_r(163).randn(3, 4),
+                                          _r(164).randn(3, 4)], wrt=(0,)),
+    "l1_loss": Case(F.l1_loss, lambda: [_r(165).randn(3, 4),
+                                        _r(166).randn(3, 4)], wrt=(0,)),
+    "smooth_l1_loss": Case(F.smooth_l1_loss,
+                           lambda: [_r(167).randn(3, 4),
+                                    _r(168).randn(3, 4)], wrt=(0,)),
+    "binary_cross_entropy": Case(
+        F.binary_cross_entropy,
+        lambda: [_r(169).rand(6) * 0.8 + 0.1,
+                 (_r(170).rand(6) > 0.5).astype(np.float64)], wrt=(0,)),
+    # dispatch records this op as 'bce_with_logits'
+    "bce_with_logits": Case(
+        F.binary_cross_entropy_with_logits,
+        lambda: [_r(171).randn(6),
+                 (_r(172).rand(6) > 0.5).astype(np.float64)], wrt=(0,)),
+    "kl_div": Case(
+        lambda x, t: F.kl_div(x, t, reduction="mean"),
+        lambda: [np.log(_r(173).rand(4, 5) + 0.1),
+                 _r(174).rand(4, 5) + 0.1], wrt=(0,)),
+}
+
+# Enumerated-but-not-swept ops: every entry must say where the op IS tested.
+NOT_SWEPT = {
+    "shard_constraint": "sharding annotation, identity numerics "
+                        "(tests/test_distributed.py exercises placement)",
+    "dropout": "stochastic; eval-mode identity + mask statistics tested in "
+               "tests/test_nn.py",
+    "rope": "fused rotary embedding parity tested in "
+            "tests/test_incubate_fused.py",
+    "lstm": "composite recurrent layer; parity in tests/test_nn.py",
+    "clone": "identity copy; covered by tensor-op suite",
+}
+
+
+def _ids():
+    return sorted(CASES)
+
+
+@pytest.mark.parametrize("dtype", DTYPES_FWD)
+@pytest.mark.parametrize("name", _ids())
+def test_forward_dtype(name, dtype):
+    if skip_reason(name, "fwd", dtype):
+        pytest.skip(skip_reason(name, "fwd", dtype))
+    case = CASES[name]
+    base = [np.asarray(a) for a in case.gen()]
+    base = [a.astype(np.float64) if a.dtype.kind == "f" else a for a in base]
+    ref = _fwd(case, base)
+    got = _fwd(case, _cast(base, dtype))
+    rtol, atol = fwd_tol(name, dtype)
+    np.testing.assert_allclose(
+        got, ref, rtol=rtol, atol=atol,
+        err_msg=f"{name} forward at {dtype} vs float64")
+
+
+@pytest.mark.parametrize("name", [n for n in _ids() if CASES[n].wrt])
+def test_grad_fd_float64(name):
+    """Autograd vs central finite differences, genuinely in float64."""
+    if skip_reason(name, "grad", "float64"):
+        pytest.skip(skip_reason(name, "grad", "float64"))
+    case = CASES[name]
+    base = [np.asarray(a) for a in case.gen()]
+    base = [a.astype(np.float64) if a.dtype.kind == "f" else a for a in base]
+    auto = _autograd(case, base)
+    eps = 1e-5
+    rtol, atol = grad_tol(name, "float64")
+    for k, i in enumerate(case.wrt):
+        num = np.zeros_like(base[i], np.float64)
+        flat = base[i].reshape(-1)
+        numf = num.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            up = float(_fwd(case, base).sum())
+            flat[j] = orig - eps
+            dn = float(_fwd(case, base).sum())
+            flat[j] = orig
+            numf[j] = (up - dn) / (2 * eps)
+        np.testing.assert_allclose(
+            auto[k], num, rtol=max(rtol, 1e-5), atol=max(atol, 1e-7),
+            err_msg=f"{name}: autograd vs finite differences (input {i})")
+
+
+@pytest.mark.parametrize("dtype", DTYPES_LOWP_GRAD)
+@pytest.mark.parametrize("name", [n for n in _ids() if CASES[n].wrt])
+def test_grad_low_precision(name, dtype):
+    """Autograd at bf16/fp16 vs autograd at float64 — the TPU training-dtype
+    gradient leg."""
+    if skip_reason(name, "grad", dtype):
+        pytest.skip(skip_reason(name, "grad", dtype))
+    case = CASES[name]
+    base = [np.asarray(a) for a in case.gen()]
+    base = [a.astype(np.float64) if a.dtype.kind == "f" else a for a in base]
+    ref = _autograd(case, base)
+    got = _autograd(case, _cast(base, dtype))
+    rtol, atol = grad_tol(name, dtype)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(
+            g.astype(np.float64), r, rtol=rtol, atol=atol,
+            err_msg=f"{name} grad at {dtype} vs float64")
+
+
+def test_top_ops_covered():
+    """Every op the model zoo executes (OP_COVERAGE.json, regenerated by
+    tools/op_coverage.py) is either dtype-swept here or has a recorded
+    NOT_SWEPT pointer to where it is tested."""
+    path = os.path.join(REPO, "OP_COVERAGE.json")
+    with open(path) as f:
+        cov = json.load(f)["counts"]
+    missing = [op for op in cov
+               if op not in CASES and op not in NOT_SWEPT]
+    assert not missing, (
+        f"model-zoo ops with no dtype-sweep case and no recorded "
+        f"exemption: {missing}")
+
+
+def test_battery_size():
+    """The battery must stay at top-100 scale (VERDICT r3 item 4)."""
+    assert len(CASES) >= 100, len(CASES)

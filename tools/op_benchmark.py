@@ -44,7 +44,7 @@ def _cases():
     k = t((2, 512, 8, 64))
     v = t((2, 512, 8, 64))
 
-    return [
+    cases = [
         ("matmul_1kx1k", lambda: P.matmul(a1k, b1k)),
         ("add_1kx1k", lambda: a1k + b1k),
         ("softmax_8x512x512", lambda: P.nn.functional.softmax(seq, axis=-1)),
@@ -60,6 +60,80 @@ def _cases():
         ("sdpa_2x512x8x64",
          lambda: P.nn.functional.scaled_dot_product_attention(
              q, k, v, is_causal=True)),
+    ]
+    cases += _pallas_vs_jnp_cases()
+    return cases
+
+
+def _pallas_vs_jnp_cases():
+    """Pallas kernel vs jnp-composition pairs (VERDICT r3 items 6/7 gate:
+    the committed on-chip baseline must show the kernel delta).  Only added
+    on a real TPU backend — in CPU interpret mode the kernels measure the
+    interpreter, not the program."""
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        return []
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas.decode_attention import ragged_decode_attention
+    from paddle_tpu.ops.pallas.fused_ce import fused_linear_cross_entropy
+
+    rng = np.random.RandomState(0)
+    N, H, V = 4096, 4096, 32000
+    h = jnp.asarray(rng.randn(N, H).astype(np.float32) * 0.02).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.randn(H, V).astype(np.float32) * 0.02).astype(jnp.bfloat16)
+    lab = jnp.asarray(rng.randint(0, V, (N,)), jnp.int32)
+    g = jnp.ones((N,), jnp.float32) / N
+
+    def jnp_ce(h, w, lab):
+        s = (h @ w).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        return lse - jnp.take_along_axis(s, lab[:, None], 1)[:, 0]
+
+    flce_grad = jax.grad(
+        lambda a, b, c: jnp.sum(fused_linear_cross_entropy(a, b, c) * g),
+        argnums=(0, 1))
+    jnp_grad = jax.grad(
+        lambda a, b, c: jnp.sum(jnp_ce(a, b, c) * g), argnums=(0, 1))
+
+    B, Smax, Hh, Hkv, D = 8, 2048, 32, 32, 128
+    qd = jnp.asarray(rng.randn(B, 1, Hh, D).astype(np.float32)).astype(jnp.bfloat16)
+    kc = jnp.asarray(rng.randn(B, Smax, Hkv, D).astype(np.float32)).astype(jnp.bfloat16)
+    vc = jnp.asarray(rng.randn(B, Smax, Hkv, D).astype(np.float32)).astype(jnp.bfloat16)
+    lengths = jnp.full((B,), 1536, jnp.int32)
+
+    def jnp_decode(qv, kv, vv, lens):
+        s = jnp.einsum("bqhd,bkhd->bhqk", qv, kv) / np.sqrt(D)
+        mask = jnp.arange(Smax)[None, None, None, :] < lens[:, None, None, None]
+        p = jax.nn.softmax(jnp.where(mask, s.astype(jnp.float32), -1e30), -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(vv.dtype), vv)
+
+    from paddle_tpu.core.tensor import Tensor
+
+    def wrapjit(fn, *args):
+        """jit with the arrays as real ARGUMENTS (closure capture would bake
+        them into the HLO as constants)."""
+        compiled = jax.jit(fn)
+        return lambda: Tensor(jax.tree_util.tree_leaves(compiled(*args))[0])
+
+    return [
+        ("fused_linear_ce_fwd_4kx32k",
+         wrapjit(lambda a, b, c: fused_linear_cross_entropy(a, b, c),
+                 h, w, lab)),
+        ("jnp_linear_ce_fwd_4kx32k",
+         wrapjit(lambda a, b, c: jnp_ce(a, b, c), h, w, lab)),
+        ("fused_linear_ce_grad_4kx32k",
+         wrapjit(lambda a, b, c: flce_grad(a, b, c), h, w, lab)),
+        ("jnp_linear_ce_grad_4kx32k",
+         wrapjit(lambda a, b, c: jnp_grad(a, b, c), h, w, lab)),
+        ("ragged_decode_attn_8x2048",
+         wrapjit(lambda a, b, c, d: ragged_decode_attention(a, b, c, d),
+                 qd, kc, vc, lengths)),
+        ("jnp_masked_decode_attn_8x2048",
+         wrapjit(lambda a, b, c, d: jnp_decode(a, b, c, d),
+                 qd, kc, vc, lengths)),
     ]
 
 
