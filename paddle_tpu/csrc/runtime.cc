@@ -172,10 +172,16 @@ PT_API void pt_queue_free(void* q_) { delete (BlockingQueue*)q_; }
 //   response: i64 status_or_value | u32 vallen | val
 // cmds: 1=SET 2=GET(blocking until key exists) 3=ADD(i64 delta in val)
 //       4=WAIT(blocking) 5=DELETE 6=PING
+//       7=LEASE(grant/refresh; val = i64 ttl_ms; expiry on the SERVER clock)
+//       8=LEASE_CHECK(status 1 = alive, 0 = expired/absent)
+// Leases give ETCD-style store-side liveness (reference
+// fleet/elastic/manager.py:126): expiry is decided by the store's own
+// clock, so every observer agrees regardless of its local timing.
 
 namespace {
 
-constexpr uint8_t kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kDel = 5, kPing = 6;
+constexpr uint8_t kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kDel = 5, kPing = 6,
+                  kLease = 7, kLeaseCheck = 8;
 
 bool read_full(int fd, void* buf, size_t n) {
   auto* p = (uint8_t*)buf;
@@ -208,6 +214,7 @@ struct StoreServer {
   std::mutex mu;
   std::condition_variable cv;  // signalled on any mutation
   std::map<std::string, std::vector<uint8_t>> kv;
+  std::map<std::string, std::chrono::steady_clock::time_point> leases;
   // live connection fds: stop() must shutdown() each so handlers blocked in
   // recv() on still-open (or half-dead) client connections actually wake up
   std::mutex conn_mu;
@@ -269,6 +276,27 @@ struct StoreServer {
           std::lock_guard<std::mutex> lk(mu);
           status = (int64_t)kv.erase(key);
           cv.notify_all();
+          break;
+        }
+        case kLease: {
+          int64_t ttl_ms = 0;
+          if (val.size() == 8) memcpy(&ttl_ms, val.data(), 8);
+          std::lock_guard<std::mutex> lk(mu);
+          leases[key] = std::chrono::steady_clock::now() +
+                        std::chrono::milliseconds(ttl_ms);
+          break;
+        }
+        case kLeaseCheck: {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = leases.find(key);
+          if (it == leases.end()) {
+            status = 0;
+          } else if (std::chrono::steady_clock::now() < it->second) {
+            status = 1;
+          } else {
+            leases.erase(it);  // lazy expiry
+            status = 0;
+          }
           break;
         }
         case kPing:
@@ -468,6 +496,24 @@ PT_API int pt_store_delete(void* c_, const char* key) {
   if (!client_rpc((StoreClient*)c_, kDel, key, nullptr, 0, &status, &reply))
     return -1;
   return (int)status;
+}
+
+PT_API int pt_store_lease(void* c_, const char* key, long long ttl_ms) {
+  int64_t status = 0;
+  std::vector<uint8_t> reply;
+  int64_t t = ttl_ms;
+  if (!client_rpc((StoreClient*)c_, kLease, key, &t, 8, &status, &reply))
+    return -1;
+  return 0;
+}
+
+PT_API int pt_store_lease_check(void* c_, const char* key) {
+  int64_t status = 0;
+  std::vector<uint8_t> reply;
+  if (!client_rpc((StoreClient*)c_, kLeaseCheck, key, nullptr, 0, &status,
+                  &reply))
+    return -1;
+  return (int)status;  // 1 alive, 0 expired/absent
 }
 
 PT_API void pt_store_client_free(void* c_) {

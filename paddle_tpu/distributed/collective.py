@@ -122,8 +122,8 @@ def _axis_from_ranks(ranks) -> Optional[str]:
     return matches[0] if len(matches) == 1 else None
 
 
-def get_group(gid: int = 0) -> Group:
-    return _groups.get(gid, _default_group())
+def get_group(id: int = 0) -> Group:
+    return _groups.get(id, _default_group())
 
 
 def get_backend(group=None) -> str:
@@ -294,15 +294,15 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis_concat=0):
     return gathered
 
 
-def all_gather_object(obj_list, obj, group=None):
+def all_gather_object(object_list, obj, group=None):
     n = group.nranks if group is not None else 1
-    obj_list.extend([obj] * max(n, 1))
+    object_list.extend([obj] * max(n, 1))
 
 
-def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     axis = _axis_of(group)
-    src = tensor_or_tensor_list
+    src = tensor_list
     if isinstance(src, list):
         from ..ops.manip import concat
         src = concat(src, axis=0)

@@ -66,7 +66,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     return out_object_list
 
 
-def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     """Single-tensor all-to-all: dim 0 split across the group, one chunk to
     each rank (process_group.h AllToAll single form); lowered through the

@@ -18,6 +18,7 @@ from typing import Optional
 from ..utils import native
 
 _SET, _GET, _ADD, _WAIT, _DEL, _PING = 1, 2, 3, 4, 5, 6
+_LEASE, _LEASE_CHECK = 7, 8
 
 
 class _PyStoreServer:
@@ -25,6 +26,7 @@ class _PyStoreServer:
 
     def __init__(self, port: int):
         self._kv = {}
+        self._leases = {}  # key -> monotonic expiry (SERVER-side TTL)
         self._cond = threading.Condition()
         self._stopping = False
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -92,6 +94,22 @@ class _PyStoreServer:
                     with self._cond:
                         status = int(self._kv.pop(key, None) is not None)
                         self._cond.notify_all()
+                elif cmd == _LEASE:
+                    import time as _t
+                    (ttl_ms,) = struct.unpack("<q", val)
+                    with self._cond:
+                        self._leases[key] = _t.monotonic() + ttl_ms / 1e3
+                elif cmd == _LEASE_CHECK:
+                    import time as _t
+                    with self._cond:
+                        exp = self._leases.get(key)
+                        if exp is None:
+                            status = 0
+                        elif _t.monotonic() < exp:
+                            status = 1
+                        else:
+                            self._leases.pop(key, None)  # lazy expiry
+                            status = 0
                 elif cmd == _PING:
                     status = 42
                 else:
@@ -191,6 +209,26 @@ class TCPStore:
             return self._lib.pt_store_delete(self._client, key.encode()) > 0
         status, _ = self._client.rpc(_DEL, key)
         return status > 0
+
+    def lease(self, key: str, ttl_ms: int) -> None:
+        """Grant/refresh a TTL lease on `key`.  Expiry is decided by the
+        STORE's clock (ETCD-lease semantics, reference
+        fleet/elastic/manager.py:126): all observers agree on liveness."""
+        if self._lib is not None:
+            if self._lib.pt_store_lease(self._client, key.encode(),
+                                        int(ttl_ms)) != 0:
+                raise RuntimeError("TCPStore.lease failed")
+        else:
+            self._client.rpc(_LEASE, key, struct.pack("<q", int(ttl_ms)))
+
+    def lease_alive(self, key: str) -> bool:
+        if self._lib is not None:
+            rc = self._lib.pt_store_lease_check(self._client, key.encode())
+            if rc < 0:
+                raise RuntimeError("TCPStore.lease_check failed")
+            return rc == 1
+        status, _ = self._client.rpc(_LEASE_CHECK, key)
+        return status == 1
 
     def stop(self):
         if self._lib is not None:

@@ -18,10 +18,10 @@ __all__ = [
 ]
 
 
-def _un(name, fn):
-    def op(x, name_=None):
-        return apply(fn, x, op_name=name)
-    op.__name__ = name
+def _un(opname, fn):
+    def op(x, name=None):
+        return apply(fn, x, op_name=opname)
+    op.__name__ = opname
     return op
 
 
@@ -138,7 +138,8 @@ def glu(x, axis=-1):
     return apply(lambda v: jax.nn.glu(v, axis=axis), x, op_name="glu")
 
 
-def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=False, key=None):
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=False, name=None,
+          key=None):
     if training:
         from ...core import generator as gen
         k = key if key is not None else gen.next_key()

@@ -64,17 +64,19 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
     return apply(f, x, op_name="dropout")
 
 
-def dropout2d(x, p=0.5, training=True, data_format="NCHW", key=None):
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None,
+              key=None):
     axis = [0, 1] if data_format == "NCHW" else [0, 3]
     return dropout(x, p, axis=axis, training=training, key=key)
 
 
-def dropout3d(x, p=0.5, training=True, data_format="NCDHW", key=None):
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None,
+              key=None):
     axis = [0, 1] if data_format == "NCDHW" else [0, 4]
     return dropout(x, p, axis=axis, training=training, key=key)
 
 
-def alpha_dropout(x, p=0.5, training=True, key=None):
+def alpha_dropout(x, p=0.5, training=True, name=None, key=None):
     if not training or p == 0.0:
         return x
     k = key if key is not None else gen.next_key()
@@ -199,9 +201,11 @@ def _resize_align_corners(v, out_shape, method, chan_last):
     return out
 
 
-def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
-             data_format="NCHW"):
-    return interpolate(x, size, scale_factor, mode, align_corners, data_format=data_format)
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode=align_mode, data_format=data_format)
 
 
 def pixel_shuffle(x, upscale_factor, data_format="NCHW"):

@@ -76,13 +76,14 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 
 def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
-                    groups, n, channel_last, name):
+                    groups, n, channel_last, name, output_size=None):
     spatial = {1: "W", 2: "HW", 3: "DHW"}[n]
     lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
     dn = (lhs_spec, "IO" + spatial, lhs_spec)  # paddle transpose-conv weight: [in, out, *k]
     strides = _tuple(stride, n)
     dil = _tuple(dilation, n)
     opad = _tuple(output_padding, n)
+    osize = _tuple(output_size, n) if output_size is not None else None
 
     def f(v, w, *b):
         k = w.shape[2:]
@@ -92,10 +93,25 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
             p = [(0, 0)] * n
         else:
             p = _padding(padding, n)
+        eff_opad = list(opad)
+        if osize is not None:
+            # reference output_size semantics: it selects among the
+            # stride-ambiguous output sizes by fixing the output padding:
+            # out = (in-1)*s - (p_lo+p_hi) + d*(k-1) + 1 + output_padding
+            in_sp = v.shape[1:1 + n] if channel_last else v.shape[2:2 + n]
+            for i in range(n):
+                base = ((in_sp[i] - 1) * strides[i] - p[i][0] - p[i][1]
+                        + dil[i] * (k[i] - 1) + 1)
+                extra = osize[i] - base
+                if not 0 <= extra < max(strides[i], 1):
+                    raise ValueError(
+                        f"{name}: output_size[{i}]={osize[i]} unreachable "
+                        f"(valid range [{base}, {base + strides[i] - 1}])")
+                eff_opad[i] = extra
         # transposed conv == gradient conv: lhs-dilate by stride, flip kernel
         # spatially, contract over the `in` dim of the [in, out, *k] weight
         pad = [(dil[i] * (k[i] - 1) - p[i][0],
-                dil[i] * (k[i] - 1) - p[i][1] + opad[i]) for i in range(n)]
+                dil[i] * (k[i] - 1) - p[i][1] + eff_opad[i]) for i in range(n)]
         w_flipped = jax.numpy.flip(w, axis=tuple(range(2, 2 + n)))
         out = jax.lax.conv_general_dilated(
             v, w_flipped, window_strides=(1,) * n, padding=pad,
@@ -112,21 +128,28 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
     return apply(f, x, weight, op_name=name)
 
 
-def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, data_format="NCL", name=None):
-    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
-                           groups, 1, data_format == "NLC", "conv1d_transpose")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, 1,
+                           data_format == "NLC",
+                           "conv1d_transpose", output_size=output_size)
 
 
-def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, data_format="NCHW", output_size=None,
-                     name=None):
-    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
-                           groups, 2, data_format == "NHWC", "conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, 2,
+                           data_format == "NHWC",
+                           "conv2d_transpose", output_size=output_size)
 
 
-def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, data_format="NCDHW", output_size=None,
-                     name=None):
-    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
-                           groups, 3, data_format == "NDHWC", "conv3d_transpose")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, 3,
+                           data_format == "NDHWC",
+                           "conv3d_transpose", output_size=output_size)

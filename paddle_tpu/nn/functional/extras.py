@@ -100,9 +100,9 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     return apply(f, x, op_name="gumbel_softmax")
 
 
-def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
     from ...ops import breadth
-    return breadth.diag_embed(x, offset, dim1, dim2)
+    return breadth.diag_embed(input, offset, dim1, dim2)
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
@@ -493,8 +493,8 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     return apply(f, x, grid, op_name="grid_sample")
 
 
-def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
-                   name=None):
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
     """Shift a channel slice one step along the segment (time) axis
     (vision.py temporal_shift, the TSM op)."""
     def f(v):

@@ -24,8 +24,9 @@ def _reduce(v, reduction):
     return v
 
 
-def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
-                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0):
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None, label_smoothing=0.0):
     def f(logits, lab, *w):
         if use_softmax:
             logp = jax.nn.log_softmax(logits, axis=axis)
@@ -66,7 +67,9 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     return apply(f, *args, op_name="cross_entropy")
 
 
-def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100,
+                               numeric_stable_mode=True,
                                return_softmax=False, axis=-1):
     loss = cross_entropy(logits, label, soft_label=soft_label,
                          ignore_index=ignore_index, reduction="none", axis=axis)
@@ -204,8 +207,9 @@ def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
     return apply(f, input1, input2, label, op_name="cosine_embedding_loss")
 
 
-def triplet_margin_loss(input, positive, negative, margin=1.0, p=2, eps=1e-6,
-                        swap=False, reduction="mean"):
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
     def f(a, pos, neg):
         dp = jnp.sum(jnp.abs(a - pos) ** p, -1) ** (1 / p)
         dn = jnp.sum(jnp.abs(a - neg) ** p, -1) ** (1 / p)

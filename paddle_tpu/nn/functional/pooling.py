@@ -136,8 +136,8 @@ def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, name,
     return apply(f, x, op_name=name)
 
 
-def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
-               data_format="NCL"):
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None, data_format="NCL"):
     return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC", "avg", 0.0,
                  "avg_pool1d", ceil_mode, not exclusive, exclusive)
 
@@ -155,7 +155,7 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
-               ceil_mode=False, data_format="NCL"):
+               ceil_mode=False, name=None, data_format="NCL"):
     if return_mask:
         if data_format == "NLC":
             raise NotImplementedError("return_mask requires channel-first")
@@ -212,7 +212,7 @@ def _adaptive(x, output_size, n, channel_last, mode, name):
     return apply(f, x, op_name=name)
 
 
-def adaptive_avg_pool1d(x, output_size, data_format="NCL"):
+def adaptive_avg_pool1d(x, output_size, name=None, data_format="NCL"):
     return _adaptive(x, output_size, 1, data_format == "NLC", "avg", "adaptive_avg_pool1d")
 
 
@@ -224,13 +224,16 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
     return _adaptive(x, output_size, 3, data_format == "NDHWC", "avg", "adaptive_avg_pool3d")
 
 
-def adaptive_max_pool1d(x, output_size, return_mask=False, data_format="NCL"):
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None,
+                        data_format="NCL"):
     return _adaptive(x, output_size, 1, data_format == "NLC", "max", "adaptive_max_pool1d")
 
 
-def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None,
+                        data_format="NCHW"):
     return _adaptive(x, output_size, 2, data_format == "NHWC", "max", "adaptive_max_pool2d")
 
 
-def adaptive_max_pool3d(x, output_size, return_mask=False, data_format="NCDHW"):
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None,
+                        data_format="NCDHW"):
     return _adaptive(x, output_size, 3, data_format == "NDHWC", "max", "adaptive_max_pool3d")

@@ -8,15 +8,23 @@ from .layers import Layer
 
 
 def _mk(name, fn_name, **defaults):
-    def __init__(self, name=None, **kw):
-        Layer.__init__(self)
-        self._kw = {**defaults, **{k: v for k, v in kw.items() if k in defaults}}
+    """Synthesize an activation Layer whose __init__ exposes the functional's
+    config args as REAL positional parameters in the reference's order
+    (e.g. LeakyReLU(negative_slope, name) — a bare **kw would silently bind
+    a positional LeakyReLU(0.1) to `name` and ignore it)."""
+    arglist = "".join(f"{k}={v!r}, " for k, v in defaults.items())
+    kwdict = ", ".join(f"{k!r}: {k}" for k in defaults)
+    ns = {"Layer": Layer}
+    exec(  # noqa: S102 — static strings derived from the defaults dict
+        f"def __init__(self, {arglist}name=None):\n"
+        f"    Layer.__init__(self)\n"
+        f"    self._kw = {{{kwdict}}}\n", ns)
 
     def forward(self, x):
         return getattr(F, fn_name)(x, **self._kw)
 
-    cls = type(name, (Layer,), {"__init__": __init__, "forward": forward})
-    return cls
+    return type(name, (Layer,), {"__init__": ns["__init__"],
+                                 "forward": forward})
 
 
 ReLU = _mk("ReLU", "relu")
@@ -30,7 +38,8 @@ Swish = _mk("Swish", "swish")
 Mish = _mk("Mish", "mish")
 GELU = _mk("GELU", "gelu", approximate=False)
 ELU = _mk("ELU", "elu", alpha=1.0)
-SELU = _mk("SELU", "selu")
+SELU = _mk("SELU", "selu", scale=1.0507009873554805,
+           alpha=1.6732632423543772)
 CELU = _mk("CELU", "celu", alpha=1.0)
 LeakyReLU = _mk("LeakyReLU", "leaky_relu", negative_slope=0.01)
 Hardsigmoid = _mk("Hardsigmoid", "hardsigmoid")

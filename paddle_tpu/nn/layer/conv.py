@@ -93,34 +93,40 @@ class Conv1DTranspose(_ConvNd):
                          transposed=True, output_padding=output_padding)
 
     def forward(self, x, output_size=None):
-        return F.conv1d_transpose(x, self.weight, self.bias, self.stride, self.padding,
-                                  self.output_padding, self.groups, self.dilation,
-                                  self.data_format)
+        return F.conv1d_transpose(
+            x, self.weight, self.bias, stride=self.stride,
+            padding=self.padding, output_padding=self.output_padding,
+            groups=self.groups, dilation=self.dilation,
+            output_size=output_size, data_format=self.data_format)
 
 
 class Conv2DTranspose(_ConvNd):
-    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
-                 output_padding=0, groups=1, dilation=1, weight_attr=None,
-                 bias_attr=None, data_format="NCHW"):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
         super().__init__(in_channels, out_channels, kernel_size, stride, padding,
                          dilation, groups, weight_attr, bias_attr, data_format, 2,
                          transposed=True, output_padding=output_padding)
 
     def forward(self, x, output_size=None):
-        return F.conv2d_transpose(x, self.weight, self.bias, self.stride, self.padding,
-                                  self.output_padding, self.groups, self.dilation,
-                                  self.data_format)
+        return F.conv2d_transpose(
+            x, self.weight, self.bias, stride=self.stride,
+            padding=self.padding, output_padding=self.output_padding,
+            groups=self.groups, dilation=self.dilation,
+            output_size=output_size, data_format=self.data_format)
 
 
 class Conv3DTranspose(_ConvNd):
-    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
-                 output_padding=0, groups=1, dilation=1, weight_attr=None,
-                 bias_attr=None, data_format="NCDHW"):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
         super().__init__(in_channels, out_channels, kernel_size, stride, padding,
                          dilation, groups, weight_attr, bias_attr, data_format, 3,
                          transposed=True, output_padding=output_padding)
 
     def forward(self, x, output_size=None):
-        return F.conv3d_transpose(x, self.weight, self.bias, self.stride, self.padding,
-                                  self.output_padding, self.groups, self.dilation,
-                                  self.data_format)
+        return F.conv3d_transpose(
+            x, self.weight, self.bias, stride=self.stride,
+            padding=self.padding, output_padding=self.output_padding,
+            groups=self.groups, dilation=self.dilation,
+            output_size=output_size, data_format=self.data_format)

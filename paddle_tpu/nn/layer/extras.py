@@ -25,6 +25,9 @@ class PairwiseDistance(Layer):
 class Softmax2D(Layer):
     """Softmax over the channel dim of NCHW input (layer/activation.py)."""
 
+    def __init__(self, name=None):
+        super().__init__()
+
     def forward(self, x):
         if x.ndim not in (3, 4):
             raise ValueError(f"Softmax2D expects 3-D/4-D input, got {x.ndim}-D")

@@ -4,6 +4,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
+from ...ops.dispatch import apply
 from .. import functional as F
 from ..initializer import Constant
 from .layers import Layer
@@ -58,6 +59,12 @@ class SyncBatchNorm(_BatchNormBase):
     """On TPU, batch stats are computed over the global (sharded) batch inside pjit,
     so SyncBatchNorm ≡ BatchNorm under SPMD; kept for API parity
     (reference: python/paddle/nn/layer/norm.py SyncBatchNorm)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, None, name)
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):
@@ -163,6 +170,54 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12, name=None):
+    """Spectral normalization as a LAYER: forward(weight) returns
+    weight / sigma_max(weight), sigma estimated by power iteration carried
+    in persistent u/v buffers (reference nn/layer/norm.py SpectralNorm,
+    spectral_norm_op semantics; the hook form is nn.utils.spectral_norm)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm layer: planned (utils.spectral_norm)")
+        import numpy as _np
+
+        self._dim = int(dim)
+        self._power_iters = int(power_iters)
+        self._eps = float(eps)
+        shape = [int(s) for s in weight_shape]
+        h = shape[self._dim]
+        w = 1
+        for i, s in enumerate(shape):
+            if i != self._dim:
+                w *= s
+        rng = _np.random.RandomState(0)
+        self.weight_u = self.create_parameter([h], dtype=dtype)
+        self.weight_v = self.create_parameter([w], dtype=dtype)
+        self.weight_u._set_value(jnp.asarray(
+            rng.randn(h).astype(dtype)))
+        self.weight_v._set_value(jnp.asarray(
+            rng.randn(w).astype(dtype)))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+
+        def f(wv, u, v):
+            mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+
+            def norm(a):
+                return a / jnp.maximum(jnp.linalg.norm(a), eps)
+            for _ in range(iters):
+                v = norm(mat.T @ u)
+                u = norm(mat @ v)
+            sigma = u @ mat @ v
+            return wv / sigma, u, v
+
+        out = apply(f, weight, self.weight_u, self.weight_v,
+                    op_name="spectral_norm")
+        w_out, u_new, v_new = out[0], out[1], out[2]
+        import jax as _jax
+        if not isinstance(u_new._value, _jax.core.Tracer):
+            self.weight_u._set_value(u_new._value)
+            self.weight_v._set_value(v_new._value)
+        return w_out

@@ -135,8 +135,8 @@ def matrix_rank(x, tol=None, hermitian=False):
     return apply(lambda v: jnp.linalg.matrix_rank(v, rtol=tol), x, op_name="matrix_rank")
 
 
-def multi_dot(tensors):
-    return apply(lambda *vs: jnp.linalg.multi_dot(list(vs)), *tensors, op_name="multi_dot")
+def multi_dot(x, name=None):
+    return apply(lambda *vs: jnp.linalg.multi_dot(list(vs)), *x, op_name="multi_dot")
 
 
 def lstsq(x, y, rcond=None, driver=None):
@@ -184,7 +184,7 @@ def pca_lowrank(x, q=None, center=True, niter=2):
     return out[0], out[1], out[2]
 
 
-def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     """Unpack jax.scipy lu_factor output into (P, L, U) (paddle.linalg.lu_unpack;
     pivots are 1-based as produced by paddle_tpu.linalg.lu)."""
     def f(lu_, piv):
@@ -213,5 +213,5 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
             P = jax.vmap(lambda p: jnp.eye(m, dtype=lu_.dtype)[:, p])(perms)
             P = P.reshape(*piv0.shape[:-1], m, m)
         return P, L, U
-    out = apply(f, lu_data, lu_pivots, op_name="lu_unpack")
+    out = apply(f, x, y, op_name="lu_unpack")
     return out[0], out[1], out[2]

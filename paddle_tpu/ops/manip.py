@@ -142,11 +142,11 @@ def chunk(x, chunks, axis=0):
 
 
 @_export
-def unbind(x, axis=0):
-    n = x.shape[axis]
+def unbind(input, axis=0):
+    n = input.shape[axis]
     def f(v):
         return tuple(jnp.squeeze(s, axis) for s in jnp.split(v, n, axis))
-    return list(apply(f, x, op_name="unbind"))
+    return list(apply(f, input, op_name="unbind"))
 
 
 @_export
@@ -180,11 +180,11 @@ def expand_as(x, y):
 
 
 @_export
-def broadcast_tensors(inputs):
-    vals = [_u(t) for t in inputs]
+def broadcast_tensors(input, name=None):
+    vals = [_u(t) for t in input]
     shape = jnp.broadcast_shapes(*[v.shape for v in vals])
     return [apply(lambda v: jnp.broadcast_to(v, shape), t, op_name="broadcast_tensors")
-            for t in inputs]
+            for t in input]
 
 
 @_export
@@ -318,7 +318,7 @@ def nonzero(x, as_tuple=False):
 
 
 @_export
-def sort(x, axis=-1, descending=False, stable=False):
+def sort(x, axis=-1, descending=False, name=None, stable=False):
     def f(v):
         out = jnp.sort(v, axis=axis, stable=stable)
         return jnp.flip(out, axis=axis) if descending else out
@@ -326,7 +326,7 @@ def sort(x, axis=-1, descending=False, stable=False):
 
 
 @_export
-def argsort(x, axis=-1, descending=False, stable=False):
+def argsort(x, axis=-1, descending=False, name=None, stable=False):
     def f(v):
         idx = jnp.argsort(v, axis=axis, stable=stable)
         return jnp.flip(idx, axis=axis).astype(jnp.int64) if descending else idx.astype(jnp.int64)
@@ -429,7 +429,7 @@ def one_hot(x, num_classes):
 
 
 @_export
-def slice(x, axes, starts, ends):
+def slice(input, axes, starts, ends):
     axes = _static_ints(axes)
     starts = _static_ints(starts)
     ends = _static_ints(ends)
@@ -442,7 +442,7 @@ def slice(x, axes, starts, ends):
             en2 = dim if en >= dim else (en % dim if en < 0 else en)
             out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
         return out
-    return apply(f, x, op_name="slice")
+    return apply(f, input, op_name="slice")
 
 
 @_export
@@ -513,8 +513,8 @@ def bincount(x, weights=None, minlength=0):
 
 
 @_export
-def histogram(x, bins=100, min=0, max=0):
-    v = np.asarray(_u(x))
+def histogram(input, bins=100, min=0, max=0, name=None):
+    v = np.asarray(_u(input))
     rng = None if (min == 0 and max == 0) else (min, max)
     hist, _ = np.histogram(v, bins=bins, range=rng)
     return Tensor(jnp.asarray(hist.astype(np.int64)))
@@ -584,12 +584,12 @@ def masked_scatter(x, mask, value):
 
 
 def _split_equal(name, axis):
-    def fn(x, num_or_indices):
+    def fn(x, num_or_sections, name=None, *, opname=name):
         def f(v):
-            if isinstance(num_or_indices, int):
-                return tuple(jnp.split(v, num_or_indices, axis=axis))
-            return tuple(jnp.split(v, list(num_or_indices), axis=axis))
-        return apply(f, x, op_name=name)
+            if isinstance(num_or_sections, int):
+                return tuple(jnp.split(v, num_or_sections, axis=axis))
+            return tuple(jnp.split(v, list(num_or_sections), axis=axis))
+        return apply(f, x, op_name=opname)
     fn.__name__ = name
     return _export(fn, name)
 
@@ -599,13 +599,13 @@ dsplit = _split_equal("dsplit", 2)
 
 
 @_export
-def hsplit(x, num_or_indices):
+def hsplit(x, num_or_sections):
     """Split on axis 1, or axis 0 for 1-D input (numpy hsplit semantics)."""
     def f(v):
         ax = 0 if v.ndim == 1 else 1
-        if isinstance(num_or_indices, int):
-            return tuple(jnp.split(v, num_or_indices, axis=ax))
-        return tuple(jnp.split(v, list(num_or_indices), axis=ax))
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=ax))
+        return tuple(jnp.split(v, list(num_or_sections), axis=ax))
     return apply(f, x, op_name="hsplit")
 
 

@@ -29,11 +29,11 @@ def _u(x):
 
 # ---------- binary elementwise with paddle-style broadcasting ----------
 
-def _binop(name, jax_fn):
-    def op(x, y, name_=None):
-        return apply(jax_fn, x, y, op_name=name)
-    op.__name__ = name
-    return _export(name, op)
+def _binop(opname, jax_fn):
+    def op(x, y, name=None):
+        return apply(jax_fn, x, y, op_name=opname)
+    op.__name__ = opname
+    return _export(opname, op)
 
 
 add = _binop("add", jnp.add)
@@ -72,11 +72,11 @@ _export("divide_no_nan", divide_no_nan)
 
 # ---------- unary elementwise ----------
 
-def _unop(name, jax_fn):
-    def op(x, name_=None):
-        return apply(jax_fn, x, op_name=name)
-    op.__name__ = name
-    return _export(name, op)
+def _unop(opname, jax_fn):
+    def op(x, name=None):
+        return apply(jax_fn, x, op_name=opname)
+    op.__name__ = opname
+    return _export(opname, op)
 
 
 abs = _unop("abs", jnp.abs)
@@ -108,7 +108,9 @@ erfinv = _unop("erfinv", jax.scipy.special.erfinv)
 floor = _unop("floor", jnp.floor)
 ceil = _unop("ceil", jnp.ceil)
 round = _unop("round", jnp.round)
-trunc = _unop("trunc", jnp.trunc)
+def trunc(input, name=None):
+    return apply(jnp.trunc, input, op_name="trunc")
+_export("trunc", trunc)
 frac = _unop("frac", lambda v: v - jnp.trunc(v))
 sign = _unop("sign", jnp.sign)
 sgn = _export("sgn", sign)
@@ -125,7 +127,13 @@ i1e = _unop("i1e", jax.scipy.special.i1e)
 isnan = _unop("isnan", jnp.isnan)
 isinf = _unop("isinf", jnp.isinf)
 isfinite = _unop("isfinite", jnp.isfinite)
-logit = _unop("logit", jax.scipy.special.logit)
+def logit(x, eps=None, name=None):
+    def f(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jax.scipy.special.logit(v)
+    return apply(f, x, op_name="logit")
+_export("logit", logit)
 deg2rad = _unop("deg2rad", jnp.deg2rad)
 rad2deg = _unop("rad2deg", jnp.rad2deg)
 
@@ -188,8 +196,8 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
 _export("matmul", matmul)
 
 
-def mm(x, y):
-    return matmul(x, y)
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
 _export("mm", mm)
 
 
@@ -214,8 +222,9 @@ def mv(x, vec):
 _export("mv", mv)
 
 
-def t(x):
-    return apply(lambda v: jnp.swapaxes(v, -1, -2) if v.ndim >= 2 else v, x, op_name="t")
+def t(input, name=None):
+    return apply(lambda v: jnp.swapaxes(v, -1, -2) if v.ndim >= 2 else v,
+                 input, op_name="t")
 _export("t", t)
 
 
@@ -248,22 +257,49 @@ def _axis_arg(axis):
     return int(axis)
 
 
-def _reduce(name, jax_fn, default_keepdim=False):
-    def op(x, axis=None, keepdim=default_keepdim, name_=None):
+def _reduce(opname, jax_fn, default_keepdim=False):
+    def op(x, axis=None, keepdim=default_keepdim, name=None):
         ax = _axis_arg(axis)
-        return apply(lambda v: jax_fn(v, axis=ax, keepdims=keepdim), x, op_name=name)
-    op.__name__ = name
-    return _export(name, op)
+        return apply(lambda v: jax_fn(v, axis=ax, keepdims=keepdim), x,
+                     op_name=opname)
+    op.__name__ = opname
+    return _export(opname, op)
 
 
-sum = _reduce("sum", jnp.sum)
 mean = _reduce("mean", jnp.mean)
-prod = _reduce("prod", jnp.prod)
 max = _reduce("max", jnp.max)
 min = _reduce("min", jnp.min)
 amax = _reduce("amax", jnp.max)
 amin = _reduce("amin", jnp.min)
-nansum = _reduce("nansum", jnp.nansum)
+
+
+def _reduce_dtype(opname, jax_fn, dtype_pos_after_keepdim=False):
+    """sum/nansum/prod carry the reference's `dtype` arg (input is cast
+    before reducing); its position differs: sum/nansum (x, axis, dtype,
+    keepdim), prod (x, axis, keepdim, dtype)."""
+    def core(x, axis, dtype, keepdim):
+        ax = _axis_arg(axis)
+        dt = dtypes.convert_dtype(dtype) if dtype is not None else None
+
+        def f(v):
+            if dt is not None:
+                v = v.astype(dt)
+            return jax_fn(v, axis=ax, keepdims=keepdim)
+        return apply(f, x, op_name=opname)
+
+    if dtype_pos_after_keepdim:
+        def op(x, axis=None, keepdim=False, dtype=None, name=None):
+            return core(x, axis, dtype, keepdim)
+    else:
+        def op(x, axis=None, dtype=None, keepdim=False, name=None):
+            return core(x, axis, dtype, keepdim)
+    op.__name__ = opname
+    return _export(opname, op)
+
+
+sum = _reduce_dtype("sum", jnp.sum)
+nansum = _reduce_dtype("nansum", jnp.nansum)
+prod = _reduce_dtype("prod", jnp.prod, dtype_pos_after_keepdim=True)
 nanmean = _reduce("nanmean", jnp.nanmean)
 logsumexp = _reduce("logsumexp", jax.scipy.special.logsumexp)
 all = _reduce("all", jnp.all)
@@ -374,11 +410,11 @@ _export("cummin", cummin)
 
 # ---------- comparison / logic ----------
 
-def _cmp(name, jax_fn):
-    def op(x, y, name_=None):
-        return apply(jax_fn, x, y, op_name=name)
-    op.__name__ = name
-    return _export(name, op)
+def _cmp(opname, jax_fn):
+    def op(x, y, name=None):
+        return apply(jax_fn, x, y, op_name=opname)
+    op.__name__ = opname
+    return _export(opname, op)
 
 
 equal = _cmp("equal", jnp.equal)
@@ -387,14 +423,35 @@ greater_than = _cmp("greater_than", jnp.greater)
 greater_equal = _cmp("greater_equal", jnp.greater_equal)
 less_than = _cmp("less_than", jnp.less)
 less_equal = _cmp("less_equal", jnp.less_equal)
-logical_and = _cmp("logical_and", jnp.logical_and)
-logical_or = _cmp("logical_or", jnp.logical_or)
-logical_xor = _cmp("logical_xor", jnp.logical_xor)
-logical_not = _unop("logical_not", jnp.logical_not)
-bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
-bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
-bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
-bitwise_not = _unop("bitwise_not", jnp.bitwise_not)
+def _logicop(opname, jax_fn, unary=False):
+    """logical_*/bitwise_* carry the reference's optional `out` tensor
+    between the operands and `name` (python/paddle/tensor/logic.py)."""
+    if unary:
+        def op(x, out=None, name=None):
+            res = apply(jax_fn, x, op_name=opname)
+            if out is not None:
+                out._set_value(res._value)
+                return out
+            return res
+    else:
+        def op(x, y, out=None, name=None):
+            res = apply(jax_fn, x, y, op_name=opname)
+            if out is not None:
+                out._set_value(res._value)
+                return out
+            return res
+    op.__name__ = opname
+    return _export(opname, op)
+
+
+logical_and = _logicop("logical_and", jnp.logical_and)
+logical_or = _logicop("logical_or", jnp.logical_or)
+logical_xor = _logicop("logical_xor", jnp.logical_xor)
+logical_not = _logicop("logical_not", jnp.logical_not, unary=True)
+bitwise_and = _logicop("bitwise_and", jnp.bitwise_and)
+bitwise_or = _logicop("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _logicop("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = _logicop("bitwise_not", jnp.bitwise_not, unary=True)
 left_shift = _cmp("left_shift", jnp.left_shift)
 right_shift = _cmp("right_shift", jnp.right_shift)
 
@@ -549,13 +606,13 @@ def add_n(inputs):
 _export("add_n", add_n)
 
 
-def rank(x):
-    return apply(lambda v: jnp.asarray(v.ndim, jnp.int32), x, op_name="rank")
+def rank(input):
+    return apply(lambda v: jnp.asarray(v.ndim, jnp.int32), input, op_name="rank")
 _export("rank", rank)
 
 
-def shape(x):
-    return apply(lambda v: jnp.asarray(v.shape, jnp.int32), x, op_name="shape")
+def shape(input):
+    return apply(lambda v: jnp.asarray(v.shape, jnp.int32), input, op_name="shape")
 _export("shape", shape)
 
 

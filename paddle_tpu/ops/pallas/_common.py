@@ -1,0 +1,22 @@
+"""Shared helpers for the Pallas TPU kernels in this package."""
+from __future__ import annotations
+
+import contextlib
+
+_NEG_INF = -1e30
+
+
+def _x32():
+    """Trace kernels in x32 mode: the package enables jax_enable_x64 globally
+    (reference float64 parity), but x64 constants break Mosaic lowering."""
+    try:
+        from jax._src.config import enable_x64
+        return enable_x64(False)
+    except Exception:  # noqa: BLE001 — jax private API moved: no-op fallback
+        return contextlib.nullcontext()
+
+
+def _interpret() -> bool:
+    """Pallas interpret mode off-TPU, so the same kernels unit-test on CPU."""
+    from ...core.device import is_tpu_backend
+    return not is_tpu_backend()

@@ -20,28 +20,14 @@ from __future__ import annotations
 
 import functools
 
-import contextlib
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-
-def _x32():
-    """Trace kernels in x32 mode: the package enables jax_enable_x64 globally
-    (reference float64 parity), but x64 constants break Mosaic lowering."""
-    try:
-        from jax._src.config import enable_x64
-        return enable_x64(False)
-    except Exception:
-        return contextlib.nullcontext()
-
-_NEG_INF = -1e30
+from ._common import _NEG_INF, _interpret, _x32
 
 
-def _interpret() -> bool:
-    from ...core.device import is_tpu_backend
-    return not is_tpu_backend()
 
 
 def _pad_axis(x, axis, multiple):

@@ -29,7 +29,6 @@ any differentiable loss composition.
 """
 from __future__ import annotations
 
-import contextlib
 import functools
 
 import jax
@@ -37,21 +36,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-
-def _x32():
-    try:
-        from jax._src.config import enable_x64
-        return enable_x64(False)
-    except Exception:  # noqa: BLE001
-        return contextlib.nullcontext()
+from ._common import _NEG_INF, _interpret, _x32
 
 
-def _interpret() -> bool:
-    from ...core.device import is_tpu_backend
-    return not is_tpu_backend()
-
-
-_NEG_INF = -1e30
 
 # Row/vocab tile sizes. BR*H + H*BV (+ accumulators) must fit VMEM; at
 # H=4096 fp32 the defaults use ~10 MB.

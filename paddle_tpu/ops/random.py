@@ -34,12 +34,12 @@ def _dt(dtype):
     return dtypes.convert_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
 
 
-def rand(shape, dtype=None, key=None):
+def rand(shape, dtype=None, name=None, key=None):
     key = key if key is not None else gen.next_key()
     return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype)))
 
 
-def randn(shape, dtype=None, key=None):
+def randn(shape, dtype=None, name=None, key=None):
     key = key if key is not None else gen.next_key()
     return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
 
@@ -47,7 +47,8 @@ def randn(shape, dtype=None, key=None):
 standard_normal = randn
 
 
-def randint(low=0, high=None, shape=(1,), dtype="int64", key=None):
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None,
+            key=None):
     if high is None:
         low, high = 0, low
     key = key if key is not None else gen.next_key()
@@ -60,13 +61,14 @@ def randint_like(x, low=0, high=None, dtype=None):
     return randint(low, high, x.shape, dtype)
 
 
-def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, key=None):
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None,
+            key=None):
     key = key if key is not None else gen.next_key()
     return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
                                      minval=float(min), maxval=float(max)))
 
 
-def normal(mean=0.0, std=1.0, shape=None, key=None):
+def normal(mean=0.0, std=1.0, shape=None, name=None, key=None):
     if isinstance(mean, Tensor) or isinstance(std, Tensor):
         m = mean._value if isinstance(mean, Tensor) else mean
         s = std._value if isinstance(std, Tensor) else std
@@ -79,19 +81,20 @@ def normal(mean=0.0, std=1.0, shape=None, key=None):
     return Tensor(mean + std * eps)
 
 
-def poisson(x, key=None):
+def poisson(x, name=None, key=None):
     key = key if key is not None else gen.next_key()
     lam = x._value if isinstance(x, Tensor) else jnp.asarray(x)
     return Tensor(jax.random.poisson(key, lam, dtype=jnp.int64).astype(lam.dtype))
 
 
-def bernoulli(x, key=None):
+def bernoulli(x, name=None, key=None):
     key = key if key is not None else gen.next_key()
     p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
     return Tensor(jax.random.bernoulli(key, p).astype(p.dtype))
 
 
-def multinomial(x, num_samples=1, replacement=False, key=None):
+def multinomial(x, num_samples=1, replacement=False, name=None,
+                key=None):
     key = key if key is not None else gen.next_key()
     p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
     logits = jnp.log(jnp.maximum(p, 1e-30))
@@ -105,7 +108,7 @@ def multinomial(x, num_samples=1, replacement=False, key=None):
     return Tensor(out.astype(jnp.int64))
 
 
-def randperm(n, dtype="int64", key=None):
+def randperm(n, dtype="int64", name=None, key=None):
     key = key if key is not None else gen.next_key()
     return Tensor(jax.random.permutation(key, int(n)).astype(dtypes.convert_dtype(dtype)))
 
@@ -120,7 +123,7 @@ def normal_(x, mean=0.0, std=1.0):
     return x
 
 
-def exponential_(x, lam=1.0, key=None):
+def exponential_(x, lam=1.0, name=None, key=None):
     key = key if key is not None else gen.next_key()
     e = jax.random.exponential(key, tuple(x.shape), x._value.dtype) / lam
     x._set_value(e)

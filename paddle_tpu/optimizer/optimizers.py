@@ -9,8 +9,9 @@ from .optimizer import Optimizer
 
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
-                 grad_clip=None, name=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+                 grad_clip=None, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
 
     def _update_rule(self, val, grad, state, lr, wd):
         if wd:
@@ -20,8 +21,11 @@ class SGD(Optimizer):
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
-                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0,
+                 use_multi_tensor=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
         self._momentum = momentum
         self._nesterov = use_nesterov
 
@@ -41,9 +45,10 @@ class Momentum(Optimizer):
 
 
 class Adam(Optimizer):
-    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
-                 parameters=None, weight_decay=None, grad_clip=None, name=None,
-                 lazy_mode=False, multi_precision=False, **kw):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
                          multi_precision)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
@@ -72,9 +77,10 @@ class Adam(Optimizer):
 
 
 class AdamW(Adam):
-    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
-                 parameters=None, weight_decay=0.01, grad_clip=None, name=None,
-                 lr_ratio=None, apply_decay_param_fun=None, multi_precision=False, **kw):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, **kw):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, name, multi_precision=multi_precision)
         self._apply_decay_param_fun = apply_decay_param_fun
@@ -106,8 +112,8 @@ class Adamax(Optimizer):
 
 class Adagrad(Optimizer):
     def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
-                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
-                 name=None, **kw):
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._eps = epsilon
         self._init_acc = initial_accumulator_value
@@ -179,7 +185,8 @@ class RMSProp(Optimizer):
 class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
-                 exclude_from_weight_decay_fn=None, name=None, **kw):
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 always_adapt=False, name=None, **kw):
         super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._exclude_fn = exclude_from_weight_decay_fn

@@ -113,6 +113,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "pt_store_add": (c.c_longlong, [c.c_void_p, c.c_char_p, c.c_longlong]),
         "pt_store_wait": (c.c_int, [c.c_void_p, c.c_char_p]),
         "pt_store_delete": (c.c_int, [c.c_void_p, c.c_char_p]),
+        "pt_store_lease": (c.c_int, [c.c_void_p, c.c_char_p, c.c_longlong]),
+        "pt_store_lease_check": (c.c_int, [c.c_void_p, c.c_char_p]),
         "pt_store_client_free": (None, [c.c_void_p]),
         "pt_trace_enable": (None, [c.c_int]),
         "pt_trace_is_enabled": (c.c_int, []),

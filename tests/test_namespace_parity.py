@@ -150,7 +150,7 @@ def test_distributed_alltoall_single_and_split():
     mesh_mod.init_mesh({"dp": 8})
     g = dist.new_group(axis="dp")
     out = P.zeros([16])
-    dist.alltoall_single(out, P.to_tensor(np.arange(16, dtype="f")), group=g)
+    dist.alltoall_single(P.to_tensor(np.arange(16, dtype="f")), out, group=g)
     assert out.shape == [16]
 
 

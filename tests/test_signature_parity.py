@@ -1,0 +1,191 @@
+"""Signature-deep API parity (VERDICT r3 item 8; reference analog:
+tools/check_api_compatible.py — the CI gate that diffs arg-lists of public
+APIs between PR and develop).
+
+test_namespace_parity.py proves the NAMES exist; this file proves the
+callables take the same POSITIONAL ARGUMENTS, by AST-extracting every
+`def`/class-`__init__` signature from the reference's source for the top
+namespaces (tensor ops, nn.functional, nn layers, optimizer, distributed)
+and diffing positional-arg name sequences against `inspect.signature` of
+our objects.  Deliberate divergences are RECORDED in EXEMPTIONS (with the
+why); anything else is a failure.
+"""
+from __future__ import annotations
+
+import ast
+import glob
+import inspect
+
+import pytest
+
+REF = "/root/reference/python/paddle/"
+
+# (reference source globs, our object roots, public-name __init__ files —
+# extraction is restricted to names the reference actually EXPORTS, so
+# un-underscored internal helpers don't count)
+GROUPS = {
+    "tensor": ([REF + "tensor/*.py"], ["paddle_tpu"],
+               [REF + "__init__.py", REF + "tensor/__init__.py"]),
+    "nn_functional": ([REF + "nn/functional/*.py"],
+                      ["paddle_tpu.nn.functional"],
+                      [REF + "nn/functional/__init__.py"]),
+    "nn_layers": ([REF + "nn/layer/*.py"], ["paddle_tpu.nn"],
+                  [REF + "nn/__init__.py"]),
+    "optimizer": ([REF + "optimizer/*.py"], ["paddle_tpu.optimizer"],
+                  [REF + "optimizer/__init__.py"]),
+    "distributed": ([REF + "distributed/communication/*.py",
+                     REF + "distributed/parallel.py"],
+                    ["paddle_tpu.distributed"],
+                    [REF + "distributed/__init__.py"]),
+}
+
+# name -> reason. Deliberate divergences only; keep this SHORT (<20).
+EXEMPTIONS = {
+    "BatchNorm": "legacy fluid-era signature (num_channels, act, is_test, "
+                 "...); ours follows the modern BatchNorm1D/2D/3D family, "
+                 "which all match positionally — migrating callers use "
+                 "keyword args per the reference's own deprecation docs",
+}
+
+_SKIP_FIRST = {"self", "cls"}
+
+
+def _public_names(init_paths):
+    names = set()
+    for path in init_paths:
+        try:
+            tree = ast.parse(open(path).read())
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for tg in node.targets:
+                    if getattr(tg, "id", "") == "__all__":
+                        names.update(
+                            e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant))
+            # tensor methods are exported via the tensor_method_func list
+            if isinstance(node, ast.Assign) and any(
+                    getattr(tg, "id", "") == "tensor_method_func"
+                    for tg in node.targets):
+                for e in ast.walk(node.value):
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        names.add(e.value)
+    return names
+
+
+def _ref_signatures(globs):
+    """{public name: [positional arg names]} from reference source.
+    Functions use their def args; classes use __init__ (minus self)."""
+    sigs = {}
+    for pattern in globs:
+        for path in sorted(glob.glob(pattern)):
+            try:
+                tree = ast.parse(open(path).read())
+            except (SyntaxError, UnicodeDecodeError):
+                continue
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if node.name.startswith("_"):
+                        continue
+                    sigs.setdefault(node.name, _args_of(node))
+                elif isinstance(node, ast.ClassDef):
+                    if node.name.startswith("_"):
+                        continue
+                    for sub in node.body:
+                        if isinstance(sub, ast.FunctionDef) \
+                                and sub.name == "__init__":
+                            sigs.setdefault(node.name, _args_of(sub))
+    return sigs
+
+
+def _args_of(fn_node):
+    names = [a.arg for a in fn_node.args.args]
+    if names and names[0] in _SKIP_FIRST:
+        names = names[1:]
+    return names
+
+
+def _our_args(obj):
+    target = obj.__init__ if inspect.isclass(obj) else obj
+    try:
+        sig = inspect.signature(target)
+    except (ValueError, TypeError):
+        return None
+    names = []
+    for p in sig.parameters.values():
+        if p.name in _SKIP_FIRST:
+            continue
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            names.append(p.name)
+        elif p.kind == p.VAR_POSITIONAL:
+            names.append("*")
+            break
+        else:
+            break  # keyword-only/ **kw: positional surface ends here
+    return names
+
+
+def _resolve(roots, name):
+    import importlib
+    for root in roots:
+        mod = importlib.import_module(root)
+        obj = getattr(mod, name, None)
+        if obj is not None:
+            return obj
+    return None
+
+
+def _compare(ref_args, our_args):
+    """Positional compatibility: our positional arg names must match the
+    reference's, position by position, up to the shorter list; trailing
+    reference args beyond ours must be accepted somewhere (we only flag
+    NAME mismatches in shared positions and missing leading args)."""
+    if our_args is None:
+        return None  # uninspectable (builtin) — not comparable
+    n = min(len(ref_args), len(our_args))
+    for i in range(n):
+        if "*" in (ref_args[i], our_args[i]):
+            return None
+        if ref_args[i] != our_args[i]:
+            return (f"pos {i}: reference {ref_args[i]!r} vs "
+                    f"ours {our_args[i]!r} (ref {ref_args}, ours {our_args})")
+    return None
+
+
+@pytest.mark.parametrize("group", sorted(GROUPS))
+def test_positional_signature_parity(group):
+    globs, roots, inits = GROUPS[group]
+    ref_sigs = _ref_signatures(globs)
+    public = _public_names(inits)
+    assert ref_sigs and public, f"no reference signatures found for {group}"
+    mismatches = {}
+    compared = 0
+    for name, ref_args in sorted(ref_sigs.items()):
+        if name not in public:
+            continue  # un-exported internal helper
+        obj = _resolve(roots, name)
+        if obj is None or not ref_args:
+            continue  # presence is test_namespace_parity's job
+        if name.endswith("_") and _our_args(obj) is not None \
+                and _our_args(obj)[-1:] == ["*"]:
+            # generated inplace wrappers forward *args positionally — the
+            # positional call surface matches by construction
+            compared += 1
+            continue
+        msg = _compare(ref_args, _our_args(obj))
+        compared += 1
+        if msg is None or name in EXEMPTIONS:
+            continue
+        mismatches[name] = msg
+    assert not mismatches, (
+        f"{group}: {len(mismatches)} positional-signature divergences "
+        f"(fix or record in EXEMPTIONS):\n" + "\n".join(
+            f"  {k}: {v}" for k, v in sorted(mismatches.items())))
+    # optimizer's flat namespace is ~a dozen classes (schedulers live under
+    # optimizer.lr and are covered by their own behavioral tests)
+    assert compared >= 10, f"{group}: only {compared} comparable signatures"
+
+
+def test_exemption_budget():
+    assert len(EXEMPTIONS) < 20, "exemption list must stay curated"

@@ -114,9 +114,16 @@ def _pallas_vs_jnp_cases():
 
     def wrapjit(fn, *args):
         """jit with the arrays as real ARGUMENTS (closure capture would bake
-        them into the HLO as constants)."""
+        them into the HLO as constants).  Blocks on EVERY output leaf before
+        handing one to the timer — wrapping only the first leaf would let
+        the last iteration's remaining outputs (e.g. dW) run past the
+        timer stop under async dispatch."""
         compiled = jax.jit(fn)
-        return lambda: Tensor(jax.tree_util.tree_leaves(compiled(*args))[0])
+
+        def run():
+            out = jax.block_until_ready(compiled(*args))
+            return Tensor(jax.tree_util.tree_leaves(out)[0])
+        return run
 
     return [
         ("fused_linear_ce_fwd_4kx32k",
