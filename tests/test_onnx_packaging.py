@@ -202,3 +202,50 @@ def test_wheel_builds():
     names = zipfile.ZipFile(os.path.join("/tmp/ptpu_dist", wheels[0])).namelist()
     assert any(n.endswith("libpaddle_tpu_rt.so") for n in names)
     assert any(n.endswith("paddle_tpu/__init__.py") for n in names)
+
+
+def test_export_tp_model_single_device_retrace(tmp_path):
+    """A model built UNDER a tensor-parallel mesh (TP layers annotate
+    shardings) exports via the automatic single-device re-trace: the mesh is
+    cleared for the trace, so no sharding primitives reach the converter,
+    and the graph reproduces the eager output (VERDICT r3 weak #8)."""
+    import numpy as np
+
+    import paddle_tpu as P
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+    from paddle_tpu.nn.layer.layers import Layer
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    dist.init_parallel_env({"mp": 2})
+    try:
+        P.seed(0)
+
+        class TPBlock(Layer):
+            def __init__(self):
+                super().__init__()
+                self.up = ColumnParallelLinear(8, 16, has_bias=False,
+                                               gather_output=False)
+                self.down = RowParallelLinear(16, 8, has_bias=False,
+                                              input_is_parallel=True)
+
+            def forward(self, x):
+                return self.down(P.nn.functional.relu(self.up(x)))
+
+        model = TPBlock()
+        x = P.to_tensor(np.random.RandomState(0).randn(2, 8)
+                        .astype(np.float32))
+        eager = model(x).numpy()
+
+        from paddle_tpu.static import InputSpec
+        path = P.onnx.export(
+            model, str(tmp_path / "tp_model"),
+            input_spec=[InputSpec([2, 8], "float32", name="x")])
+        # the ambient mesh must survive the export untouched
+        assert mesh_mod.get_mesh() is not None
+        out = P.onnx.run_model(path, {"x": np.asarray(x.numpy())})[0]
+        np.testing.assert_allclose(out, np.asarray(eager), rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        mesh_mod.set_mesh(None)

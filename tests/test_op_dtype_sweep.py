@@ -363,6 +363,16 @@ CASES = {
         lambda x, t: F.kl_div(x, t, reduction="mean"),
         lambda: [np.log(_r(173).rand(4, 5) + 0.1),
                  _r(174).rand(4, 5) + 0.1], wrt=(0,)),
+    # cast to the WIDEST float: casting down to fp32 would make the fp64
+    # finite-difference leg measure fp32 rounding, not the gradient
+    "cast": Case(lambda x: P.cast(x, "float64"),
+                 lambda: [_r(175).randn(3, 4)]),
+    "ctc_loss": Case(
+        lambda lg, lab, ilen, llen: F.ctc_loss(lg, lab, ilen, llen),
+        lambda: [_r(176).randn(1, 6, 5),
+                 np.asarray([[1, 2, 3]], np.int64),
+                 np.asarray([6], np.int64),
+                 np.asarray([3], np.int64)], wrt=(0,)),
 }
 
 # Enumerated-but-not-swept ops: every entry must say where the op IS tested.
@@ -375,6 +385,17 @@ NOT_SWEPT = {
             "tests/test_incubate_fused.py",
     "lstm": "composite recurrent layer; parity in tests/test_nn.py",
     "clone": "identity copy; covered by tensor-op suite",
+    "getitem": "indexing dispatch; semantics covered by the tensor-op and "
+               "manip suites (tests/test_tensor_ops.py)",
+    "gru": "composite recurrent layer; parity in tests/test_nn.py",
+    "kv_cache_upd": "dynamic_update_slice cache write; decode-vs-oracle "
+                    "parity in tests/test_pallas_fused_kernels.py",
+    "decode_mask": "constant mask construction for the prefill path; "
+                   "decode parity tests cover it",
+    "ragged_decode_attention": "Pallas decode kernel; reference parity in "
+                               "tests/test_pallas_fused_kernels.py",
+    "bert_pad_mask": "constant attention-mask construction; BERT forward "
+                     "covered in tests/test_model_zoo.py",
 }
 
 

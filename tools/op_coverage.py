@@ -112,6 +112,41 @@ def drive():
         F.binary_cross_entropy_with_logits(
             logit, P.to_tensor((rng.rand(4) > 0.5).astype(np.float32))
         ).backward()
+
+        # --- BERT-style masked LM head + GPT decode (generate path) ---
+        from paddle_tpu.models import BertConfig, BertForSequenceClassification
+        bcfg = BertConfig(vocab_size=64, hidden_size=32,
+                          num_hidden_layers=1, num_attention_heads=4,
+                          intermediate_size=64, max_position_embeddings=32)
+        bert = BertForSequenceClassification(bcfg, num_classes=3)
+        bids = P.to_tensor(rng.randint(0, 64, (2, 8)))
+        bl = F.cross_entropy(bert(bids), P.to_tensor(rng.randint(0, 3, (2,))))
+        bl.backward()
+
+        # --- OCR recognition head (CRNN + CTC) ---
+        from paddle_tpu.models import CRNN
+        crnn = CRNN(num_classes=11, in_channels=1)
+        img2 = P.to_tensor(rng.randn(1, 1, 32, 64).astype(np.float32))
+        logits2 = crnn(img2)
+        lab = P.to_tensor(rng.randint(1, 11, (1, 4)))
+        ll = F.ctc_loss(logits2,
+                        lab,
+                        P.to_tensor(np.asarray([logits2.shape[1]], np.int64)),
+                        P.to_tensor(np.asarray([4], np.int64)))
+        ll.backward()
+
+        # --- GRU + bidirectional path ---
+        gru = nn.GRU(12, 16, direction="bidirect")
+        sg = P.to_tensor(rng.randn(2, 5, 12).astype(np.float32))
+        og, _ = gru(sg)
+        og.mean().backward()
+
+        # --- KV-cache greedy decode (ragged decode path) ---
+        from paddle_tpu.models import LlamaConfig as _LC, LlamaForCausalLM as _LM
+        dm = _LM(_LC.tiny(vocab=32, hidden=16, layers=1, heads=2, inter=32))
+        dm.eval()
+        dm.generate(P.to_tensor(rng.randint(0, 32, (1, 3))),
+                    max_new_tokens=2, use_cache=True)
     finally:
         dispatch.set_coverage_recorder(None)
     return counts
