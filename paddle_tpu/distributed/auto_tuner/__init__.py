@@ -1,10 +1,14 @@
 """paddle_tpu.distributed.auto_tuner — parallel-config search
 (reference: python/paddle/distributed/auto_tuner/)."""
+from .cost_model import (CLUSTERS, ClusterSpec, CostEstimate,  # noqa: F401
+                         estimate, rank_configs)
 from .prune import prune, register_prune, same_cfgs_beside  # noqa: F401
 from .recorder import HistoryRecorder  # noqa: F401
-from .search import GridSearch, SearchAlgo, candidate_space  # noqa: F401
+from .search import (CostRankedSearch, GridSearch, SearchAlgo,  # noqa: F401
+                     candidate_space)
 from .tuner import AutoTuner, measure_llama_step  # noqa: F401
 
-__all__ = ["AutoTuner", "GridSearch", "HistoryRecorder", "SearchAlgo",
-           "candidate_space", "measure_llama_step", "prune", "register_prune",
-           "same_cfgs_beside"]
+__all__ = ["AutoTuner", "CLUSTERS", "ClusterSpec", "CostEstimate",
+           "CostRankedSearch", "GridSearch", "HistoryRecorder", "SearchAlgo",
+           "candidate_space", "estimate", "measure_llama_step", "prune",
+           "rank_configs", "register_prune", "same_cfgs_beside"]

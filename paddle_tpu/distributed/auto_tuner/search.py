@@ -60,3 +60,54 @@ class GridSearch(SearchAlgo):
                 continue
             return cand
         return None
+
+
+class CostRankedSearch(SearchAlgo):
+    """Grid search ordered by the analytic cost model (cost_model.py) —
+    best-predicted-first — with measured-domination pruning: once a config
+    has been MEASURED, any remaining candidate whose predicted throughput
+    falls below `cost_prune_ratio` x the best measured config's prediction
+    is skipped (reference planner_v2.py ranks plans with its cost model the
+    same way before launching them)."""
+
+    def __init__(self, tuner_cfg, model_desc, global_batch_size, seq_len,
+                 cluster="tpu_v4"):
+        super().__init__(tuner_cfg)
+        from .cost_model import rank_configs
+
+        cands = [c for c in candidate_space(tuner_cfg)
+                 if not prune(tuner_cfg, c, [])]
+        self._ranked = rank_configs(model_desc, cands, global_batch_size,
+                                    seq_len, cluster)
+        self._queue = list(self._ranked)
+        self._pred = {self._key(e.cfg): e.tokens_per_sec
+                      for e in self._ranked}
+        self.ratio = float(tuner_cfg.get("cost_prune_ratio", 0.5))
+        self.pruned_by_cost = []
+
+    @staticmethod
+    def _key(cfg):
+        return tuple(sorted(cfg.items()))
+
+    def predicted(self, cfg):
+        return self._pred.get(self._key(cfg))
+
+    def search_once(self, history):
+        tried = {self._key(h["cfg"]) for h in history}
+        measured = [self._pred.get(self._key(h["cfg"]))
+                    for h in history if h.get("metric") is not None]
+        best_measured_pred = max([p for p in measured if p is not None],
+                                 default=None)
+        while self._queue:
+            est = self._queue.pop(0)
+            k = self._key(est.cfg)
+            if k in tried:
+                continue
+            if prune(self.tuner_cfg, est.cfg, history):
+                continue
+            if best_measured_pred is not None and \
+                    est.tokens_per_sec < self.ratio * best_measured_pred:
+                self.pruned_by_cost.append(est.cfg)
+                continue
+            return est.cfg
+        return None

@@ -16,9 +16,20 @@ from .search import GridSearch
 
 
 class AutoTuner:
-    def __init__(self, tuner_cfg: dict):
+    def __init__(self, tuner_cfg: dict, model_desc=None,
+                 global_batch_size=None, seq_len=None, cluster="tpu_v4"):
+        """With `model_desc` (LlamaConfig-like or dict) + batch/seq, the
+        tuner ranks candidates with the analytic cost model and measures
+        best-predicted-first, pruning measured-dominated configs
+        (cost_model.py; reference planner_v2.py).  Without it, plain pruned
+        grid order."""
         self.tuner_cfg = dict(tuner_cfg)
-        self.algo = GridSearch(self.tuner_cfg)
+        if model_desc is not None:
+            from .search import CostRankedSearch
+            self.algo = CostRankedSearch(self.tuner_cfg, model_desc,
+                                         global_batch_size, seq_len, cluster)
+        else:
+            self.algo = GridSearch(self.tuner_cfg)
         self.recorder = HistoryRecorder(
             metric_name=tuner_cfg.get("metric", "ips"),
             direction=tuner_cfg.get("direction", "max"))

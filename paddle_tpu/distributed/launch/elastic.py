@@ -80,6 +80,10 @@ class ElasticManager:
     def leave(self):
         """Graceful scale-down: stop heartbeating and revoke the lease."""
         self._stop.set()
+        # the heartbeat thread may be past its _stop check and about to
+        # re-grant the lease; join it BEFORE revoking so the ttl=0 below is
+        # the last word (ADVICE r4 #2)
+        self._hb_thread.join(timeout=2 * self.interval + 5)
         try:
             if self._use_lease:
                 self.store.lease(f"elastic/lease/{self.node_id}", 0)

@@ -1,6 +1,7 @@
 """Norm layers (analog of python/paddle/nn/layer/norm.py)."""
 from __future__ import annotations
 
+import jax.lax as _jlax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
@@ -210,6 +211,11 @@ class SpectralNorm(Layer):
             for _ in range(iters):
                 v = norm(mat.T @ u)
                 u = norm(mat @ v)
+            # reference spectral_norm_op treats the iterated u/v as
+            # CONSTANTS in the gradient: d(sigma)/d(w) = u v^T only, even
+            # when power_iters has not converged (ADVICE r4 #3)
+            u = _jlax.stop_gradient(u)
+            v = _jlax.stop_gradient(v)
             sigma = u @ mat @ v
             return wv / sigma, u, v
 

@@ -52,6 +52,8 @@ def _attrs(node):
             out[a.name] = list(a.ints)
         elif a.type == pb.AttributeProto.FLOATS:
             out[a.name] = list(a.floats)
+        elif a.type == pb.AttributeProto.GRAPH:
+            out[a.name] = a.g
     return out
 
 
@@ -115,7 +117,20 @@ def run_model(model_bytes_or_path, inputs: dict):
         if vi.name not in inputs:
             raise ValueError(f"missing input {vi.name!r}")
         env[vi.name] = np.asarray(inputs[vi.name])
+    return _run_graph(g, env)
 
+
+def _run_subgraph(sub, outer_env, bound_inputs):
+    """Execute a control-flow body graph.  ONNX subgraphs capture the outer
+    scope by name; explicit body inputs are bound positionally."""
+    env = dict(outer_env)
+    env.update({t.name: _tensor_to_np(t) for t in sub.initializer})
+    for vi, val in zip(sub.input, bound_inputs):
+        env[vi.name] = np.asarray(val)
+    return _run_graph(sub, env)
+
+
+def _run_graph(g, env):
     for node in g.node:
         a = _attrs(node)
         x = [env[i] for i in node.input if i]
@@ -243,7 +258,9 @@ def run_model(model_bytes_or_path, inputs: dict):
         elif op == "Concat":
             r = np.concatenate(x, axis=a["axis"])
         elif op == "Slice":
-            starts, ends, axes, steps = (x[1], x[2], x[3], x[4])
+            starts, ends = x[1], x[2]
+            axes = x[3] if len(x) > 3 else np.arange(len(starts))
+            steps = x[4] if len(x) > 4 else np.ones(len(starts), np.int64)
             idx = [slice(None)] * x[0].ndim
             big = np.iinfo(np.int64).max
             for s, e, ax, st in zip(starts, ends, axes, steps):
@@ -283,8 +300,78 @@ def run_model(model_bytes_or_path, inputs: dict):
             r = np.argmin(x[0], axis=a.get("axis", 0))
             if a.get("keepdims", 1):
                 r = np.expand_dims(r, a.get("axis", 0))
+        elif op == "Split":
+            axis = a.get("axis", 0)
+            sizes = [int(s) for s in x[1]] if len(x) > 1 else None
+            if sizes is None:
+                n = len(node.output)
+                sizes = [x[0].shape[axis] // n] * n
+            r = tuple(np.split(x[0], np.cumsum(sizes)[:-1], axis=axis))
+        elif op == "CumSum":
+            axis = int(x[1])
+            v = np.flip(x[0], axis) if a.get("reverse", 0) else x[0]
+            v = np.cumsum(v, axis=axis, dtype=v.dtype)
+            r = np.flip(v, axis) if a.get("reverse", 0) else v
+        elif op == "TopK":
+            k = int(np.asarray(x[1]).reshape(-1)[0])
+            axis = a.get("axis", -1)
+            order = np.argsort(-x[0] if a.get("largest", 1) else x[0],
+                               axis=axis, kind="stable")
+            idx = np.take(order, np.arange(k), axis=axis)
+            r = (np.take_along_axis(x[0], idx, axis=axis),
+                 idx.astype(np.int64))
+        elif op == "Scan":
+            body = a["body"]
+            n_scan = a["num_scan_inputs"]
+            n_states = len(node.input) - n_scan
+            states, xs = list(x[:n_states]), x[n_states:]
+            n_ys = len(node.output) - n_states
+            in_dirs = a.get("scan_input_directions") or [0] * n_scan
+            out_dirs = a.get("scan_output_directions") or [0] * n_ys
+            T = xs[0].shape[0]
+            ys = [[] for _ in range(n_ys)]
+            for t in range(T):
+                elems = [xi[T - 1 - t] if d else xi[t]
+                         for xi, d in zip(xs, in_dirs)]
+                outs = _run_subgraph(body, env, states + elems)
+                states = list(outs[:n_states])
+                for acc, y in zip(ys, outs[n_states:]):
+                    acc.append(y)
+            stacked = [np.stack(acc[::-1] if d else acc)
+                       for acc, d in zip(ys, out_dirs)]
+            r = tuple(states) + tuple(stacked)
+        elif op == "Loop":
+            body = a["body"]
+            M = None if not node.input[0] else \
+                int(np.asarray(env[node.input[0]]).reshape(-1)[0])
+            cond = True if not node.input[1] else \
+                bool(np.asarray(env[node.input[1]]).reshape(-1)[0])
+            states = [np.asarray(env[i]) for i in node.input[2:]]
+            n_states = len(states)
+            n_scan = len(body.output) - 1 - n_states
+            accs = [[] for _ in range(n_scan)]
+            it = 0
+            while cond and (M is None or it < M):
+                outs = _run_subgraph(
+                    body, env,
+                    [np.asarray(it, np.int64), np.asarray(cond)] + states)
+                cond = bool(np.asarray(outs[0]).reshape(-1)[0])
+                states = list(outs[1:1 + n_states])
+                for acc, y in zip(accs, outs[1 + n_states:]):
+                    acc.append(y)
+                it += 1
+            r = tuple(states) + tuple(np.stack(acc) for acc in accs)
+        elif op == "If":
+            branch = a["then_branch"] if bool(np.asarray(x[0]).reshape(-1)[0]) \
+                else a["else_branch"]
+            r = tuple(_run_subgraph(branch, env, []))
         else:
             raise NotImplementedError(f"interp: op {op}")
-        env[node.output[0]] = np.asarray(r)
+        if len(node.output) > 1:
+            for o, v in zip(node.output, r):
+                env[o] = np.asarray(v)
+        else:
+            env[node.output[0]] = np.asarray(
+                r[0] if isinstance(r, tuple) else r)
 
     return [env[o.name] for o in g.output]

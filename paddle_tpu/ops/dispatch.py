@@ -206,11 +206,20 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
 
     diff_vals = [vals[i] for i in diff_idx]
 
+    def norm_fn(*a, **k):
+        """jax_fn with NamedTuple outputs (EighResult, SVDResult, ...)
+        flattened to plain tuples: the backward pass builds cotangents as
+        tuples, and jax.vjp requires the EXACT output pytree type."""
+        out = jax_fn(*a, **k)
+        if isinstance(out, tuple) and type(out) is not tuple:
+            return tuple(out)
+        return out
+
     def f(*dv):
         vv = list(vals)
         for k, i in enumerate(diff_idx):
             vv[i] = dv[k]
-        return jax_fn(*vv, **static_kwargs)
+        return norm_fn(*vv, **static_kwargs)
 
     try:
         raw, vjp_fn = jax.vjp(f, *diff_vals)
@@ -224,7 +233,7 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
         for o in outs_list
     ]
     node = GradNode(vjp_fn, [args[i] for i in diff_idx], out_avals, multi, name,
-                    recompute=(jax_fn, vals, diff_idx, static_kwargs))
+                    recompute=(norm_fn, vals, diff_idx, static_kwargs))
     # consumer registry: lets Tensor._inplace_assign rewire EVERY node that
     # consumed the pre-op tensor, not just this one (weakrefs — the tape's
     # strong refs run node->tensor, never tensor->node)

@@ -287,10 +287,14 @@ def scatter_nd(index, updates, shape):
 
 @_export
 def masked_select(x, mask):
-    # dynamic output shape: eager-only op (not jittable), like reference semantics
-    v, m = _u(x), _u(mask)
-    out = np.asarray(v)[np.asarray(m).astype(bool)]
-    return Tensor(jnp.asarray(out))
+    # dynamic output shape: eager-only op (not jittable), like reference
+    # semantics.  The mask is concretized to indices eagerly; the gather
+    # itself runs through apply() so the op is DIFFERENTIABLE (reference
+    # masked_select_grad scatters the cotangent back into the mask
+    # positions — a gather's vjp does exactly that).
+    m = np.asarray(_u(mask)).astype(bool).reshape(-1)
+    idx = jnp.asarray(np.nonzero(m)[0])
+    return apply(lambda v: v.reshape(-1)[idx], x, op_name="masked_select")
 
 
 @_export

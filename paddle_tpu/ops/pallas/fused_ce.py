@@ -229,7 +229,10 @@ def _bwd_impl(h, w, labels_local, lse, g, v_real, br, bv):
 def _prep(h, w, labels):
     n, hd = h.shape
     v = w.shape[1]
-    br, bv = min(BLOCK_R, max(8, n)), BLOCK_V
+    # row block must be a multiple of the fp32 sublane count (8): an
+    # unaligned N (e.g. 13) would otherwise hand Mosaic a 13-row block
+    # (ADVICE r4 #1); padded rows are masked out via g=0 / label shift
+    br, bv = min(BLOCK_R, -(-max(8, n) // 8) * 8), BLOCK_V
     h_p = _pad_to(_pad_to(h, 0, br), 1, 128)
     w_p = _pad_to(_pad_to(w, 0, 128), 1, bv)
     lab = _pad_to(labels.astype(jnp.int32).reshape(-1, 1), 0, br)

@@ -95,6 +95,10 @@ def _zero_state_spec(param_spec: PartitionSpec, shape, axis, mesh):
         return param_spec
     n = mesh.shape[axis]
     spec = list(param_spec) + [None] * (len(shape) - len(param_spec))
+    # stage 3: the param spec itself already rides the zero axis — the
+    # state inherits it; adding the axis to a second dim is illegal
+    if any(axis == s or (isinstance(s, tuple) and axis in s) for s in spec):
+        return param_spec
     order = sorted(range(len(shape)), key=lambda i: -shape[i])
     for i in order:
         if spec[i] is None and shape[i] % n == 0 and shape[i] >= n:
@@ -295,6 +299,22 @@ class TrainStep:
         if self._jitted is None:
             self._build(batch_vals)
         return "<compiled>"
+
+    def memory_stats(self, batch):
+        """Per-device CompiledMemoryStats (XLA buffer assignment) of the
+        exact compiled step — instrument for the ZeRO memory-scaling
+        guarantee (tests/test_zero_memory.py)."""
+        batch_vals = jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else x, batch,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        if self._jitted is None:
+            self._build(batch_vals)
+        lr = jnp.asarray(self._base_opt.get_lr(), jnp.float32)
+        step = jnp.asarray(1, jnp.int32)
+        rng = gen.next_key()
+        param_vals = [p._value for p in self._params]
+        return self._jitted.lower(param_vals, self._opt_state, batch_vals,
+                                  lr, step, rng).compile().memory_analysis()
 
 
 def compile_train_step(model, loss_fn, optimizer, mesh=None, **kw) -> TrainStep:

@@ -114,26 +114,70 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     return apply(f, x, boxes, op_name="roi_align")
 
 
-def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
-    """RoIPool: max over bins (vision/ops.py roi_pool).
+def _round_half_away(v):
+    """C round(): half away from zero (jnp.round is half-to-even)."""
+    return jnp.where(v >= 0, jnp.floor(v + 0.5), jnp.ceil(v - 0.5))
 
-    DIVERGENCE from the reference kernel: the reference maxes over the exact
-    integer-quantized pixel bin (floor/ceil boundaries, data-dependent
-    extent); that shape is dynamic and does not compile under XLA, so this
-    maxes over a fixed 2x2 bilinear tap grid per bin instead.  Outputs differ
-    numerically for any box; pretrained detection heads relying on exact
-    RoIPool values should use roi_align (which IS reference-exact up to
-    sampling grid) or re-finetune."""
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool: exact quantized-bin max (roi_pool_kernel.cc:100-150).
+
+    Reference semantics, reproduced exactly: box corners are rounded to the
+    integer grid (round-half-away, x spatial_scale), malformed RoIs forced
+    to 1x1, bin (ph, pw) spans pixels [floor(ph*bin), ceil((ph+1)*bin))
+    offset by the box start and clamped to the image, the output is the max
+    over those pixels, and an EMPTY bin yields 0.  The data-dependent bin
+    extent is expressed as a per-(roi, bin) membership mask over the full
+    pixel range and reduced with a two-stage masked max — static shapes,
+    so it compiles under XLA (no dynamic-extent gather)."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
+    oh, ow = output_size
     spans = list(_per_image_spans(boxes_num))
 
+    def pool_one_image(feat, bxs):
+        """feat: [C, H, W]; bxs: [n, 4] -> [n, C, oh, ow]."""
+        H, W = feat.shape[-2], feat.shape[-1]
+        fp = jnp.float32
+        x1 = _round_half_away(bxs[:, 0].astype(fp) * spatial_scale)
+        y1 = _round_half_away(bxs[:, 1].astype(fp) * spatial_scale)
+        x2 = _round_half_away(bxs[:, 2].astype(fp) * spatial_scale)
+        y2 = _round_half_away(bxs[:, 3].astype(fp) * spatial_scale)
+        bh = jnp.maximum(y2 - y1 + 1, 1)      # forced >= 1x1
+        bw = jnp.maximum(x2 - x1 + 1, 1)
+
+        def bounds(start, extent, n_bins, limit):
+            """[n, n_bins] int start/end (clamped, box-offset) per bin."""
+            i = jnp.arange(n_bins, dtype=fp)
+            size = (extent / n_bins)[:, None]
+            lo = jnp.floor(i[None, :] * size) + start[:, None]
+            hi = jnp.ceil((i[None, :] + 1) * size) + start[:, None]
+            return (jnp.clip(lo, 0, limit).astype(jnp.int32),
+                    jnp.clip(hi, 0, limit).astype(jnp.int32))
+
+        hstart, hend = bounds(y1, bh, oh, H)   # [n, oh]
+        wstart, wend = bounds(x1, bw, ow, W)   # [n, ow]
+        hs = jnp.arange(H)
+        ws = jnp.arange(W)
+        mask_h = ((hs[None, None, :] >= hstart[:, :, None])
+                  & (hs[None, None, :] < hend[:, :, None]))   # [n, oh, H]
+        mask_w = ((ws[None, None, :] >= wstart[:, :, None])
+                  & (ws[None, None, :] < wend[:, :, None]))   # [n, ow, W]
+
+        neg = jnp.asarray(jnp.finfo(fp).min, feat.dtype)
+        # stage 1: max over h per (roi, bin-row) -> [n, C, oh, W]
+        tmp = jnp.max(jnp.where(mask_h[:, None, :, :, None],
+                                feat[None, :, None, :, :], neg), axis=3)
+        # stage 2: max over w per (roi, bin-col) -> [n, C, oh, ow]
+        out = jnp.max(jnp.where(mask_w[:, None, None, :, :],
+                                tmp[:, :, :, None, :], neg), axis=4)
+        empty = ((hend <= hstart)[:, None, :, None]
+                 | (wend <= wstart)[:, None, None, :])
+        return jnp.where(empty, jnp.zeros((), feat.dtype), out)
+
     def f(feat, bxs):
-        outs = [_roi_grid_sample(
-            feat[b], bxs[s:s + n], output_size, spatial_scale,
-            sampling_ratio=2, aligned=False,
-            reducer=lambda v: jnp.max(v, axis=(3, 5)))
-            for b, s, n in spans if n]
+        outs = [pool_one_image(feat[b], bxs[s:s + n])
+                for b, s, n in spans if n]
         return jnp.concatenate(outs) if outs else jnp.zeros(
             (0, feat.shape[1], *output_size), feat.dtype)
     return apply(f, x, boxes, op_name="roi_pool")

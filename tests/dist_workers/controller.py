@@ -1,0 +1,70 @@
+"""Multi-controller collectives payload (registry row
+controller_collectives; reference pattern test/legacy_test/
+test_dist_base.py:962 — env-driven ranks, assert collective results).
+
+argv: out_dir.  Writes res{rank}.json with psum / all_reduce / DataParallel
+loss parity / store-backed barrier evidence.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import paddle_tpu as P
+import paddle_tpu.distributed as dist
+from jax.sharding import NamedSharding, PartitionSpec
+from paddle_tpu.distributed.collective import _world_store
+from paddle_tpu.parallel import mesh as mesh_mod
+
+out_dir = sys.argv[1]
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+dist.init_parallel_env({"dp": 2})
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 2, jax.devices()
+mesh = mesh_mod.get_mesh()
+res = {"rank": rank}
+
+# 1) cross-process psum with rank-distinct data through the framework mesh
+local = np.full((1, 4), float(rank + 1), np.float32)
+sharding = NamedSharding(mesh, PartitionSpec("dp", None))
+gx = jax.make_array_from_process_local_data(sharding, local, (2, 4))
+psummed = jax.jit(jax.shard_map(
+    lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+    in_specs=PartitionSpec("dp", None),
+    out_specs=PartitionSpec("dp", None)))(gx)
+res["psum"] = float(np.asarray(psummed.addressable_shards[0].data)[0, 0])
+
+# 2) framework all_reduce on a replicated global tensor
+rep = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, PartitionSpec()), np.ones((4,), np.float32), (4,))
+t = P.Tensor(rep)
+dist.all_reduce(t)
+res["all_reduce"] = float(np.asarray(t._value.addressable_shards[0].data)[0])
+
+# 3) DataParallel loss parity: identical weights everywhere (same seed),
+#    full batch sharded over the two processes by the wrapper
+P.seed(0)
+model = P.nn.Linear(8, 4)
+dp_model = P.DataParallel(model)
+xb = np.random.RandomState(7).randn(4, 8).astype(np.float32)
+loss = dp_model(P.to_tensor(xb)).mean()
+res["dp_loss"] = float(loss.numpy())
+ref = model(P.to_tensor(xb)).mean()   # full batch, no dp sharding
+res["ref_loss"] = float(ref.numpy())
+
+# 4) store-backed barrier: the slow rank publishes a marker BEFORE the
+#    barrier; the fast rank must see it AFTER the barrier — impossible if
+#    barrier() returns without waiting.
+st = _world_store()
+if rank == 1:
+    time.sleep(0.7)
+    st.add("marker", 1)
+dist.barrier()
+res["marker_after_barrier"] = int(st.add("marker", 0))
+
+with open(os.path.join(out_dir, f"res{rank}.json"), "w") as f:
+    json.dump(res, f)

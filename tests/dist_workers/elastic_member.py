@@ -1,0 +1,15 @@
+"""Elastic-membership victim payload (registry row elastic_member, popen
+orchestration: the TEST kills this process to exercise store-clock lease
+expiry).  argv: out_dir store_port node_id.  No jax — pure store client.
+"""
+import sys
+import time
+
+from paddle_tpu.distributed.launch.elastic import ElasticManager
+from paddle_tpu.distributed.store import TCPStore
+
+store = TCPStore("127.0.0.1", int(sys.argv[2]), is_master=False)
+m = ElasticManager(store, node_id=sys.argv[3], np_range=(1, 4),
+                   heartbeat_interval=0.1, timeout=0.5)
+print("joined", flush=True)
+time.sleep(120)   # heartbeat until killed

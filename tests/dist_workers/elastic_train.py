@@ -1,0 +1,69 @@
+"""Fault-injection training payload (registry row elastic_train_killrank;
+reference fleet/elastic/manager.py ETCD-lease liveness + whole-job restart).
+
+argv: out_dir n_steps.  A 2-rank dp job; rank 1 SIGKILLs itself mid-step
+once; the relaunched generation resumes from the sharded checkpoint.
+Writes done{rank}.json with the resume point and the post-resume losses.
+"""
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+
+import paddle_tpu as P
+import paddle_tpu.distributed as dist
+import paddle_tpu.distributed.checkpoint as dck
+
+out_dir = sys.argv[1]
+n_steps = int(sys.argv[2])
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+ckpt = os.path.join(out_dir, "ckpt")
+kill_marker = os.path.join(out_dir, "killed.marker")
+
+dist.init_parallel_env({"dp": 2})
+
+P.seed(0)
+model = P.nn.Linear(8, 4)
+opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+
+start = 0
+meta = os.path.join(ckpt, "step.json")
+if os.path.exists(meta):
+    with open(meta) as f:
+        start = json.load(f)["step"]
+    state = {"params": {n: p._value for n, p in model.named_parameters()}}
+    dck.load_state_dict(state, ckpt)
+    for n, p in model.named_parameters():
+        p._set_value(state["params"][n])
+
+rng = np.random.RandomState(0)
+losses = []
+for step in range(n_steps):
+    x = rng.randn(4, 8).astype(np.float32)   # deterministic data stream
+    y = rng.randn(4, 4).astype(np.float32)
+    if step < start:
+        continue                             # replay RNG, skip done steps
+    loss = P.nn.functional.mse_loss(model(P.to_tensor(x)), P.to_tensor(y))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    losses.append(float(loss.numpy()))
+
+    dck.save_state_dict(
+        {"params": {n: p._value for n, p in model.named_parameters()}}, ckpt)
+    dck.wait()
+    dist.barrier()
+    if rank == 0:
+        with open(meta, "w") as f:
+            json.dump({"step": step + 1}, f)
+    dist.barrier()
+
+    # FAULT: rank 1 dies hard mid-run, once
+    if rank == 1 and step == 1 and not os.path.exists(kill_marker):
+        open(kill_marker, "w").write("x")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+with open(os.path.join(out_dir, f"done{rank}.json"), "w") as f:
+    json.dump({"rank": rank, "resumed_from": start, "losses": losses}, f)

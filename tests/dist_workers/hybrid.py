@@ -1,0 +1,87 @@
+"""Hybrid-parallel payload (registry rows hybrid_2proc / hybrid_ref).
+
+argv: out_dir n_steps [schedule]
+
+Builds the tiny-LLaMA compiled hybrid step (dp2 x pp2 x mp2, Megatron-SP,
+ZeRO state sharding, selectable pipeline schedule incl. VPP interleave) and
+runs n_steps on a deterministic batch stream.  Multi-process rows also save
+a sharded checkpoint and run a 1-step resume leg from a fresh model.
+Writes res{rank}.json: {"losses": [...], "resumed": [...]}.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import jax
+import paddle_tpu as P
+import paddle_tpu.distributed as dist
+import paddle_tpu.distributed.checkpoint as dck
+from paddle_tpu.distributed.fleet.meta_parallel.sharding_optimizer import (
+    DygraphShardingOptimizer,
+)
+from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                               build_hybrid_train_step)
+from paddle_tpu.parallel import mesh as mesh_mod
+
+out_dir = sys.argv[1]
+n_steps = int(sys.argv[2])
+schedule = sys.argv[3] if len(sys.argv) > 3 else "1f1b"
+nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+dist.init_parallel_env({"dp": 2, "pp": 2, "mp": 2})
+mesh = mesh_mod.get_mesh()
+if nprocs > 1:
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()
+    # dp must be the cross-process axis: each process contributes 4 devices
+    assert mesh.devices.shape == (2, 2, 2)
+
+
+def build():
+    P.seed(0)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=4, heads=4, inter=64)
+    cfg.sequence_parallel = True
+    model = LlamaForCausalLM(cfg)
+    opt = P.optimizer.AdamW(learning_rate=1e-2,
+                            parameters=model.parameters())
+    opt = DygraphShardingOptimizer(opt)
+    return build_hybrid_train_step(
+        model, opt, mesh=mesh, n_microbatches=4, schedule=schedule,
+        n_virtual=2 if schedule == "vpp" else 1)
+
+
+def run(step, n, skip=0):
+    rng = np.random.RandomState(0)
+    for _ in range(skip):
+        rng.randint(0, 64, (8, 17))
+    losses = []
+    for _ in range(n):
+        ids = rng.randint(0, 64, (8, 17))
+        batch = {"input_ids": P.to_tensor(ids[:, :-1]),
+                 "labels": P.to_tensor(ids[:, 1:])}
+        loss = step(batch)
+        losses.append(float(np.asarray(
+            loss._value.addressable_shards[0].data)))
+    return losses
+
+
+step = build()
+losses = run(step, n_steps)
+resumed = []
+if nprocs > 1:  # checkpoint-resume leg: sharded save, fresh model, reload
+    ckpt = os.path.join(out_dir, "ckpt")
+    dck.save_state_dict({"params": step.state["params"],
+                         "opt": step.state["opt"]}, ckpt)
+    dck.wait()
+    step2 = build()
+    state = {"params": step2.state["params"], "opt": step2.state["opt"]}
+    dck.load_state_dict(state, ckpt)
+    step2.state["params"] = state["params"]
+    step2.state["opt"] = state["opt"]
+    resumed = run(step2, 1, skip=n_steps)
+
+with open(os.path.join(out_dir, f"res{rank}.json"), "w") as f:
+    json.dump({"rank": rank, "losses": losses, "resumed": resumed}, f)

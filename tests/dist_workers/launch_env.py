@@ -1,0 +1,13 @@
+"""Launcher env-contract payload (registry row launch_env): dump the
+rank-describing env vars the launcher must set.  argv: out_dir."""
+import json
+import os
+import sys
+
+rank = os.environ["PADDLE_TRAINER_ID"]
+out = os.path.join(sys.argv[1], f"res{rank}.json")
+with open(out, "w") as f:
+    json.dump({k: os.environ.get(k) for k in
+               ["PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+                "PADDLE_LOCAL_RANK", "MASTER_ADDR", "MASTER_PORT",
+                "WORLD_SIZE"]}, f)

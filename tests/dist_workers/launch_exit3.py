@@ -1,0 +1,5 @@
+"""Permanent-failure payload (registry row launch_exit3): always exit 3 —
+the launcher must give up after --max_restart and propagate the code."""
+import sys
+
+sys.exit(3)

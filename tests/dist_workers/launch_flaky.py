@@ -1,0 +1,12 @@
+"""Restart-on-failure payload (registry row launch_flaky): exit 1 on the
+first attempt only; the launcher's --max_restart must retry it to success.
+argv: out_dir."""
+import os
+import sys
+
+marker = os.path.join(sys.argv[1], "attempt")
+n = 0
+if os.path.exists(marker):
+    n = int(open(marker).read())
+open(marker, "w").write(str(n + 1))
+sys.exit(1 if n == 0 else 0)  # fail on the first attempt only

@@ -1,7 +1,7 @@
 """Fault injection for elastic training (VERDICT r3 item 9; reference:
 fleet/elastic/manager.py ETCD-lease liveness + whole-job restart).
 
-Two legs:
+Two legs, both driven from the declarative registry (dist_registry.py):
 1. store-side TTL lease semantics: a member SIGKILLed mid-run is declared
    dead by the STORE's clock — in particular, a FRESH observer that never
    saw the victim's heartbeats agrees immediately after expiry (the
@@ -11,34 +11,14 @@ Two legs:
    generation), and the workers RESUME from the sharded checkpoint —
    the final loss equals an uninterrupted run's.
 """
-import json
-import os
-import signal
 import subprocess
-import sys
 import time
 
 import numpy as np
-import pytest
 
+from dist_registry import run_dist, start_dist
 from paddle_tpu.distributed.launch.elastic import ElasticManager
-from paddle_tpu.distributed.store import TCPStore, create_master_store
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-MEMBER = r'''
-import os, sys, time
-sys.path.insert(0, {repo!r})
-from paddle_tpu.distributed.store import TCPStore
-from paddle_tpu.distributed.launch.elastic import ElasticManager
-
-store = TCPStore("127.0.0.1", int(sys.argv[1]), is_master=False)
-m = ElasticManager(store, node_id=sys.argv[2], np_range=(1, 4),
-                   heartbeat_interval=0.1, timeout=0.5)
-print("joined", flush=True)
-time.sleep(120)   # heartbeat until killed
-'''
+from paddle_tpu.distributed.store import create_master_store
 
 
 def test_lease_survives_fresh_observer_after_kill(tmp_path):
@@ -47,11 +27,9 @@ def test_lease_survives_fresh_observer_after_kill(tmp_path):
     correct alive set as soon as the TTL lapses."""
     master = create_master_store(port=0, world_size=1)
     try:
-        script = tmp_path / "member.py"
-        script.write_text(MEMBER.format(repo=REPO))
-        victim = subprocess.Popen(
-            [sys.executable, str(script), str(master.port), "victim"],
-            stdout=subprocess.PIPE, text=True)
+        victim = start_dist("elastic_member", tmp_path,
+                            args=(master.port, "victim"),
+                            stdout=subprocess.PIPE)
         assert victim.stdout.readline().strip() == "joined"
 
         alive_mgr = ElasticManager(master, node_id="survivor",
@@ -81,98 +59,18 @@ def test_lease_survives_fresh_observer_after_kill(tmp_path):
         master.stop()
 
 
-WORKER = r'''
-import json, os, signal, sys
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("XLA_FLAGS", None)
-import jax
-jax.config.update("jax_platforms", "cpu")
-import numpy as np
-import paddle_tpu as P
-import paddle_tpu.distributed as dist
-import paddle_tpu.distributed.checkpoint as dck
-
-out_dir = sys.argv[1]
-n_steps = int(sys.argv[2])
-rank = int(os.environ["PADDLE_TRAINER_ID"])
-ckpt = os.path.join(out_dir, "ckpt")
-kill_marker = os.path.join(out_dir, "killed.marker")
-
-dist.init_parallel_env({"dp": 2})
-
-P.seed(0)
-model = P.nn.Linear(8, 4)
-opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
-
-start = 0
-meta = os.path.join(ckpt, "step.json")
-if os.path.exists(meta):
-    with open(meta) as f:
-        start = json.load(f)["step"]
-    state = {"params": {n: p._value for n, p in model.named_parameters()}}
-    dck.load_state_dict(state, ckpt)
-    for n, p in model.named_parameters():
-        p._set_value(state["params"][n])
-
-rng = np.random.RandomState(0)
-losses = []
-for step in range(n_steps):
-    x = rng.randn(4, 8).astype(np.float32)   # deterministic data stream
-    y = rng.randn(4, 4).astype(np.float32)
-    if step < start:
-        continue                             # replay RNG, skip done steps
-    loss = P.nn.functional.mse_loss(model(P.to_tensor(x)), P.to_tensor(y))
-    loss.backward(); opt.step(); opt.clear_grad()
-    losses.append(float(loss.numpy()))
-
-    dck.save_state_dict(
-        {"params": {n: p._value for n, p in model.named_parameters()}}, ckpt)
-    dck.wait()
-    dist.barrier()
-    if rank == 0:
-        with open(meta, "w") as f:
-            json.dump({"step": step + 1}, f)
-    dist.barrier()
-
-    # FAULT: rank 1 dies hard mid-run, once
-    if rank == 1 and step == 1 and not os.path.exists(kill_marker):
-        open(kill_marker, "w").write("x")
-        os.kill(os.getpid(), signal.SIGKILL)
-
-with open(os.path.join(out_dir, f"done{rank}.json"), "w") as f:
-    json.dump({"rank": rank, "resumed_from": start, "losses": losses}, f)
-'''
-
-
 def test_kill_rank_relaunch_resume(tmp_path):
     n_steps = 4
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    env = dict(os.environ,
-               PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""))
-    env.pop("XLA_FLAGS", None)
-    r = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node=2", "--max_restart=3",
-         f"--log_dir={tmp_path}/log", str(script), str(tmp_path),
-         str(n_steps)],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
-    logs = ""
-    logdir = tmp_path / "log"
-    if logdir.exists():
-        for p in sorted(logdir.iterdir()):
-            logs += f"\n--- {p.name} ---\n" + p.read_text()[-2000:]
-    assert r.returncode == 0, f"launch failed: {r.stderr[-2000:]}\n{logs}"
+    r, _, logs = run_dist("elastic_train_killrank", tmp_path,
+                          args=(n_steps,))
     # the pod restarted (the controller's relaunch message) ...
     assert "restarting all local ranks" in r.stderr + logs, logs
 
-    results = {}
+    from dist_registry import REGISTRY, collect_results
+    results = collect_results(REGISTRY["elastic_train_killrank"], tmp_path,
+                              prefix="done")
     for rank in (0, 1):
-        path = tmp_path / f"done{rank}.json"
-        assert path.exists(), f"rank {rank} never completed\n{logs}"
-        with open(path) as f:
-            results[rank] = json.load(f)
+        assert rank in results, f"rank {rank} never completed\n{logs}"
     # ... and the second generation RESUMED, not restarted from scratch
     assert results[0]["resumed_from"] >= 1, results
     assert results[0]["resumed_from"] == results[1]["resumed_from"]
@@ -191,7 +89,9 @@ def test_kill_rank_relaunch_resume(tmp_path):
         y = rng.randn(4, 4).astype(np.float32)
         loss = P.nn.functional.mse_loss(model(P.to_tensor(x)),
                                         P.to_tensor(y))
-        loss.backward(); opt.step(); opt.clear_grad()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
         ref.append(float(loss.numpy()))
     resumed_losses = results[0]["losses"]
     np.testing.assert_allclose(

@@ -4,10 +4,7 @@ Launcher tests mirror the reference's TestDistBase pattern (SURVEY.md §4:
 multi-process on one host, env-driven ranks); checkpoint tests cover
 reshard-on-load across mesh changes (converter/dist_saver parity).
 """
-import json
 import os
-import subprocess
-import sys
 
 import numpy as np
 import pytest
@@ -17,8 +14,6 @@ import paddle_tpu.distributed as dist
 import paddle_tpu.nn as nn
 from paddle_tpu.parallel import mesh as mesh_mod
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
 
 @pytest.fixture(autouse=True)
 def clean_mesh():
@@ -26,46 +21,12 @@ def clean_mesh():
     mesh_mod.set_mesh(None)
 
 
-WORKER = """
-import json, os, sys
-rank = os.environ["PADDLE_TRAINER_ID"]
-out = os.path.join(sys.argv[1], f"rank{rank}.json")
-with open(out, "w") as f:
-    json.dump({k: os.environ.get(k) for k in
-               ["PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "PADDLE_LOCAL_RANK",
-                "MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE"]}, f)
-"""
-
-FLAKY = """
-import os, sys
-marker = os.path.join(sys.argv[1], "attempt")
-n = 0
-if os.path.exists(marker):
-    n = int(open(marker).read())
-open(marker, "w").write(str(n + 1))
-sys.exit(1 if n == 0 else 0)  # fail on the first attempt only
-"""
-
-
-def _run_launch(tmp_path, script_body, nproc, extra=()):
-    script = tmp_path / "worker.py"
-    script.write_text(script_body)
-    env = dict(os.environ, PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""))
-    return subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         f"--nproc_per_node={nproc}", f"--log_dir={tmp_path}/log", *extra,
-         str(script), str(tmp_path)],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
-
-
 def test_launch_sets_rank_env(tmp_path):
-    r = _run_launch(tmp_path, WORKER, nproc=3)
-    assert r.returncode == 0, r.stderr
-    seen = {}
+    from dist_registry import run_dist
+
+    _, seen, logs = run_dist("launch_env", tmp_path)
     for i in range(3):
-        with open(tmp_path / f"rank{i}.json") as f:
-            seen[i] = json.load(f)
-    for i in range(3):
+        assert i in seen, f"rank {i} produced no result\n{logs}"
         assert seen[i]["PADDLE_TRAINER_ID"] == str(i)
         assert seen[i]["PADDLE_TRAINERS_NUM"] == "3"
         assert seen[i]["WORLD_SIZE"] == "3"
@@ -73,22 +34,17 @@ def test_launch_sets_rank_env(tmp_path):
 
 
 def test_launch_restarts_failed_worker(tmp_path):
-    r = _run_launch(tmp_path, FLAKY, nproc=1, extra=("--max_restart=2",))
-    assert r.returncode == 0, r.stderr
+    from dist_registry import run_dist
+
+    r, _, _ = run_dist("launch_flaky", tmp_path)
     assert "restart 1/2" in r.stderr
     assert (tmp_path / "attempt").read_text() == "2"
 
 
 def test_launch_gives_up_after_max_restart(tmp_path):
-    script = tmp_path / "worker.py"
-    script.write_text("import sys; sys.exit(3)\n")
-    env = dict(os.environ, PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""))
-    r = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node=1", "--max_restart=1", f"--log_dir={tmp_path}/log",
-         str(script), str(tmp_path)],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
-    assert r.returncode == 3
+    from dist_registry import run_dist
+
+    r, _, _ = run_dist("launch_exit3", tmp_path)  # registry expects rc=3
     assert "failed permanently" in r.stderr
 
 

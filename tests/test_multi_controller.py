@@ -2,117 +2,18 @@
 item 5; reference pattern: test/legacy_test/test_dist_base.py:962 — spawn
 real processes, env-driven ranks, assert collective results).
 
-Two REAL processes are launched via `python -m paddle_tpu.distributed.launch`
-with the CPU backend (one XLA device per process). They rendezvous through
-the launcher's TCPStore + jax.distributed coordination service, then assert:
-  * a cross-process psum over the framework mesh (rank-distinct contributions)
-  * the framework-level all_reduce on a replicated global tensor
-  * DataParallel loss parity vs the local full-batch reference
-  * barrier() actually blocks until the slow rank arrives (store-backed)
+Two REAL processes rendezvous through the launcher's TCPStore +
+jax.distributed (payload: tests/dist_workers/controller.py, driven from the
+declarative registry) and assert cross-process psum, framework all_reduce,
+DataParallel loss parity, and that barrier() actually blocks.
 """
-import json
-import os
-import subprocess
-import sys
-
-import pytest
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-WORKER = r'''
-import json, os, sys, time
-
-import numpy as np
-
-# one CPU device per process; never touch a real accelerator backend
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("XLA_FLAGS", None)
-import jax
-jax.config.update("jax_platforms", "cpu")
-
-import paddle_tpu as P
-import paddle_tpu.distributed as dist
-from paddle_tpu.distributed.collective import _world_store
-from paddle_tpu.parallel import mesh as mesh_mod
-from jax.sharding import NamedSharding, PartitionSpec
-
-out_dir = sys.argv[1]
-rank = int(os.environ["PADDLE_TRAINER_ID"])
-
-dist.init_parallel_env({"dp": 2})
-assert jax.process_count() == 2, jax.process_count()
-assert len(jax.devices()) == 2, jax.devices()
-mesh = mesh_mod.get_mesh()
-res = {"rank": rank}
-
-# 1) cross-process psum with rank-distinct data through the framework mesh
-local = np.full((1, 4), float(rank + 1), np.float32)
-sharding = NamedSharding(mesh, PartitionSpec("dp", None))
-gx = jax.make_array_from_process_local_data(sharding, local, (2, 4))
-psummed = jax.jit(jax.shard_map(
-    lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
-    in_specs=PartitionSpec("dp", None),
-    out_specs=PartitionSpec("dp", None)))(gx)
-res["psum"] = float(np.asarray(psummed.addressable_shards[0].data)[0, 0])
-
-# 2) framework all_reduce on a replicated global tensor
-rep = jax.make_array_from_process_local_data(
-    NamedSharding(mesh, PartitionSpec()), np.ones((4,), np.float32), (4,))
-t = P.Tensor(rep)
-dist.all_reduce(t)
-res["all_reduce"] = float(np.asarray(t._value.addressable_shards[0].data)[0])
-
-# 3) DataParallel loss parity: identical weights everywhere (same seed),
-#    full batch sharded over the two processes by the wrapper
-P.seed(0)
-model = P.nn.Linear(8, 4)
-dp_model = P.DataParallel(model)
-xb = np.random.RandomState(7).randn(4, 8).astype(np.float32)
-loss = dp_model(P.to_tensor(xb)).mean()
-res["dp_loss"] = float(loss.numpy())
-ref = model(P.to_tensor(xb)).mean()   # full batch, no dp sharding
-res["ref_loss"] = float(ref.numpy())
-
-# 4) store-backed barrier: the slow rank publishes a marker BEFORE the
-#    barrier; the fast rank must see it AFTER the barrier — impossible if
-#    barrier() returns without waiting.
-st = _world_store()
-if rank == 1:
-    time.sleep(0.7)
-    st.add("marker", 1)
-dist.barrier()
-res["marker_after_barrier"] = int(st.add("marker", 0))
-
-with open(os.path.join(out_dir, f"res{rank}.json"), "w") as f:
-    json.dump(res, f)
-'''
+from dist_registry import run_dist
 
 
 def test_two_process_collectives(tmp_path):
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    env = dict(os.environ,
-               PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""))
-    env.pop("XLA_FLAGS", None)  # no virtual 8-device mesh in the workers
-    r = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
-         str(script), str(tmp_path)],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=150)
-    logs = ""
-    logdir = tmp_path / "log"
-    if logdir.exists():
-        for p in sorted(logdir.iterdir()):
-            logs += f"\n--- {p.name} ---\n" + p.read_text()[-2000:]
-    assert r.returncode == 0, f"launch failed: {r.stderr}\n{logs}"
-
-    results = {}
+    _, results, logs = run_dist("controller_collectives", tmp_path)
     for rank in (0, 1):
-        path = tmp_path / f"res{rank}.json"
-        assert path.exists(), f"rank {rank} produced no result\n{logs}"
-        with open(path) as f:
-            results[rank] = json.load(f)
-    for rank in (0, 1):
+        assert rank in results, f"rank {rank} produced no result\n{logs}"
         res = results[rank]
         assert res["psum"] == 3.0, res            # 1 + 2 across processes
         assert res["all_reduce"] == 2.0, res      # replicated x world=2

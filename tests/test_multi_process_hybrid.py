@@ -1,172 +1,32 @@
-"""Multi-process HYBRID-parallel verification (VERDICT r3 item 3; reference
-pattern: test/collective/fleet/test_parallel_dygraph_pipeline_parallel.py:25
-— launch a real multi-device job running the hybrid payload).
+"""Multi-process HYBRID-parallel verification (VERDICT r3 item 3 / r4 items
+3+5; reference pattern: test/collective/fleet/
+test_parallel_dygraph_pipeline_parallel.py:25).
 
-Two REAL processes x 4 virtual CPU devices each = an 8-device dp2 x pp2 x mp2
-mesh spanning processes (dp is the cross-process axis).  The workers:
-  * rendezvous via the launcher's TCPStore + jax.distributed,
-  * build the SAME tiny LLaMA and run the compiled hybrid train step
-    (1F1B pipeline + TP + dp-sharded ZeRO states) for 3 steps,
-  * save a sharded checkpoint (each process writes its addressable shards),
-  * reload it into a fresh model/optimizer and run 1 more step (resume leg).
-
-The test then asserts loss parity per step against the SAME payload run
-single-process on the conftest's 8-device mesh, and that the resumed step-4
-loss matches a 4-step single-process run.
+Two REAL processes x 4 virtual CPU devices each = an 8-device dp2 x pp2 x
+mp2 mesh spanning processes (dp is the cross-process axis), driven from the
+declarative registry (dist_registry.py, the testslist.csv analog).  Both the
+1F1B and the VPP (interleaved virtual stage) schedules get:
+  * per-step loss parity across the two ranks,
+  * loss parity vs the SAME payload single-process on an 8-device mesh,
+  * a sharded-checkpoint save -> fresh-model resume leg whose step-(N+1)
+    loss equals the uninterrupted single-process run's.
 """
-import json
-import os
-import subprocess
-import sys
-
 import numpy as np
+import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from dist_registry import run_dist
 
 N_STEPS = 3
 
-PAYLOAD = r'''
-import numpy as np
 
-
-def run_payload(n_steps, ckpt_dir=None, resume=False, skip_batches=0):
-    """Build the hybrid model/step deterministically and run n_steps.
-    Returns list of per-step losses.  With resume=True, first load the
-    sharded checkpoint from ckpt_dir into the fresh state, then run.
-    skip_batches advances the data stream so a resumed run continues the
-    uninterrupted batch sequence."""
-    import jax
-    import paddle_tpu as P
-    from paddle_tpu.distributed.fleet.meta_parallel.sharding_optimizer import (
-        DygraphShardingOptimizer,
-    )
-    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
-                                   build_hybrid_train_step)
-    from paddle_tpu.parallel import mesh as mesh_mod
-
-    mesh = mesh_mod.get_mesh()
-    P.seed(0)
-    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=4, heads=4, inter=64)
-    cfg.sequence_parallel = True
-    model = LlamaForCausalLM(cfg)
-    opt = P.optimizer.AdamW(learning_rate=1e-2,
-                            parameters=model.parameters())
-    opt = DygraphShardingOptimizer(opt)
-    step = build_hybrid_train_step(model, opt, mesh=mesh, n_microbatches=4)
-
-    if resume:
-        import paddle_tpu.distributed.checkpoint as dck
-        state = {"params": step.state["params"], "opt": step.state["opt"]}
-        dck.load_state_dict(state, ckpt_dir)
-        step.state["params"] = state["params"]
-        step.state["opt"] = state["opt"]
-
-    rng = np.random.RandomState(0)
-    for _ in range(skip_batches):
-        rng.randint(0, cfg.vocab_size, (8, 17))
-    losses = []
-    for i in range(n_steps):
-        ids = rng.randint(0, cfg.vocab_size, (8, 17))
-        batch = {"input_ids": P.to_tensor(ids[:, :-1]),
-                 "labels": P.to_tensor(ids[:, 1:])}
-        loss = step(batch)
-        losses.append(float(np.asarray(
-            loss._value.addressable_shards[0].data)))
-    if ckpt_dir is not None and not resume:
-        import paddle_tpu.distributed.checkpoint as dck
-        dck.save_state_dict({"params": step.state["params"],
-                             "opt": step.state["opt"]}, ckpt_dir)
-        dck.wait()
-    return losses
-'''
-
-WORKER = PAYLOAD + r'''
-import json, os, sys
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=4"
-import jax
-jax.config.update("jax_platforms", "cpu")
-
-import paddle_tpu.distributed as dist
-from paddle_tpu.parallel import mesh as mesh_mod
-
-out_dir = sys.argv[1]
-n_steps = int(sys.argv[2])
-rank = int(os.environ["PADDLE_TRAINER_ID"])
-
-dist.init_parallel_env({"dp": 2, "pp": 2, "mp": 2})
-assert jax.process_count() == 2, jax.process_count()
-assert len(jax.devices()) == 8, jax.devices()
-mesh = mesh_mod.get_mesh()
-# dp must be the cross-process axis: each process contributes 4 devices
-assert mesh.devices.shape == (2, 2, 2)
-
-ckpt = os.path.join(out_dir, "ckpt")
-losses = run_payload(n_steps, ckpt_dir=ckpt)
-resumed = run_payload(1, ckpt_dir=ckpt, resume=True, skip_batches=n_steps)
-
-with open(os.path.join(out_dir, f"res{rank}.json"), "w") as f:
-    json.dump({"rank": rank, "losses": losses, "resumed": resumed}, f)
-'''
-
-
-def _single_process_reference(tmp_path, n_steps):
-    """Same payload on this process's own 8-device mesh (conftest platform),
-    in a subprocess so mesh/global state can't leak into other tests."""
-    script = tmp_path / "ref.py"
-    script.write_text(PAYLOAD + r'''
-import json, os, sys
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-import jax
-jax.config.update("jax_platforms", "cpu")
-import paddle_tpu.distributed as dist
-
-out, n_steps = sys.argv[1], int(sys.argv[2])
-dist.init_parallel_env({"dp": 2, "pp": 2, "mp": 2})
-losses = run_payload(n_steps)
-with open(out, "w") as f:
-    json.dump(losses, f)
-''')
-    out = tmp_path / "ref.json"
-    env = dict(os.environ,
-               PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""))
-    r = subprocess.run([sys.executable, str(script), str(out), str(n_steps)],
-                       cwd=REPO, env=env, capture_output=True, text=True,
-                       timeout=600)
-    assert r.returncode == 0, f"reference run failed: {r.stderr[-3000:]}"
-    with open(out) as f:
-        return json.load(f)
-
-
-def test_two_process_hybrid_parallel(tmp_path):
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    env = dict(os.environ,
-               PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""))
-    env.pop("XLA_FLAGS", None)  # workers set their own 4-device flag
-    r = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
-         str(script), str(tmp_path), str(N_STEPS)],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
-    logs = ""
-    logdir = tmp_path / "log"
-    if logdir.exists():
-        for p in sorted(logdir.iterdir()):
-            logs += f"\n--- {p.name} ---\n" + p.read_text()[-3000:]
-    assert r.returncode == 0, f"launch failed: {r.stderr[-2000:]}\n{logs}"
-
-    results = {}
+@pytest.mark.parametrize("schedule", ["1f1b", "vpp"])
+def test_two_process_hybrid_parallel(tmp_path, schedule):
+    mp_dir = tmp_path / "mp"
+    mp_dir.mkdir()
+    _, results, logs = run_dist("hybrid_2proc", mp_dir,
+                                args=(N_STEPS, schedule))
     for rank in (0, 1):
-        path = tmp_path / f"res{rank}.json"
-        assert path.exists(), f"rank {rank} produced no result\n{logs}"
-        with open(path) as f:
-            results[rank] = json.load(f)
+        assert rank in results, f"rank {rank} produced no result\n{logs}"
 
     # both processes observe the identical global loss sequence
     np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
@@ -175,7 +35,12 @@ def test_two_process_hybrid_parallel(tmp_path):
                                rtol=1e-6)
 
     # loss parity with the single-process 8-device run of the same payload
-    ref = _single_process_reference(tmp_path, N_STEPS + 1)
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    _, ref_results, ref_logs = run_dist("hybrid_ref", ref_dir,
+                                        args=(N_STEPS + 1, schedule))
+    assert 0 in ref_results, f"reference run produced no result\n{ref_logs}"
+    ref = ref_results[0]["losses"]
     np.testing.assert_allclose(results[0]["losses"], ref[:N_STEPS],
                                rtol=1e-4, atol=1e-5)
 

@@ -401,3 +401,35 @@ def test_pairwise_and_losses_grad_numeric():
     v = rng2.rand(5).astype("f") + 0.5
     OpTest.check_grad(lambda p, l, vv: F.gaussian_nll_loss(p, l, vv),
                       [x, y, v], wrt=(0, 2), eps=1e-4)
+
+
+def test_spectral_norm_forward_and_constant_uv_grad():
+    """SpectralNorm divides by the power-iterated sigma, and its gradient
+    treats the iterated u/v as CONSTANTS (reference spectral_norm_op: grad
+    flows only through w in sigma = u^T w v, even unconverged iterations)."""
+    import jax
+    import jax.numpy as jnp
+
+    w_np = rng.randn(4, 6).astype("f")
+    layer = nn.SpectralNorm([4, 6], dim=0, power_iters=1)
+    u0 = layer.weight_u.numpy().copy()
+    v0 = layer.weight_v.numpy().copy()
+    out = layer(t(w_np))
+    # one manual power iteration from the SAME persistent u/v buffers
+    def norm(a):
+        return a / max(np.linalg.norm(a), 1e-12)
+    v1 = norm(w_np.T @ u0)
+    u1 = norm(w_np @ v1)
+    sigma = float(u1 @ w_np @ v1)
+    np.testing.assert_allclose(out.numpy(), w_np / sigma, rtol=1e-5)
+
+    # grad semantics: d/dw sum(w/sigma) with d sigma/dw = u1 v1^T exactly
+    layer2 = nn.SpectralNorm([4, 6], dim=0, power_iters=1)
+
+    def f(wv):
+        return jnp.sum(layer2(P.Tensor(wv))._value)
+
+    g = jax.grad(f)(jnp.asarray(w_np))
+    ones = np.ones_like(w_np)
+    expected = ones / sigma - (np.sum(ones * w_np) / sigma ** 2) * np.outer(u1, v1)
+    np.testing.assert_allclose(np.asarray(g), expected, rtol=1e-4, atol=1e-5)

@@ -249,3 +249,140 @@ def test_export_tp_model_single_device_retrace(tmp_path):
                                    atol=1e-6)
     finally:
         mesh_mod.set_mesh(None)
+
+
+class _ScanLayer(nn.Layer):
+    """Forward uses lax control flow directly: exercises the Scan / Loop /
+    If converters (VERDICT r4 item 9; reference python/paddle/onnx export
+    covers paddle's while/cond via its dy2static counterpart)."""
+
+    def __init__(self, kind):
+        super().__init__()
+        self.kind = kind
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+        v = x._value
+
+        if self.kind == "scan":
+            def step(carry, row):
+                new = jnp.tanh(carry + row)
+                return new, new * 2.0
+            carry, ys = jax.lax.scan(step, jnp.zeros(v.shape[1:], v.dtype), v)
+            out = carry.sum() + ys.sum()
+        elif self.kind == "while":
+            def cond(s):
+                return s[0] < 10.0
+            def body(s):
+                return (s[0] + 1.0, s[1] * 1.5 + s[0])
+            a, b = jax.lax.while_loop(
+                cond, body, (jnp.asarray(0.0, v.dtype), v.sum()))
+            out = a + b
+        elif self.kind == "cond":
+            out = jax.lax.cond(v.sum() > 0,
+                               lambda u: u.sum() * 2.0,
+                               lambda u: u.sum() - 1.0, v)
+        elif self.kind == "fori":
+            out = jax.lax.fori_loop(
+                0, 5, lambda i, s: s * 1.1 + jnp.float32(i), v.sum())
+        else:
+            raise ValueError(self.kind)
+        return P.Tensor(out)
+
+
+@pytest.mark.parametrize("kind", ["scan", "while", "cond", "fori"])
+def test_onnx_control_flow_round_trip(tmp_path, kind):
+    m = _ScanLayer(kind)
+    path = P.onnx.export(m, str(tmp_path / kind),
+                         input_spec=[InputSpec([3, 4], "float32", name="x")])
+    x = rng.randn(3, 4).astype("f")
+    ref = m(P.to_tensor(x)).numpy()
+    got = P.onnx.run_model(path, {"x": x})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # the negative branch of cond must also be exercised
+    if kind == "cond":
+        xn = -np.abs(x)
+        np.testing.assert_allclose(P.onnx.run_model(path, {"x": xn})[0],
+                                   m(P.to_tensor(xn)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class _MiscPrims(nn.Layer):
+    def forward(self, x):
+        import jax
+        v = x._value
+        vals, idx = jax.lax.top_k(v, 3)
+        cs = v.cumsum(axis=-1)
+        import jax.numpy as jnp
+        sl = jax.lax.dynamic_slice(
+            v, (idx[0, 0].astype("int32") * 0, jnp.int32(1)), (2, 3))
+        return P.Tensor(vals.sum() + cs.sum() + sl.sum()
+                        + idx.astype(v.dtype).sum())
+
+
+def test_onnx_topk_cumsum_dynamic_slice_round_trip(tmp_path):
+    m = _MiscPrims()
+    path = P.onnx.export(m, str(tmp_path / "misc"),
+                         input_spec=[InputSpec([4, 6], "float32", name="x")])
+    x = rng.randn(4, 6).astype("f")
+    np.testing.assert_allclose(P.onnx.run_model(path, {"x": x})[0],
+                               m(P.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_rnn_model_round_trip(tmp_path):
+    """An actual recurrent MODEL (lax.scan inside nn.GRU) survives export
+    and replays numerically in the interpreter."""
+    P.seed(7)
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.rnn = nn.GRU(8, 16)
+            self.head = nn.Linear(16, 4)
+
+        def forward(self, x):
+            out, _ = self.rnn(x)
+            return self.head(out[:, -1])
+
+    m = Net()
+    m.eval()
+    path = P.onnx.export(m, str(tmp_path / "gru"),
+                         input_spec=[InputSpec([2, 5, 8], "float32",
+                                               name="x")])
+    x = rng.randn(2, 5, 8).astype("f")
+    ref = m(P.to_tensor(x)).numpy()
+    got = P.onnx.run_model(path, {"x": x})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_tp_export_warns_replicated(tmp_path):
+    """Exporting a model with sharded params warns and records the
+    replicated-semantics note in the graph doc_string (VERDICT r4 item 9)."""
+    import warnings
+
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.init_mesh({"mp": 2})
+    try:
+        from paddle_tpu.distributed.fleet.meta_parallel.mp_layers import (
+            ColumnParallelLinear,
+        )
+        m = ColumnParallelLinear(8, 8, gather_output=True)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            path = P.onnx.export(
+                m, str(tmp_path / "tp"),
+                input_spec=[InputSpec([2, 8], "float32", name="x")])
+        assert any("REPLICATED" in str(x.message) for x in w), \
+            [str(x.message) for x in w]
+        from paddle_tpu.onnx.proto import pb
+        mp = pb.ModelProto.FromString(open(path, "rb").read())
+        assert "REPLICATED" in mp.graph.doc_string
+        # and the exported math still replays
+        x = rng.randn(2, 8).astype("f")
+        np.testing.assert_allclose(P.onnx.run_model(path, {"x": x})[0],
+                                   m(P.to_tensor(x)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        mesh_mod.set_mesh(None)

@@ -375,6 +375,358 @@ CASES = {
                  np.asarray([3], np.int64)], wrt=(0,)),
 }
 
+# ---------------------------------------------------------------------------
+# Round-5 extension (VERDICT r4 item 4): the long tail beyond the model-zoo
+# floor — linalg decompositions (with their grads), fft, indexing/scatter,
+# stats/quantiles, special functions, and the loss family.  Decomposition
+# outputs with sign/phase gauge freedom are compared through invariant
+# functionals (|R| for qr, singular/eigen-values, reconstructions) so the
+# dtype sweep never fails on a legitimate sign flip.
+# ---------------------------------------------------------------------------
+
+def _pd(seed, n=4):
+    """Well-conditioned symmetric positive-definite matrix."""
+    a = _r(seed).randn(n, n)
+    return a @ a.T + n * np.eye(n)
+
+
+def _sym(seed, n=4):
+    a = _r(seed).randn(n, n)
+    return (a + a.T) / 2 + np.diag(np.arange(n) * 2.0)  # separated eigvals
+
+
+L = P.linalg
+FFT = P.fft
+
+CASES.update({
+    # --- linalg decompositions / solvers ---
+    "cholesky": Case(L.cholesky, lambda: [_pd(200)], wrt=(0,)),
+    "qr": Case(lambda a: P.abs(L.qr(a)[1]), lambda: [_r(201).randn(4, 3)],
+               wrt=(0,)),
+    "svd": Case(lambda a: L.svd(a)[1], lambda: [_r(202).randn(4, 3)],
+                wrt=(0,)),
+    "svd_reconstruct": Case(
+        lambda a: (lambda u, s, vh: u @ P.diag(s) @ vh)(*L.svd(a, full_matrices=False)),
+        lambda: [_r(203).randn(4, 3)], wrt=()),
+    "eigh": Case(lambda a: L.eigh(a)[0], lambda: [_sym(204)], wrt=(0,)),
+    "eigvalsh": Case(L.eigvalsh, lambda: [_sym(205)], wrt=(0,)),
+    "eigvals": Case(lambda a: P.sort(P.abs(L.eigvals(a))),
+                    lambda: [_sym(206)], wrt=()),
+    "lu": Case(lambda a: L.lu(a)[0], lambda: [_pd(207)], wrt=()),
+    "solve": Case(L.solve, lambda: [_pd(208), _r(209).randn(4, 2)],
+                  wrt=(0, 1)),
+    "triangular_solve": Case(
+        lambda a, b: L.triangular_solve(a, b, upper=False),
+        lambda: [np.tril(_r(210).randn(4, 4)) + 4 * np.eye(4),
+                 _r(211).randn(4, 2)], wrt=(0, 1)),
+    "cholesky_solve": Case(
+        lambda b, l: P.cholesky_solve(b, l, upper=False)
+        if hasattr(P, "cholesky_solve") else L.cholesky_solve(b, l),
+        lambda: [_r(212).randn(4, 2), np.linalg.cholesky(_pd(213))],
+        wrt=(0,)),
+    "lstsq": Case(lambda a, b: L.lstsq(a, b)[0],
+                  lambda: [_r(214).randn(5, 3), _r(215).randn(5, 2)],
+                  wrt=()),
+    "inv": Case(L.inv, lambda: [_pd(216)], wrt=(0,)),
+    "pinv": Case(L.pinv, lambda: [_r(217).randn(4, 3)], wrt=(0,)),
+    "det": Case(L.det, lambda: [_pd(218)], wrt=(0,)),
+    "slogdet": Case(lambda a: L.slogdet(a)[1], lambda: [_pd(219)],
+                    wrt=(0,)),
+    "matrix_power": Case(lambda a: L.matrix_power(a, 3),
+                         lambda: [_r(220).randn(4, 4) * 0.5], wrt=(0,)),
+    "matrix_rank": Case(lambda a: P.cast(L.matrix_rank(a), "float64"),
+                        lambda: [_pd(221)], wrt=()),
+    "cond_linalg": Case(L.cond, lambda: [_pd(222)], wrt=()),
+    "multi_dot": Case(lambda a, b, c: L.multi_dot([a, b, c]),
+                      lambda: [_r(223).randn(3, 4), _r(224).randn(4, 2),
+                               _r(225).randn(2, 5)], wrt=(0, 1, 2)),
+    "corrcoef": Case(L.corrcoef, lambda: [_r(226).randn(3, 8)], wrt=()),
+    "cov": Case(L.cov, lambda: [_r(227).randn(3, 8)], wrt=(0,)),
+    "householder_product": Case(
+        L.householder_product,
+        lambda: [_r(228).randn(4, 3), _r(229).randn(3)], wrt=(0, 1)),
+    "addmm": Case(P.addmm, lambda: [_r(230).randn(3, 5), _r(231).randn(3, 4),
+                                    _r(232).randn(4, 5)], wrt=(0, 1, 2)),
+    "inner": Case(P.inner, lambda: [_r(233).randn(3, 4), _r(234).randn(5, 4)],
+                  wrt=(0, 1)),
+    "tensordot": Case(lambda a, b: P.tensordot(a, b, axes=2),
+                      lambda: [_r(235).randn(3, 4, 5), _r(236).randn(4, 5)],
+                      wrt=(0, 1)),
+    "vander": Case(lambda x: P.vander(x, 4), lambda: [_r(237).randn(5)],
+                   wrt=(0,)),
+    # --- fft (complex kernels are c64/c128-only: low-precision legs are
+    #     recorded skips; |.| makes outputs real and the FD loss scalar) ---
+    "fft": Case(lambda x: P.abs(FFT.fft(x)), lambda: [_r(240).randn(8)],
+                wrt=(0,)),
+    "ifft": Case(lambda a, b: P.abs(FFT.ifft(P.complex(a, b))),
+                 lambda: [_r(241).randn(8), _r(242).randn(8)], wrt=(0, 1)),
+    "rfft": Case(lambda x: P.abs(FFT.rfft(x)), lambda: [_r(243).randn(8)],
+                 wrt=(0,)),
+    "irfft": Case(lambda a, b: FFT.irfft(P.complex(a, b), 8),
+                  lambda: [_r(244).randn(5), _r(245).randn(5)], wrt=(0, 1)),
+    "fft2": Case(lambda x: P.abs(FFT.fft2(x)), lambda: [_r(246).randn(4, 4)],
+                 wrt=(0,)),
+    "ifft2": Case(lambda a, b: P.abs(FFT.ifft2(P.complex(a, b))),
+                  lambda: [_r(247).randn(4, 4), _r(248).randn(4, 4)],
+                  wrt=(0, 1)),
+    "rfft2": Case(lambda x: P.abs(FFT.rfft2(x)),
+                  lambda: [_r(249).randn(4, 4)], wrt=(0,)),
+    "irfft2": Case(lambda a, b: FFT.irfft2(P.complex(a, b), s=(4, 4)),
+                   lambda: [_r(250).randn(4, 3), _r(251).randn(4, 3)],
+                   wrt=(0, 1)),
+    "hfft": Case(lambda a, b: FFT.hfft(P.complex(a, b), 8),
+                 lambda: [_r(252).randn(5), _r(253).randn(5)], wrt=(0, 1)),
+    "ihfft": Case(lambda x: P.abs(FFT.ihfft(x)), lambda: [_r(254).randn(8)],
+                  wrt=(0,)),
+    "fftshift": Case(FFT.fftshift, lambda: [_r(255).randn(8)], wrt=(0,)),
+    "ifftshift": Case(FFT.ifftshift, lambda: [_r(256).randn(8)], wrt=(0,)),
+    # --- indexing / scatter ---
+    "gather_nd": Case(
+        P.gather_nd,
+        lambda: [_r(260).randn(4, 5),
+                 np.asarray([[0, 1], [3, 4], [2, 2]], np.int64)], wrt=(0,)),
+    "scatter": Case(
+        lambda x, idx, upd: P.scatter(x, idx, upd, overwrite=False),
+        lambda: [_r(261).randn(5, 3), np.asarray([0, 2, 4], np.int64),
+                 _r(262).randn(3, 3)], wrt=(0, 2)),
+    "scatter_nd": Case(
+        lambda idx, upd: P.scatter_nd(idx, upd, [6]),
+        lambda: [np.asarray([[1], [3], [5]], np.int64),
+                 _r(263).randn(3)], wrt=(1,)),
+    "scatter_nd_add": Case(
+        P.scatter_nd_add,
+        lambda: [_r(264).randn(6), np.asarray([[1], [3], [1]], np.int64),
+                 _r(265).randn(3)], wrt=(0, 2)),
+    "put_along_axis": Case(
+        lambda x, i, v: P.put_along_axis(x, i, v, axis=1),
+        lambda: [_r(266).randn(3, 5),
+                 _r(267).randint(0, 5, (3, 2)).astype(np.int64),
+                 _r(268).randn(3, 2)], wrt=(0, 2)),
+    "take_along_axis": Case(
+        lambda x, i: P.take_along_axis(x, i, axis=1),
+        lambda: [_r(269).randn(3, 5),
+                 _r(270).randint(0, 5, (3, 2)).astype(np.int64)], wrt=(0,)),
+    "index_sample": Case(
+        P.index_sample,
+        lambda: [_r(271).randn(3, 5),
+                 _r(272).randint(0, 5, (3, 2)).astype(np.int64)], wrt=(0,)),
+    "index_add": Case(
+        lambda x, i, v: P.index_add(x, i, 0, v),
+        lambda: [_r(273).randn(5, 3), np.asarray([0, 2], np.int64),
+                 _r(274).randn(2, 3)], wrt=(0, 2)),
+    "index_put": Case(
+        lambda x, i, v: P.index_put(x, [i], v),
+        lambda: [_r(275).randn(5, 3), np.asarray([1, 3], np.int64),
+                 _r(276).randn(2, 3)], wrt=(0, 2)),
+    "index_fill": Case(
+        lambda x, i: P.index_fill(x, i, 0, 0.5),
+        lambda: [_r(277).randn(5, 3), np.asarray([1, 3], np.int64)],
+        wrt=(0,)),
+    "masked_fill": Case(
+        lambda x, m: P.masked_fill(x, m, 0.5),
+        lambda: [_r(278).randn(4, 4), _r(279).rand(4, 4) > 0.5], wrt=(0,)),
+    "masked_select": Case(
+        P.masked_select,
+        lambda: [_r(280).randn(4, 4), _r(281).rand(4, 4) > 0.5], wrt=(0,)),
+    "diagonal": Case(P.diagonal, lambda: [_r(282).randn(4, 4)], wrt=(0,)),
+    "diagflat": Case(P.diagflat, lambda: [_r(283).randn(4)], wrt=(0,)),
+    "rot90": Case(P.rot90, lambda: [_r(284).randn(3, 4)], wrt=(0,)),
+    "unbind": Case(lambda x: P.unbind(x)[1], lambda: [_r(285).randn(3, 4)],
+                   wrt=(0,)),
+    "chunk": Case(lambda x: P.chunk(x, 2, axis=1)[0],
+                  lambda: [_r(286).randn(3, 4)], wrt=(0,)),
+    "repeat_interleave": Case(
+        lambda x: P.repeat_interleave(x, 2, axis=0),
+        lambda: [_r(287).randn(3, 4)], wrt=(0,)),
+    "diff": Case(P.diff, lambda: [_r(288).randn(3, 5)], wrt=(0,)),
+    # --- stats / order ---
+    "amax": Case(lambda x: P.amax(x, axis=1), lambda: [_r(290).randn(3, 5)],
+                 wrt=(0,)),
+    "amin": Case(lambda x: P.amin(x, axis=1), lambda: [_r(291).randn(3, 5)],
+                 wrt=(0,)),
+    "nansum": Case(
+        P.nansum,
+        lambda: [np.where(_r(292).rand(3, 5) > 0.8, np.nan,
+                          _r(293).randn(3, 5))], wrt=()),
+    "nanmean": Case(
+        P.nanmean,
+        lambda: [np.where(_r(294).rand(3, 5) > 0.8, np.nan,
+                          _r(295).randn(3, 5))], wrt=()),
+    "median": Case(lambda x: P.median(x, axis=1),
+                   lambda: [_r(296).randn(3, 5)], wrt=(0,)),
+    "nanmedian": Case(lambda x: P.nanmedian(x, axis=1),
+                      lambda: [_r(297).randn(3, 5)], wrt=()),
+    "quantile": Case(lambda x: P.quantile(x, 0.3, axis=1),
+                     lambda: [_r(298).randn(3, 5)], wrt=(0,)),
+    "kthvalue": Case(lambda x: P.kthvalue(x, 2, axis=1)[0],
+                     lambda: [_r(299).randn(3, 5)], wrt=(0,)),
+    "mode": Case(lambda x: P.mode(x, axis=1)[0],
+                 lambda: [_r(300).randn(3, 5)], wrt=()),
+    "cummax": Case(lambda x: P.cummax(x, axis=1)[0],
+                   lambda: [_r(301).randn(3, 5)], wrt=(0,)),
+    "cummin": Case(lambda x: P.cummin(x, axis=1)[0],
+                   lambda: [_r(302).randn(3, 5)], wrt=(0,)),
+    "logcumsumexp": Case(lambda x: P.logcumsumexp(x, axis=1),
+                         lambda: [_r(303).randn(3, 5)], wrt=(0,)),
+    "searchsorted": Case(
+        lambda s, v: P.cast(P.searchsorted(s, v), "float64"),
+        lambda: [np.sort(_r(304).randn(8)), _r(305).randn(5)], wrt=()),
+    "bucketize": Case(
+        lambda x, s: P.cast(P.bucketize(x, s), "float64"),
+        lambda: [_r(306).randn(5), np.sort(_r(307).randn(6))], wrt=()),
+    # --- elementwise binary extras ---
+    "fmax": Case(P.fmax, lambda: [_r(310).randn(3, 4), _r(311).randn(3, 4)],
+                 wrt=(0, 1)),
+    "fmin": Case(P.fmin, lambda: [_r(312).randn(3, 4), _r(313).randn(3, 4)],
+                 wrt=(0, 1)),
+    "copysign": Case(P.copysign,
+                     lambda: [_r(314).randn(3, 4),
+                              _r(315).randn(3, 4)], wrt=(0,)),
+    "hypot": Case(P.hypot, lambda: [_r(316).randn(3, 4) + 2.0,
+                                    _r(317).randn(3, 4) + 2.0], wrt=(0, 1)),
+    "heaviside": Case(P.heaviside,
+                      lambda: [_r(318).randn(3, 4), _r(319).rand(3, 4)],
+                      wrt=()),
+    "remainder": Case(P.remainder,
+                      lambda: [_r(320).randn(3, 4) * 3,
+                               _r(321).rand(3, 4) + 1.0], wrt=(0,)),
+    "mod_floor": Case(P.floor_mod,
+                      lambda: [_r(322).randn(3, 4) * 3,
+                               _r(323).rand(3, 4) + 1.0], wrt=()),
+    "ldexp": Case(P.ldexp,
+                  lambda: [_r(324).randn(3, 4),
+                           _r(325).randint(-3, 4, (3, 4)).astype(np.int64)],
+                  wrt=(0,)),
+    "logaddexp": Case(P.logaddexp,
+                      lambda: [_r(326).randn(3, 4), _r(327).randn(3, 4)],
+                      wrt=(0, 1)),
+    "nextafter": Case(P.nextafter,
+                      lambda: [_r(328).randn(3, 4), _r(329).randn(3, 4)],
+                      wrt=()),
+    # --- special functions ---
+    "logit": Case(lambda x: P.logit(x, eps=1e-6),
+                  lambda: [_r(330).rand(3, 4) * 0.8 + 0.1], wrt=(0,)),
+    "erfinv": Case(P.erfinv, lambda: [_r(331).rand(3, 4) * 1.6 - 0.8],
+                   wrt=(0,)),
+    "lgamma": Case(P.lgamma, lambda: [_r(332).rand(3, 4) * 3 + 0.5],
+                   wrt=(0,)),
+    "digamma": Case(P.digamma, lambda: [_r(333).rand(3, 4) * 3 + 0.5],
+                    wrt=(0,)),
+    "polygamma": Case(lambda x: P.polygamma(x, 1),
+                      lambda: [_r(334).rand(3, 4) * 3 + 0.5], wrt=(0,)),
+    "i0": Case(P.i0, lambda: [_r(335).randn(3, 4)], wrt=(0,)),
+    "i0e": Case(P.i0e, lambda: [_r(336).randn(3, 4)], wrt=(0,)),
+    "i1": Case(P.i1, lambda: [_r(337).randn(3, 4)], wrt=(0,)),
+    "i1e": Case(P.i1e, lambda: [_r(338).randn(3, 4)], wrt=(0,)),
+    "stanh": Case(P.stanh, lambda: [_r(339).randn(3, 4)], wrt=(0,)),
+    "nan_to_num": Case(
+        P.nan_to_num,
+        lambda: [np.where(_r(340).rand(3, 4) > 0.8, np.nan,
+                          _r(341).randn(3, 4))], wrt=()),
+    # fractional parts pinned to [0.1, 0.9]: a value NEAR an integer would
+    # cross the trunc boundary under bf16 rounding and flip frac by ~1
+    "frac": Case(P.frac,
+                 lambda: [_r(342).randint(-3, 4, (3, 4)).astype(np.float64)
+                          + _r(343).rand(3, 4) * 0.8 + 0.1], wrt=(0,)),
+    "deg2rad": Case(P.deg2rad, lambda: [_r(345).randn(3, 4) * 90],
+                    wrt=(0,)),
+    "rad2deg": Case(P.rad2deg, lambda: [_r(344).randn(3, 4)], wrt=(0,)),
+    # --- losses ---
+    "margin_ranking_loss": Case(
+        F.margin_ranking_loss,
+        lambda: [_r(350).randn(6), _r(351).randn(6),
+                 np.sign(_r(352).randn(6))], wrt=(0, 1)),
+    "hinge_embedding_loss": Case(
+        F.hinge_embedding_loss,
+        lambda: [_r(353).randn(6), np.sign(_r(354).randn(6))], wrt=(0,)),
+    "cosine_embedding_loss": Case(
+        F.cosine_embedding_loss,
+        lambda: [_r(355).randn(4, 6), _r(356).randn(4, 6),
+                 np.sign(_r(357).randn(4))], wrt=(0, 1)),
+    "triplet_margin_loss": Case(
+        F.triplet_margin_loss,
+        lambda: [_r(358).randn(4, 6), _r(359).randn(4, 6),
+                 _r(360).randn(4, 6)], wrt=(0, 1, 2)),
+    "multi_label_soft_margin_loss": Case(
+        F.multi_label_soft_margin_loss,
+        lambda: [_r(361).randn(4, 5),
+                 (_r(362).rand(4, 5) > 0.5).astype(np.float64)], wrt=(0,)),
+    "multi_margin_loss": Case(
+        F.multi_margin_loss,
+        lambda: [_r(363).randn(4, 5),
+                 _r(364).randint(0, 5, (4,)).astype(np.int64)], wrt=(0,)),
+    "poisson_nll_loss": Case(
+        F.poisson_nll_loss,
+        lambda: [_r(365).randn(4, 5), _r(366).rand(4, 5) * 3], wrt=(0,)),
+    "gaussian_nll_loss": Case(
+        F.gaussian_nll_loss,
+        lambda: [_r(367).randn(4, 5), _r(368).randn(4, 5),
+                 _r(369).rand(4, 5) + 0.5], wrt=(0, 2)),
+    "huber_loss": Case(
+        lambda x, y: F.smooth_l1_loss(x, y, delta=1.0)
+        if not hasattr(F, "huber_loss") else F.huber_loss(x, y),
+        lambda: [_r(370).randn(4, 5), _r(371).randn(4, 5)], wrt=(0,)),
+    "soft_margin_loss": Case(
+        F.soft_margin_loss,
+        lambda: [_r(372).randn(6), np.sign(_r(373).randn(6))], wrt=(0,)),
+    "square_error_cost": Case(
+        F.square_error_cost,
+        lambda: [_r(374).randn(4, 5), _r(375).randn(4, 5)], wrt=(0,)),
+    "log_loss": Case(
+        F.log_loss,
+        lambda: [_r(376).rand(6, 1) * 0.8 + 0.1,
+                 (_r(377).rand(6, 1) > 0.5).astype(np.float64)], wrt=(0,)),
+    "sigmoid_focal_loss": Case(
+        lambda x, lab: F.sigmoid_focal_loss(x, lab, reduction="mean"),
+        lambda: [_r(378).randn(6, 1),
+                 (_r(379).rand(6, 1) > 0.5).astype(np.float64)], wrt=(0,)),
+    "dice_loss": Case(
+        lambda x, lab: F.dice_loss(x, lab),
+        lambda: [_softmax_rows(_r(380).rand(4, 3) + 0.1),
+                 _r(381).randint(0, 3, (4, 1)).astype(np.int64)], wrt=(0,)),
+    "npair_loss": Case(
+        F.npair_loss,
+        lambda: [_r(382).randn(4, 6), _r(383).randn(4, 6),
+                 _r(384).randint(0, 3, (4,)).astype(np.int64)], wrt=(0, 1)),
+    # --- nn functional extras ---
+    "celu": Case(F.celu, lambda: [_r(390).randn(3, 4)], wrt=(0,)),
+    "thresholded_relu": Case(F.thresholded_relu,
+                             lambda: [_r(391).randn(3, 4)], wrt=(0,)),
+    "hardtanh": Case(F.hardtanh, lambda: [_r(392).randn(3, 4) * 2],
+                     wrt=(0,)),
+    "log_sigmoid": Case(F.log_sigmoid, lambda: [_r(393).randn(3, 4)],
+                        wrt=(0,)),
+    "local_response_norm": Case(
+        lambda x: F.local_response_norm(x, 3),
+        lambda: [_r(394).randn(1, 4, 5, 5)], wrt=(0,)),
+    "channel_shuffle": Case(
+        lambda x: F.channel_shuffle(x, 2),
+        lambda: [_r(395).randn(1, 4, 3, 3)], wrt=(0,)),
+    "pixel_unshuffle": Case(
+        lambda x: F.pixel_unshuffle(x, 2),
+        lambda: [_r(396).randn(1, 2, 4, 4)], wrt=(0,)),
+    "unfold": Case(lambda x: F.unfold(x, 2),
+                   lambda: [_r(397).randn(1, 2, 4, 4)], wrt=(0,)),
+    "fold": Case(lambda x: F.fold(x, [4, 4], 2),
+                 lambda: [_r(398).randn(1, 8, 9)], wrt=(0,)),
+    "grid_sample": Case(
+        F.grid_sample,
+        lambda: [_r(399).randn(1, 2, 4, 4),
+                 (_r(400).rand(1, 3, 3, 2) * 1.6 - 0.8)], wrt=(0, 1)),
+    "affine_grid": Case(
+        lambda t: F.affine_grid(t, [1, 2, 4, 4]),
+        lambda: [_r(401).randn(1, 2, 3) * 0.5], wrt=(0,)),
+    "pairwise_distance": Case(
+        F.pairwise_distance,
+        lambda: [_r(402).randn(4, 6), _r(403).randn(4, 6)], wrt=(0, 1)),
+})
+
+
+def _softmax_rows(a):
+    e = np.exp(a - a.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
 # Enumerated-but-not-swept ops: every entry must say where the op IS tested.
 NOT_SWEPT = {
     "shard_constraint": "sharding annotation, identity numerics "
@@ -481,5 +833,6 @@ def test_top_ops_covered():
 
 
 def test_battery_size():
-    """The battery must stay at top-100 scale (VERDICT r3 item 4)."""
-    assert len(CASES) >= 100, len(CASES)
+    """The battery must stay at 250-op scale (VERDICT r3 item 4 set the
+    top-100 floor; r4 item 4 raised it to the long tail)."""
+    assert len(CASES) >= 250, len(CASES)

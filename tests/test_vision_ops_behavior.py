@@ -126,3 +126,61 @@ class TestSparseGuard:
             S.sparse_coo_tensor(
                 np.array([[0, 1], [0, 1]]), np.array([1.0, 2.0]),
                 shape=[20, 20])
+
+
+def _roi_pool_numpy_ref(x, boxes, box_batch, oh, ow, spatial_scale):
+    """Line-for-line numpy port of the reference CPU kernel's semantics
+    (roi_pool_kernel.cc:100-150): rounded box, forced 1x1 minimum,
+    floor/ceil integer bins, exact pixel max, empty bin -> 0."""
+    import math
+
+    n, (C, H, W) = len(boxes), x.shape[1:]
+    out = np.zeros((n, C, oh, ow), x.dtype)
+    rnd = lambda v: math.floor(v + 0.5) if v >= 0 else math.ceil(v - 0.5)
+    for i, (bx, img) in enumerate(zip(boxes, box_batch)):
+        x1, y1 = rnd(bx[0] * spatial_scale), rnd(bx[1] * spatial_scale)
+        x2, y2 = rnd(bx[2] * spatial_scale), rnd(bx[3] * spatial_scale)
+        bh, bw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        for ph in range(oh):
+            for pw in range(ow):
+                hs = min(max(int(math.floor(ph * bh / oh)) + y1, 0), H)
+                he = min(max(int(math.ceil((ph + 1) * bh / oh)) + y1, 0), H)
+                ws = min(max(int(math.floor(pw * bw / ow)) + x1, 0), W)
+                we = min(max(int(math.ceil((pw + 1) * bw / ow)) + x1, 0), W)
+                if he <= hs or we <= ws:
+                    continue  # empty bin stays 0
+                out[i, :, ph, pw] = x[img, :, hs:he, ws:we].max(axis=(1, 2))
+    return out
+
+
+class TestRoIPoolExact:
+    """roi_pool matches the reference quantized-bin kernel exactly
+    (VERDICT r4 item 8; divergence note deleted from vision/ops.py)."""
+
+    def test_integer_grid_and_fractional_boxes(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 12, 14).astype(np.float32)
+        boxes = np.array([[0, 0, 11, 11],      # full-ish box
+                          [2, 3, 7, 9],        # interior integer box
+                          [5, 5, 5, 5],        # degenerate 1x1
+                          [1.4, 2.6, 10.2, 8.7],  # fractional corners
+                          [3, 1, 13, 11]], np.float32)
+        boxes_num = np.array([3, 2], np.int32)
+        for scale in (1.0, 0.5):
+            got = roi_pool(P.to_tensor(x), P.to_tensor(boxes),
+                           P.to_tensor(boxes_num), output_size=3,
+                           spatial_scale=scale).numpy()
+            ref = _roi_pool_numpy_ref(x, boxes, [0, 0, 0, 1, 1], 3, 3, scale)
+            np.testing.assert_allclose(got, ref, rtol=1e-6, atol=0)
+
+    def test_empty_bin_yields_zero(self):
+        """A box hanging past the image edge gets its outer bins clamped to
+        zero extent; the reference defines those as 0 (not -inf)."""
+        x = np.full((1, 1, 8, 8), 7.0, np.float32)
+        boxes = np.array([[6, 6, 12, 12]], np.float32)  # spills past 8x8
+        out = roi_pool(P.to_tensor(x), P.to_tensor(boxes),
+                       P.to_tensor(np.array([1], np.int32)),
+                       output_size=4).numpy()
+        ref = _roi_pool_numpy_ref(x, boxes, [0], 4, 4, 1.0)
+        np.testing.assert_allclose(out, ref)
+        assert (ref == 0).any(), "case must actually contain empty bins"

@@ -1,0 +1,158 @@
+"""Compiled-ZeRO memory proof (VERDICT r4 item 2).
+
+The eager ZeRO stages pay full-model transients per step (documented PERF
+NOTE, sharding_optimizer.py); the COMPILED path claims to avoid them by
+construction.  These tests make that claim measurable: XLA buffer-assignment
+stats (CompiledMemoryStats, per device) of the exact compiled train step
+must show
+
+  1. per-device argument bytes tracking  params + opt_state/shard_degree
+     (stage 1, dp alias) and  (params + opt_state)/shard_degree  (stage 3,
+     explicit 'sharding' axis) at fixed per-device batch, dp in {1, 2, 4};
+  2. NO full-size optimizer-state transient: temp bytes do not grow with
+     shard degree (a gather-update-scatter implementation would add the
+     full unsharded state to temps at dp > 1).
+
+Reference analog: group_sharded_stage3.py:59 claims the same 1/shard-degree
+scaling for its GPU param/state sharding; here the compiler's buffer
+assignment is the witness, not the wrapper.
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+def _local_bytes(leaf) -> int:
+    """Per-device bytes of one (possibly sharded) jax array."""
+    local = leaf.sharding.shard_shape(leaf.shape)
+    return int(np.prod(local)) * leaf.dtype.itemsize
+
+
+def _tree_local_bytes(tree) -> int:
+    return sum(_local_bytes(l) for l in jax.tree_util.tree_leaves(tree)
+               if hasattr(l, "sharding"))
+
+
+def _hybrid_point(dp: int):
+    """Build the llama hybrid step on a dp-only mesh with ZeRO stage-1 and
+    return (stats, analytic per-device arg estimate, global opt bytes)."""
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding_optimizer import (
+        DygraphShardingOptimizer,
+    )
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   build_hybrid_train_step)
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.init_mesh({"dp": dp}, devices=jax.devices()[:dp])
+    P.seed(0)
+    cfg = LlamaConfig.tiny(vocab=256, hidden=128, layers=2, heads=4,
+                           inter=256)
+    model = LlamaForCausalLM(cfg)
+    opt = P.optimizer.AdamW(learning_rate=1e-3,
+                            parameters=model.parameters())
+    opt = DygraphShardingOptimizer(opt)  # stage 1 over the dp alias
+    step = build_hybrid_train_step(model, opt, n_microbatches=1, remat=False)
+
+    B = 2 * dp  # fixed per-device batch of 2
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (B, 17))
+    batch = {"input_ids": P.to_tensor(ids[:, :-1]),
+             "labels": P.to_tensor(ids[:, 1:])}
+    stats = step.memory_stats(batch)
+
+    params_local = _tree_local_bytes(step.state["params"])
+    opt_local = _tree_local_bytes(step.state["opt"])
+    opt_global = sum(l.nbytes for l in
+                     jax.tree_util.tree_leaves(step.state["opt"])
+                     if hasattr(l, "nbytes"))
+    batch_local = sum(v.numpy().nbytes for v in batch.values()) // dp
+    expected_args = params_local + opt_local + batch_local
+    mesh_mod.clear_mesh() if hasattr(mesh_mod, "clear_mesh") else None
+    return stats, expected_args, opt_global, opt_local
+
+
+@pytest.mark.parametrize("dp", [1, 2, 4])
+def test_hybrid_stage1_args_match_buffer_assignment(dp):
+    """XLA's per-device argument bytes == params + state/dp + batch (±12%):
+    the state REALLY arrives sharded, it is not re-assembled at the jit
+    boundary."""
+    stats, expected, _, _ = _hybrid_point(dp)
+    meas = stats.argument_size_in_bytes
+    assert abs(meas - expected) / expected < 0.12, (
+        f"dp={dp}: measured arg bytes {meas} vs analytic {expected}")
+
+
+def test_hybrid_stage1_state_share_scales_inverse_dp():
+    """The optimizer-state share of per-device argument bytes scales ~1/dp,
+    and temps carry no full-size state transient as dp grows."""
+    points = {dp: _hybrid_point(dp) for dp in (1, 2, 4)}
+    # per-device state bytes measured from the live sharded pytree
+    s1 = points[1][3]
+    for dp in (2, 4):
+        s = points[dp][3]
+        assert abs(s - s1 / dp) / (s1 / dp) < 0.15, (
+            f"state bytes at dp={dp}: {s}, want ~{s1 / dp}")
+    # buffer-assignment args shrink by at least 60% of the analytic saving
+    for dp in (2, 4):
+        saved_analytic = s1 - points[dp][3]
+        saved_meas = (points[1][0].argument_size_in_bytes
+                      - points[dp][0].argument_size_in_bytes)
+        assert saved_meas > 0.6 * saved_analytic, (
+            f"dp={dp}: args saved {saved_meas} < 60% of analytic "
+            f"{saved_analytic}")
+    # no full-size state transient: a gather-update-scatter implementation
+    # would add the gathered state (s1 - s1/dp bytes) to temps at dp > 1;
+    # actual growth must stay well below that (what does grow is collective
+    # scratch for the dp grad all-reduce, ~100s of KB here)
+    t1 = points[1][0].temp_size_in_bytes
+    for dp in (2, 4):
+        t = points[dp][0].temp_size_in_bytes
+        gathered = s1 - points[dp][3]
+        assert t - t1 < 0.5 * gathered, (
+            f"dp={dp}: temp bytes grew {t - t1} — at least half a gathered "
+            f"full-size state transient ({gathered}B) materialized")
+
+
+def test_stage3_explicit_sharding_axis_scales_params_and_state():
+    """Stage 3 (FSDP) over an EXPLICIT 'sharding' mesh axis (not the dp
+    alias): params AND optimizer states arrive 1/n-sharded per device."""
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding_optimizer import (
+        GroupShardedStage3,
+    )
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.parallel.trainer import compile_train_step
+
+    def build(n):
+        mesh_mod.init_mesh({"sharding": n}, devices=jax.devices()[:n])
+        P.seed(0)
+        model = P.nn.Sequential(
+            P.nn.Linear(256, 512), P.nn.ReLU(), P.nn.Linear(512, 256))
+        opt = P.optimizer.AdamW(learning_rate=1e-3,
+                                parameters=model.parameters())
+        model = GroupShardedStage3(model, opt)
+
+        def loss_fn(m, b):
+            return P.mean((m(b["x"]) - b["y"]) ** 2)
+
+        step = compile_train_step(model, loss_fn, opt,
+                                  batch_spec=("sharding",))
+        rng = np.random.RandomState(0)
+        B = 4 * n  # fixed per-device batch
+        batch = {"x": P.to_tensor(rng.randn(B, 256).astype("f")),
+                 "y": P.to_tensor(rng.randn(B, 256).astype("f"))}
+        stats = step.memory_stats(batch)
+        params_local = sum(_local_bytes(p._value) for p in step._params)
+        state_local = _tree_local_bytes(step._opt_state)
+        return stats, params_local, state_local
+
+    s1, p1, st1 = build(1)
+    s4, p4, st4 = build(4)
+    # params and states each shard ~1/4 per device (biases may stay whole)
+    assert p4 < 0.30 * p1, f"stage-3 params/device {p4} vs {p1} at n=1"
+    assert st4 < 0.30 * st1, f"stage-3 state/device {st4} vs {st1} at n=1"
+    # and the compiled argument buffers agree with the pytree accounting
+    shrink = (s1.argument_size_in_bytes - s4.argument_size_in_bytes)
+    assert shrink > 0.6 * ((p1 - p4) + (st1 - st4)), (
+        f"buffer-assignment args shrank {shrink}, want >60% of analytic "
+        f"{(p1 - p4) + (st1 - st4)}")

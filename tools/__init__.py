@@ -1,0 +1,1 @@
+"""Dev tools (benchmarks, coverage, golden generators)."""
