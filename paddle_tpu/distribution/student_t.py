@@ -34,8 +34,11 @@ class StudentT(Distribution):
     def rsample(self, shape=()):
         key = self._key()
         out_shape = self._extend_shape(shape)
+        # jax.random.t defaults to shape=() — without an explicit shape=,
+        # scalar params broadcast df UP to out_shape and then fail the
+        # result-must-equal-shape check. Pass shape= and let df broadcast.
         return _wrap(
-            lambda d, l, s: l + s * jax.random.t(key, jnp.broadcast_to(d, out_shape)),
+            lambda d, l, s: l + s * jax.random.t(key, d, shape=out_shape),
             self.df, self.loc, self.scale, op_name="studentt_rsample")
 
     def log_prob(self, value):

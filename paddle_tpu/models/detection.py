@@ -120,6 +120,6 @@ class YOLOv3(nn.Layer):
             keep = np.asarray(vops.nms(
                 Tensor(bx), nms_thresh, scores=Tensor(sc),
                 category_idxs=Tensor(ci), top_k=top_k).numpy())
-            results.append([(int(ci[k]), float(sc[k]), *bx[k].tolist())
+            results.append([(int(ci[k]), float(sc[k]), *bx[k].tolist())  # staticcheck: ok[host-sync] — NMS postprocess returns python lists by contract
                             for k in keep])
         return results

@@ -63,7 +63,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """Inputs [batch, seq, heads, head_dim] as in the reference flash-attn API."""
     def f(q, k, v, *m):
         mask = m[0] if m else None
-        if mask is None and _use_pallas(q):
+        if mask is None and _use_pallas(q):  # staticcheck: ok[tracer-branch] — _use_pallas reads backend + q.dtype only (static under trace)
             from ...ops.pallas.flash_attention import flash_attention as fa
             return fa(q, k, v, is_causal, scale)
         return _sdpa_ref(q, k, v, mask, dropout_p, is_causal, scale)

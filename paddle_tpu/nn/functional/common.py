@@ -284,7 +284,7 @@ def bilinear(x1, x2, weight, bias=None):
 
 def class_center_sample(label, num_classes, num_samples, group=None):
     # rarely used (face recognition); host-side implementation
-    lab = np.asarray(label._value if isinstance(label, Tensor) else label)
+    lab = np.asarray(label._value if isinstance(label, Tensor) else label)  # staticcheck: ok[host-sync] — documented host-side op (sampling over unique labels)
     pos = np.unique(lab)
     if pos.size >= num_samples:
         sampled = pos

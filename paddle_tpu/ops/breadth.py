@@ -100,13 +100,14 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1):
 @_export
 def combinations(x, r=2, with_replacement=False, name=None):
     import itertools
-    def f(v):
-        n = v.shape[0]
-        it = itertools.combinations_with_replacement(range(n), r) \
-            if with_replacement else itertools.combinations(range(n), r)
-        idx = np.asarray(list(it), np.int32).reshape(-1, r)
-        return v[idx]
-    return apply(f, x, op_name="combinations")
+    # index construction is host work over the STATIC length — build it
+    # once here, not inside the traced function (where every retrace would
+    # re-materialize the full index list on host)
+    n = int(x.shape[0])
+    it = itertools.combinations_with_replacement(range(n), r) \
+        if with_replacement else itertools.combinations(range(n), r)
+    idx = jnp.asarray(np.asarray(list(it), np.int32).reshape(-1, r))
+    return apply(lambda v: v[idx], x, op_name="combinations")
 
 
 @_export
@@ -231,9 +232,11 @@ def sigmoid(x, name=None):
 
 @_export
 def pdist(x, p=2.0, name=None):
+    # pair indices depend only on the static row count — hoist them out of
+    # the traced function so the gather uses device-resident indices
+    i, j = (jnp.asarray(a) for a in np.triu_indices(int(x.shape[0]), k=1))
+
     def f(v):
-        n = v.shape[0]
-        i, j = np.triu_indices(n, k=1)
         d = v[i] - v[j]
         if p == 2.0:
             return jnp.sqrt(jnp.sum(d * d, axis=-1))

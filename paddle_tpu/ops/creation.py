@@ -68,7 +68,10 @@ def ones(shape, dtype=None):
 
 def full(shape, fill_value, dtype=None):
     if isinstance(fill_value, Tensor):
-        fill_value = fill_value.item()
+        # keep the fill on device: jnp.full broadcasts an array fill_value,
+        # so a traced fill stays traceable (.item() here forced a host
+        # round-trip and broke under jit)
+        fill_value = fill_value._value
     dt = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
     return Tensor(jnp.full(_norm_shape(shape), fill_value, dt))
 
@@ -237,7 +240,7 @@ def array_length(array):
 
 def _idx_of(i):
     if isinstance(i, Tensor):
-        return int(np.asarray(i._value))
+        return int(np.asarray(i._value))  # staticcheck: ok[host-sync] — TensorArray is a python list; its index must be concrete
     return int(i)
 
 

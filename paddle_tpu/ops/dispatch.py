@@ -131,7 +131,7 @@ def _scan_nan_inf(out, multi, name):
             continue
         if not jnp.issubdtype(o._value.dtype, jnp.floating):
             continue
-        bad = int(jnp.size(o._value)) - int(jnp.sum(jnp.isfinite(o._value)))
+        bad = int(jnp.size(o._value)) - int(jnp.sum(jnp.isfinite(o._value)))  # staticcheck: ok[host-sync] — FLAGS_check_nan_inf debug scan reads values by design
         if bad:
             raise FloatingPointError(
                 f"Operator {name!r} produced {bad} nan/inf element(s) "

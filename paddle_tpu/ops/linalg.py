@@ -104,7 +104,7 @@ def svd(x, full_matrices=False):
 
 def eig(x):
     # CPU-only in jax; evaluate on host
-    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)  # staticcheck: ok[host-sync] — XLA has no general eig; np fallback by design
     w, vec = np.linalg.eig(v)
     return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
 

@@ -292,15 +292,20 @@ def masked_select(x, mask):
     # itself runs through apply() so the op is DIFFERENTIABLE (reference
     # masked_select_grad scatters the cotangent back into the mask
     # positions — a gather's vjp does exactly that).
-    m = np.asarray(_u(mask)).astype(bool).reshape(-1)
+    m = np.asarray(_u(mask)).astype(bool).reshape(-1)  # staticcheck: ok[host-sync] — dynamic output shape, eager-only by contract
     idx = jnp.asarray(np.nonzero(m)[0])
     return apply(lambda v: v.reshape(-1)[idx], x, op_name="masked_select")
 
 
 @_export
 def masked_fill(x, mask, value):
-    val = _u(value) if isinstance(value, Tensor) else value
-    return apply(lambda v, m: jnp.where(m.astype(bool), jnp.asarray(val, v.dtype), v),
+    if isinstance(value, Tensor):
+        # pass the fill through apply, not a closure: a closed-over payload
+        # bypasses the tape (no grad w.r.t. value) and AMP casting
+        return apply(
+            lambda v, m, val: jnp.where(m.astype(bool), val.astype(v.dtype), v),
+            x, mask, value, op_name="masked_fill")
+    return apply(lambda v, m: jnp.where(m.astype(bool), jnp.asarray(value, v.dtype), v),
                  x, mask, op_name="masked_fill")
 
 
@@ -314,7 +319,7 @@ def where(condition, x=None, y=None):
 
 @_export
 def nonzero(x, as_tuple=False):
-    v = np.asarray(_u(x))
+    v = np.asarray(_u(x))  # staticcheck: ok[host-sync] — nonzero: dynamic output shape, eager-only by contract
     idx = np.nonzero(v)
     if as_tuple:
         return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in idx)
@@ -399,7 +404,7 @@ def mode(x, axis=-1, keepdim=False):
 
 @_export
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
-    v = np.asarray(_u(x))
+    v = np.asarray(_u(x))  # staticcheck: ok[host-sync] — unique: dynamic output shape, np-backed eager op
     res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
                     return_counts=return_counts, axis=axis)
     if not isinstance(res, tuple):
@@ -409,7 +414,7 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
 
 @_export
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
-    v = np.asarray(_u(x)).ravel() if axis is None else np.asarray(_u(x))
+    v = np.asarray(_u(x)).ravel() if axis is None else np.asarray(_u(x))  # staticcheck: ok[host-sync] — unique_consecutive: dynamic output shape, np-backed eager op
     if axis is not None:
         raise NotImplementedError("unique_consecutive with axis")
     keep = np.ones(v.shape[0], bool)
@@ -512,13 +517,13 @@ def bincount(x, weights=None, minlength=0):
     if weights is not None:
         return apply(lambda v, w: jnp.bincount(v.astype(jnp.int32), w, minlength=minlength,
                                                length=None), x, weights, op_name="bincount")
-    v = np.asarray(_u(x))
+    v = np.asarray(_u(x))  # staticcheck: ok[host-sync] — bincount fallback: output length is value-dependent
     return Tensor(jnp.asarray(np.bincount(v, minlength=minlength)))
 
 
 @_export
 def histogram(input, bins=100, min=0, max=0, name=None):
-    v = np.asarray(_u(input))
+    v = np.asarray(_u(input))  # staticcheck: ok[host-sync] — histogram: np-backed eager op (bin edges on host)
     rng = None if (min == 0 and max == 0) else (min, max)
     hist, _ = np.histogram(v, bins=bins, range=rng)
     return Tensor(jnp.asarray(hist.astype(np.int64)))
@@ -714,7 +719,7 @@ def polar(abs, angle):
 
 def tolist(x):
     import numpy as _np
-    return _np.asarray(x._value if isinstance(x, Tensor) else x).tolist()
+    return _np.asarray(x._value if isinstance(x, Tensor) else x).tolist()  # staticcheck: ok[host-sync] — tolist() IS the explicit host-conversion API
 _export(tolist)
 
 

@@ -98,6 +98,23 @@ def _kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems, *,
     o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
 
 
+def _ragged_ref(q, k_cache, v_cache, lengths, s):
+    """jnp reference of the kernel's math (full-S_max masked softmax)."""
+    B, _, H, D = q.shape
+    Hkv, S = k_cache.shape[2], k_cache.shape[1]
+    qg = q.reshape(B, Hkv, H // Hkv, D)
+    scores = jnp.einsum("bhgd,bshd->bhgs", qg.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * s
+    valid = jnp.arange(S, dtype=jnp.int32)[None, :] < lengths[:, None]
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgs,bshd->bhgd", p, v_cache.astype(jnp.float32))
+    # kernel parity for lengths[b] == 0: its chunk loop runs zero times and
+    # returns zeros, while softmax over an all-masked row would go uniform
+    out = jnp.where(lengths[:, None, None, None] > 0, out, 0.0)
+    return out.reshape(B, 1, H, D).astype(q.dtype)
+
+
 def ragged_decode_attention(q, k_cache, v_cache, lengths, scale=None):
     """q: [B, 1, H, D]; k_cache/v_cache: [B, S_max, H_kv, D]; lengths: [B]
     int32 (positions j < lengths[b] are attended). Returns [B, 1, H, D]."""
@@ -106,6 +123,15 @@ def ragged_decode_attention(q, k_cache, v_cache, lengths, scale=None):
     Hkv, S_max = k_cache.shape[2], k_cache.shape[1]
     group = H // Hkv
     s = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+
+    if _interpret() and isinstance(q, jax.core.Tracer):
+        # Interpret-mode pallas in this jax can't LOWER inside an enclosing
+        # x64 trace (its grid loop mixes i32/i64 in a stablehlo div: the
+        # _x32 window only covers tracing here — an outer jit defers
+        # lowering past it). Eager interpret calls still run the kernel
+        # (that's what the kernel unit tests exercise); traced CPU callers
+        # (the jitted generate decode loop) get the same math via jnp.
+        return _ragged_ref(q, k_cache, v_cache, lengths, s)
 
     # [B, Hkv, group, D], group padded to the fp32 sublane minimum
     gp = max(8, group)

@@ -22,6 +22,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running battery (tier-1 excludes these via -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as P

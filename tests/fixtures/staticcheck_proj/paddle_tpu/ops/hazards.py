@@ -1,0 +1,72 @@
+"""Purpose-built staticcheck violations (test fixture — never imported).
+
+Mirrors the real tree's layout (paddle_tpu/ops/) so path-gated rules
+(host-sync) and the registry cross-check fire exactly as they do on the
+shipped code. Each function below is one known-answer violation asserted
+by tests/test_staticcheck.py.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .dispatch import apply  # AST-only fixture: import never executes
+
+__all__ = ["branchy", "numpy_feeder", "syncy", "ghost_export"]
+
+
+def branchy(x):
+    def f(v):
+        if v > 0:  # tracer-branch: value-dependent Python branch
+            return v
+        return -v
+    return apply(f, x, op_name="covered_op")
+
+
+def metadata_branch_ok(x):
+    def f(v):
+        if v.ndim == 2:  # static metadata: must NOT be flagged
+            return v
+        return v[None]
+    return apply(f, x, op_name="covered_op")
+
+
+def numpy_feeder(x):
+    # numpy-on-tracer: np.* fed the traced param
+    return apply(lambda v: jnp.asarray(np.cumsum(v)), x,
+                 op_name="toleranced_op")
+
+
+def numpy_static_ok(x):
+    def f(v):
+        idx = np.arange(int(v.shape[0]))  # static-shape numpy: not flagged
+        return v[idx]
+    return apply(f, x, op_name="covered_op")
+
+
+def syncy(x):
+    n = int(x._value)  # host-sync: int() over the payload
+    return x.item(), n  # host-sync: .item()
+
+
+def orphan(x):
+    # registry-consistency: no tolerance entry, no coverage record
+    return apply(jnp.tanh, x, op_name="fixture_orphan_op")
+
+
+def suppressed(x):
+    def f(v):
+        if v > 0:  # staticcheck: ok[tracer-branch] — fixture: pragma-suppressed on purpose
+            return v
+        return -v
+    return apply(f, x, op_name="covered_op")
+
+
+def suppressed_all(x):
+    return x.item()  # staticcheck: ok — bare pragma suppresses every rule
+
+
+def wrong_pragma(x):
+    def f(v):
+        if v > 0:  # staticcheck: ok[host-sync] — wrong rule id: must still be reported
+            return v
+        return -v
+    return apply(f, x, op_name="covered_op")

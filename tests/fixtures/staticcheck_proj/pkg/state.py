@@ -1,0 +1,24 @@
+"""Fixture: mutable-global violations and the sanctioned installer path."""
+
+_CACHE = {}
+_handler = None
+
+
+def set_handler(fn):
+    global _handler  # installer-shaped name: sanctioned, not flagged
+    _handler = fn
+
+
+def sneaky_write(fn):
+    global _handler  # mutable-global: rebind outside an installer
+    _handler = fn
+
+
+def memoize(key, value):
+    _CACHE[key] = value  # mutable-global: container mutated outside installer
+
+
+def local_shadow_ok(key, value):
+    _CACHE = {}  # local rebind shadows the module global: not flagged
+    _CACHE[key] = value
+    return _CACHE

@@ -1,0 +1,10 @@
+"""Fixture tolerance registry for the registry-consistency cross-check."""
+
+FWD_OVERRIDES = {
+    "toleranced_op": {"bfloat16": (1e-1, 1e-2)},
+    "stale_op": {"float16": (1e-2, 1e-3)},  # no dispatch site: stale
+}
+
+GRAD_OVERRIDES = {}
+
+SKIPS = {}

@@ -1,0 +1,37 @@
+"""OP_COVERAGE.json drift gate.
+
+The staticcheck registry-consistency rule and the dtype-sweep battery's
+top-op requirement are both pinned to the checked-in OP_COVERAGE.json; if
+the enumeration drifts from the file, those gates silently govern a stale
+op set. Regenerates the enumeration (tools/op_coverage.py drives real
+eager train/infer steps — minutes of work, hence `slow`; tier-1 excludes
+it) and asserts exact equality.
+
+On failure: `python tools/op_coverage.py` refreshes the file — commit it
+together with whatever changed the op mix.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_op_coverage_json_matches_fresh_enumeration(tmp_path):
+    out = str(tmp_path / "fresh.json")
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "op_coverage.py"),
+         "-o", out],
+        cwd=REPO, check=True, timeout=900,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    with open(os.path.join(REPO, "OP_COVERAGE.json")) as f:
+        checked_in = json.load(f)
+    with open(out) as f:
+        fresh = json.load(f)
+    assert checked_in == fresh, (
+        "OP_COVERAGE.json is stale — rerun `python tools/op_coverage.py` "
+        "and commit the result")

@@ -1,0 +1,215 @@
+"""graftcheck (tools/staticcheck) — framework tests + the tier-1 CI gate.
+
+Three layers:
+1. known-answer fixtures (tests/fixtures/staticcheck_proj) asserting every
+   rule fires where expected, negatives stay quiet, and pragmas suppress;
+2. baseline-ratchet semantics (only NEW findings fail; per-key counts);
+3. the real gate: the shipped tree must be clean against the checked-in
+   tools/staticcheck/baseline.json — the same check `python -m
+   tools.staticcheck --ci` runs, executed in-process as part of tier-1.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "staticcheck_proj")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.staticcheck import (  # noqa: E402
+    load_baseline, new_findings, run, save_baseline)
+from tools.staticcheck.baseline import DEFAULT_BASELINE  # noqa: E402
+
+FIXTURE_PATHS = ("paddle_tpu", "pkg")
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return run(FIXTURE, paths=FIXTURE_PATHS)
+
+
+@pytest.fixture(scope="module")
+def repo_findings():
+    # shared: a full-repo scan is the most expensive call in this module
+    return run(REPO)
+
+
+# ---------------- rule detection on known-answer fixtures ----------------
+
+def test_all_rules_fire_on_fixtures(fixture_findings):
+    rules = {f.rule for f in fixture_findings}
+    assert rules >= {"tracer-branch", "numpy-on-tracer", "host-sync",
+                     "registry-consistency", "mutable-global",
+                     "dead-export"}, rules
+    assert len(rules) >= 5  # the acceptance floor, trivially exceeded
+
+
+def test_findings_carry_location_and_severity(fixture_findings):
+    by_rule = {}
+    for f in fixture_findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    tb = by_rule["tracer-branch"]
+    assert all(f.path == "paddle_tpu/ops/hazards.py" for f in tb)
+    assert all(f.severity == "error" and f.line > 0 for f in tb)
+    # branchy() and wrong_pragma() — the pragma'd copy must NOT be here
+    assert len(tb) == 2
+    hs = by_rule["host-sync"]
+    assert {f.line for f in hs} == {46, 47}  # syncy(): int(_value), .item()
+    assert all(f.severity == "warning" for f in hs)
+
+
+def test_registry_cross_check_both_directions(fixture_findings):
+    rc = [f for f in fixture_findings if f.rule == "registry-consistency"]
+    assert {f.context for f in rc} == {"fixture_orphan_op", "stale_op"}
+    orphan = next(f for f in rc if f.context == "fixture_orphan_op")
+    assert orphan.path == "paddle_tpu/ops/hazards.py"  # at the dispatch site
+    stale = next(f for f in rc if f.context == "stale_op")
+    assert stale.path == "tests/op_tolerances.py"      # at the registry
+
+
+def test_static_metadata_and_static_numpy_not_flagged(fixture_findings):
+    # metadata_branch_ok (v.ndim branch) and numpy_static_ok (np.arange on a
+    # static shape) are hazard-free idioms the heuristics must not flag
+    for f in fixture_findings:
+        assert not (24 <= f.line <= 29), f      # metadata_branch_ok body
+        assert not (38 <= f.line <= 42), f      # numpy_static_ok body
+
+
+def test_mutable_global_installer_sanctioned(fixture_findings):
+    mg = [f for f in fixture_findings if f.rule == "mutable-global"]
+    assert {f.line for f in mg} == {13, 18}  # sneaky_write + memoize
+    # set_handler (line 8) and local_shadow_ok (line 22) stay quiet
+
+
+def test_dead_export_detected(fixture_findings):
+    de = [f for f in fixture_findings if f.rule == "dead-export"]
+    assert [f.context for f in de] == ["ghost_export"]
+
+
+# ---------------- pragma suppression ----------------
+
+def test_pragma_suppresses_named_rule(fixture_findings):
+    # hazards.suppressed() has the identical violation as branchy() plus a
+    # `# staticcheck: ok[tracer-branch]` pragma: line 57 must be absent
+    assert not any(f.line == 57 for f in fixture_findings
+                   if f.path.endswith("hazards.py"))
+
+
+def test_bare_pragma_suppresses_all_rules(fixture_findings):
+    # suppressed_all(): `.item()` + bare `# staticcheck: ok` (line 64)
+    assert not any(f.line == 64 for f in fixture_findings
+                   if f.path.endswith("hazards.py"))
+
+
+def test_pragma_for_other_rule_does_not_suppress(fixture_findings):
+    # wrong_pragma(): tracer-branch violation pragma'd as ok[host-sync]
+    assert any(f.line == 69 and f.rule == "tracer-branch"
+               for f in fixture_findings)
+
+
+# ---------------- baseline ratchet ----------------
+
+def test_baseline_ratchet_only_new_fail(fixture_findings, tmp_path):
+    bl = str(tmp_path / "baseline.json")
+    grandfathered = fixture_findings[:-2]
+    save_baseline(grandfathered, bl)
+    fresh = new_findings(fixture_findings, load_baseline(bl))
+    assert fresh == fixture_findings[-2:]
+    # full baseline -> nothing new
+    save_baseline(fixture_findings, bl)
+    assert new_findings(fixture_findings, load_baseline(bl)) == []
+
+
+def test_baseline_counts_duplicate_keys(fixture_findings, tmp_path):
+    bl = str(tmp_path / "baseline.json")
+    save_baseline(fixture_findings, bl)
+    # a second occurrence of an already-baselined key is still NEW
+    dup = fixture_findings + [fixture_findings[0]]
+    fresh = new_findings(dup, load_baseline(bl))
+    assert fresh == [fixture_findings[0]]
+
+
+def test_scoped_baseline_update_merges(fixture_findings, tmp_path):
+    """`--update-baseline <paths>` over a partial scan must not drop
+    grandfathered entries outside those paths."""
+    bl = str(tmp_path / "baseline.json")
+    save_baseline(fixture_findings, bl)  # full: paddle_tpu + pkg + registry
+    pkg_only = [f for f in fixture_findings if f.path.startswith("pkg/")]
+    other = [f for f in fixture_findings if not f.path.startswith("pkg/")]
+    # scoped rewrite of pkg/ with one finding fixed
+    save_baseline(pkg_only[:-1], bl, scanned_paths=["pkg"])
+    merged = load_baseline(bl)
+    assert pkg_only[-1].key not in merged          # fixed entry pruned
+    assert all(f.key in merged for f in pkg_only[:-1])
+    assert all(f.key in merged for f in other)     # untouched paths survive
+
+
+def test_baseline_keys_survive_line_drift(fixture_findings):
+    # keys use (rule, path, context), never the line number
+    assert all(str(f.line) not in f.key.split("::")[0] for f in fixture_findings)
+    f0 = fixture_findings[0]
+    assert f0.key.startswith(f"{f0.rule}::{f0.path}::")
+
+
+# ---------------- the CLI ----------------
+
+def test_cli_ci_gate_exit_codes(tmp_path):
+    bl = str(tmp_path / "bl.json")
+    base_cmd = [sys.executable, "-m", "tools.staticcheck",
+                "--root", FIXTURE, *FIXTURE_PATHS, "--baseline", bl]
+    # no baseline yet: every finding is new -> nonzero
+    r = subprocess.run(base_cmd + ["--ci"], cwd=REPO, capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "NEW violation" in r.stderr
+    # ratchet the current state, then the gate is clean
+    r = subprocess.run(base_cmd + ["--update-baseline"], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(base_cmd + ["--ci"], cwd=REPO, capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_cli_json_report(tmp_path):
+    import json
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.staticcheck", "--root", FIXTURE,
+         *FIXTURE_PATHS, "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    payload = json.loads(r.stdout)
+    assert payload["total"] == len(payload["findings"]) > 0
+    assert {"rule", "severity", "path", "line", "message"} <= \
+        set(payload["findings"][0])
+
+
+# ---------------- the real tier-1 gate ----------------
+
+def test_repo_is_clean_against_checked_in_baseline(repo_findings):
+    """The in-process equivalent of `python -m tools.staticcheck --ci`:
+    the shipped tree must introduce NO findings beyond the checked-in
+    baseline. Fix the finding, pragma it with a rationale, or (for
+    deliberate debt) regenerate the baseline via --update-baseline."""
+    fresh = new_findings(repo_findings, load_baseline(DEFAULT_BASELINE))
+    assert fresh == [], (
+        f"{len(fresh)} NEW staticcheck violation(s):\n"
+        + "\n".join(f.format() for f in fresh[:25]))
+
+
+def test_checked_in_baseline_not_inflated(repo_findings):
+    """The ratchet only ratchets downward if stale entries get pruned:
+    at least 90 percent of baselined keys must still correspond to real
+    findings (prevents the baseline from accumulating dead grandfather
+    entries as violations get fixed)."""
+    live = {f.key for f in repo_findings}
+    baseline = load_baseline(DEFAULT_BASELINE)
+    dead = [k for k in baseline if k not in live]
+    assert len(dead) <= max(5, len(baseline) // 10), (
+        f"{len(dead)} stale baseline entries, e.g. {dead[:10]} — "
+        f"run `python -m tools.staticcheck --update-baseline`")

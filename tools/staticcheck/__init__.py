@@ -1,0 +1,26 @@
+"""graftcheck — AST-based JAX-hazard static analysis for the op/nn surface.
+
+The framework's premise is that every op IS a jax function that must trace
+cleanly under `jax.jit`/pjit (see dispatch.apply). Nothing about that is
+enforced by the runtime until a user hits it under trace, so this package
+walks the source with compiler-style passes and reports the classic JAX
+hazards statically:
+
+- ``tracer-branch``      Python `if`/`while`/`assert` on traced values
+- ``numpy-on-tracer``    `np.*` calls fed traced values inside op lambdas
+- ``host-sync``          `.item()`/`np.asarray`/`float()` on hot paths
+- ``registry-consistency`` op_name strings vs tolerance/coverage registries
+- ``mutable-global``     module globals written outside `set_*` installers
+- ``dead-export``        `__all__` names that don't resolve
+
+Run `python -m tools.staticcheck --help` for the CLI; the checked-in
+`baseline.json` makes the CI gate a ratchet (only NEW violations fail).
+"""
+from .core import (  # noqa: F401
+    Checker, Finding, Module, Project, all_checkers, register, run)
+from .baseline import load_baseline, new_findings, save_baseline  # noqa: F401
+
+__all__ = [
+    "Checker", "Finding", "Module", "Project", "all_checkers", "register",
+    "run", "load_baseline", "new_findings", "save_baseline",
+]

@@ -1,0 +1,96 @@
+"""CLI: python -m tools.staticcheck [paths...] [options]
+
+Modes:
+  (default)            report every finding (baseline NOT applied), exit 0
+  --ci                 apply the baseline ratchet; exit 1 on NEW findings
+  --update-baseline    rewrite baseline.json from the current finding set
+
+Examples:
+  python -m tools.staticcheck                       # full report
+  python -m tools.staticcheck --ci                  # the CI gate
+  python -m tools.staticcheck --rules host-sync paddle_tpu/ops
+  python -m tools.staticcheck --json > findings.json
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .baseline import (DEFAULT_BASELINE, load_baseline, new_findings,
+                       save_baseline)
+from .core import all_checkers, run
+from .report import json_report, text_report
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.staticcheck",
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: paddle_tpu tools)")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="project root (baseline keys are relative to it)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ci", action="store_true",
+                    help="apply the baseline; exit 1 if NEW findings exist")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit JSON instead of text")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list registered rule ids and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for c in sorted(all_checkers(), key=lambda c: c.rule):
+            mod = sys.modules[type(c).__module__]
+            doc = (mod.__doc__ or "").strip().splitlines()
+            print(f"{c.rule:24s} [{c.severity}] {doc[0] if doc else ''}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    findings = run(args.root, paths=args.paths or None, rules=rules)
+    baseline_path = args.baseline or DEFAULT_BASELINE
+
+    if args.update_baseline:
+        # scoped invocations merge: entries outside the scanned paths are
+        # preserved, so a partial scan can't resurface the rest as "new"
+        scanned = None
+        if args.paths:
+            scanned = [os.path.relpath(p, args.root) if os.path.isabs(p)
+                       else p for p in args.paths]
+        save_baseline(findings, baseline_path, scanned_paths=scanned)
+        print(f"baseline updated: {len(findings)} finding(s) recorded"
+              + (f" under {', '.join(scanned)}" if scanned else "")
+              + f" -> {baseline_path}")
+        return 0
+
+    if args.ci:
+        fresh = new_findings(findings, load_baseline(baseline_path))
+        out = json_report(fresh) if args.as_json else text_report(fresh)
+        print(out)
+        if fresh:
+            print(f"\nstaticcheck --ci: {len(fresh)} NEW violation(s) not "
+                  f"in the baseline ({len(findings)} total, "
+                  f"{len(findings) - len(fresh)} baselined).\n"
+                  f"Fix them, add a `# staticcheck: ok[rule]` pragma with a "
+                  f"rationale, or (last resort) run --update-baseline.",
+                  file=sys.stderr)
+            return 1
+        print(f"staticcheck --ci: clean "
+              f"({len(findings)} baselined finding(s), 0 new).")
+        return 0
+
+    print(json_report(findings) if args.as_json else text_report(findings))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

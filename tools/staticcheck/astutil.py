@@ -1,0 +1,208 @@
+"""Shared AST analyses: traced-function discovery and value-use tainting.
+
+"Traced" means the function object is handed to the tracing machinery —
+dispatch.apply / defprim / the distributions' _wrap, or jax.jit/pjit —
+so its positional parameters are jax values (possibly Tracers) at runtime.
+The analyses here are deliberately heuristic: metadata access
+(`.shape`/`.ndim`/`.dtype`) and shape-level builtins (`isinstance`, `len`)
+are static under trace and never count as value uses.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+# entry points whose first positional argument becomes a traced callable
+TRACE_ENTRY_NAMES = {"apply", "defprim", "_wrap"}
+JIT_NAMES = {"jit", "pjit"}
+
+# attributes that are static metadata under trace (reading them off a
+# tracer never materializes values on host)
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "device", "sharding",
+                "aval", "weak_type", "itemsize", "nbytes"}
+# builtins whose result over a traced array is static (or that inspect the
+# python object, not the array values)
+STATIC_CALLS = {"isinstance", "len", "type", "hasattr", "getattr",
+                "callable", "id", "repr"}
+
+
+def call_name(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def attr_root(node: ast.AST) -> str | None:
+    """Leftmost name of an attribute chain: `np.linalg.eig` -> 'np'."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@dataclasses.dataclass
+class TracedFn:
+    node: ast.AST          # ast.Lambda | ast.FunctionDef
+    params: set[str]       # positional params — traced values at runtime
+    entry: str             # 'apply' | 'defprim' | '_wrap' | 'jit' | ...
+    entry_node: ast.AST    # the call / decorator that marked it traced
+
+
+def _positional_params(args: ast.arguments) -> set[str]:
+    names = {a.arg for a in args.posonlyargs + args.args}
+    if args.vararg is not None:
+        names.add(args.vararg.arg)
+    # kwonlyargs excluded: apply() passes static config by keyword
+    return names
+
+
+def _functiondefs_by_name(tree: ast.AST) -> dict[str, list[ast.FunctionDef]]:
+    out: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def traced_functions(tree: ast.AST) -> Iterator[TracedFn]:
+    """Yield every function node in the module that is passed to a trace
+    entry point, either inline (lambda / local def referenced by name) or
+    via a jit decorator."""
+    defs = _functiondefs_by_name(tree)
+    seen: set[int] = set()
+
+    def emit(fn_expr: ast.AST, entry: str, entry_node: ast.AST):
+        targets: list[ast.AST] = []
+        if isinstance(fn_expr, (ast.Lambda, ast.FunctionDef)):
+            targets.append(fn_expr)
+        elif isinstance(fn_expr, ast.Name):
+            targets.extend(defs.get(fn_expr.id, ()))
+        for t in targets:
+            if id(t) in seen:
+                continue
+            seen.add(id(t))
+            yield TracedFn(node=t, params=_positional_params(t.args),
+                           entry=entry, entry_node=entry_node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in TRACE_ENTRY_NAMES and node.args:
+                yield from emit(node.args[0], name, node)
+            elif name in JIT_NAMES and node.args:
+                yield from emit(node.args[0], "jit", node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dname = (call_name(dec) if isinstance(dec, ast.Call)
+                         else dec.attr if isinstance(dec, ast.Attribute)
+                         else dec.id if isinstance(dec, ast.Name) else None)
+                if dname in JIT_NAMES:
+                    yield from emit(node, "jit", dec)
+
+
+def value_uses(expr: ast.AST, tainted: set[str],
+               containers: set[str] = frozenset()) -> list[ast.Name]:
+    """Name nodes in `expr` that read a tainted value AS A VALUE.
+
+    Static accesses never count: metadata attributes (`x.shape`, `x.ndim`),
+    object-level builtins (`isinstance(x, T)`, `len(x)`), identity checks
+    (`x is None`), and container-key membership (`k in params`). Names in
+    `containers` (e.g. a traced *args tuple) count only when indexed —
+    `if gs:` is a length check, `gs[0] + 1` touches a traced element."""
+    out: list[ast.Name] = []
+
+    def visit(n: ast.AST):
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Call) and call_name(n) in STATIC_CALLS:
+            return
+        if isinstance(n, ast.Compare):
+            # `x is None` is object identity (static); `k in d` checks keys,
+            # not values, so the container side never counts. The left side
+            # still counts for ordinary comparisons and as the member of
+            # `in` (a traced member against a static container is dynamic).
+            if not isinstance(n.ops[0], (ast.Is, ast.IsNot)):
+                visit(n.left)
+            for op, comp in zip(n.ops, n.comparators):
+                if isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)):
+                    continue
+                visit(comp)
+            return
+        if isinstance(n, ast.Subscript) and isinstance(n.value, ast.Name) \
+                and n.value.id in containers and n.value.id in tainted:
+            out.append(n.value)
+            visit(n.slice)
+            return
+        if isinstance(n, ast.Name):
+            if n.id in tainted and n.id not in containers:
+                out.append(n)
+            return
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(expr)
+    return out
+
+
+def _binding_names(target: ast.AST):
+    """Names BOUND by an assignment target. `env[n] = x` binds nothing but
+    mutates `env` (the container gets tainted, the index `n` does not)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _binding_names(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _binding_names(target.value)
+    elif isinstance(target, (ast.Subscript, ast.Attribute)):
+        root = attr_root(target.value)
+        if root is not None:
+            yield root
+
+
+def vararg_name(fn: TracedFn) -> set[str]:
+    va = fn.node.args.vararg
+    return {va.arg} if va is not None else set()
+
+
+_CONTAINER_LITERALS = (ast.List, ast.ListComp, ast.Tuple, ast.Dict,
+                       ast.DictComp, ast.Set, ast.SetComp)
+
+
+def tainted_names(fn: TracedFn, max_iters: int = 10) -> tuple[set[str], set[str]]:
+    """-> (tainted, containers): params plus local names (transitively)
+    assigned from tainted values, and the subset that holds *collections* of
+    traced values (the *args tuple, a list built from traced elements) —
+    their truthiness/length is static, only indexing them is a value use.
+    A bounded fixpoint over the function's Assign statements —
+    order-insensitive, so re-assignments are over-approximated as tainted."""
+    tainted = set(fn.params)
+    containers = vararg_name(fn)
+    body = fn.node.body if isinstance(fn.node, ast.FunctionDef) else [fn.node.body]
+    assigns = [n for stmt in body for n in ast.walk(stmt)
+               if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))]
+    for _ in range(max_iters):
+        grew = False
+        for a in assigns:
+            value = a.value
+            if value is None or not value_uses(value, tainted, containers):
+                continue
+            is_container = isinstance(value, _CONTAINER_LITERALS)
+            targets = a.targets if isinstance(a, ast.Assign) else [a.target]
+            for t in targets:
+                for name in _binding_names(t):
+                    if name not in tainted:
+                        tainted.add(name)
+                        grew = True
+                    if is_container and isinstance(t, ast.Name) \
+                            and name not in containers:
+                        containers.add(name)
+                        grew = True
+        if not grew:
+            break
+    return tainted, containers

@@ -1,0 +1,76 @@
+"""Ratcheting baseline: pre-existing violations are recorded, only NEW ones
+fail CI. Keys are (rule, path, context) with an occurrence count, so line
+drift from unrelated edits doesn't resurface old findings, while adding a
+second violation identical in text to a baselined one still fails."""
+from __future__ import annotations
+
+import collections
+import json
+import os
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def _counts(findings: list[Finding]) -> dict[str, int]:
+    c: collections.Counter[str] = collections.Counter()
+    for f in findings:
+        c[f.key] += 1
+    return dict(sorted(c.items()))
+
+
+def save_baseline(findings: list[Finding], path: str = DEFAULT_BASELINE,
+                  scanned_paths=None) -> None:
+    """Rewrite the baseline from `findings`. With `scanned_paths` (a partial
+    scan), only entries whose file lives under one of those paths are
+    replaced; everything else is preserved — a scoped `--update-baseline
+    some/dir` must not silently drop the grandfathered findings the scan
+    never visited."""
+    counts = _counts(findings)
+    if scanned_paths:
+        prefixes = tuple(p.strip("/").rstrip("/") for p in scanned_paths)
+
+        def scanned(key: str) -> bool:
+            kpath = key.split("::", 2)[1]
+            return any(kpath == p or kpath.startswith(p + "/")
+                       for p in prefixes)
+
+        kept = {k: v for k, v in load_baseline(path).items()
+                if not scanned(k)}
+        counts = dict(sorted({**kept, **counts}.items()))
+    payload = {
+        "version": BASELINE_VERSION,
+        "total": sum(counts.values()),
+        "counts": counts,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    if payload.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{payload.get('version')!r}")
+    return dict(payload.get("counts", {}))
+
+
+def new_findings(findings: list[Finding],
+                 baseline: dict[str, int]) -> list[Finding]:
+    """Findings beyond the baselined count for their key, in input order.
+    The first `n` occurrences of a key baselined with count `n` are grandfathered;
+    occurrences past that are new."""
+    remaining = dict(baseline)
+    fresh = []
+    for f in findings:
+        if remaining.get(f.key, 0) > 0:
+            remaining[f.key] -= 1
+        else:
+            fresh.append(f)
+    return fresh

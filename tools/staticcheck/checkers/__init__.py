@@ -1,0 +1,9 @@
+"""Checker registry — importing this package registers every checker."""
+from . import (  # noqa: F401
+    dead_export,
+    host_sync,
+    mutable_global,
+    numpy_on_tracer,
+    registry_consistency,
+    tracer_branch,
+)

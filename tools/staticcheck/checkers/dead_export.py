@@ -1,0 +1,129 @@
+"""dead-export: `__all__` names that don't resolve at module scope.
+
+A name exported in a literal `__all__` but never bound at module level is
+an ImportError waiting for `from mod import *` (and breaks the namespace
+parity test's notion of the public surface). Modules that build `__all__`
+dynamically (append in a loop, `globals()[...]` registration — e.g.
+ops/breadth.py) are skipped: the binding set isn't statically resolvable.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, register
+
+
+def _literal_strs(node: ast.AST) -> list[str] | None:
+    """Strings of a literal list/tuple (or concatenation of them)."""
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _literal_strs(node.left)
+        right = _literal_strs(node.right)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def _collect_exports(tree: ast.Module):
+    """-> (exports with nodes, dynamic?) — dynamic means some write to
+    __all__ couldn't be resolved to literal strings."""
+    exports: list[tuple[str, ast.AST]] = []
+    dynamic = False
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if not any(isinstance(t, ast.Name) and t.id == "__all__"
+                       for t in targets):
+                continue
+            strs = _literal_strs(node.value)
+            if strs is None:
+                dynamic = True
+            else:
+                exports.extend((s, node) for s in strs)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "__all__":
+            if node.func.attr == "extend" and node.args:
+                strs = _literal_strs(node.args[0])
+                if strs is None:
+                    dynamic = True
+                else:
+                    exports.extend((s, node) for s in strs)
+            else:
+                dynamic = True  # .append in a helper/loop etc.
+    return exports, dynamic
+
+
+def _bound_names(body: list[ast.stmt]) -> set[str]:
+    """Names bound at module scope — recursing into If/Try/For/While/With
+    blocks but not into function/class bodies."""
+    names: set[str] = set()
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(stmt.name)
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for alias in stmt.names:
+                if alias.name == "*":
+                    names.add("*")
+                else:
+                    names.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name):
+                        names.add(leaf.id)
+        elif isinstance(stmt, (ast.If, ast.Try, ast.For, ast.While,
+                               ast.With)):
+            for attr in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(stmt, attr, [])
+                for item in sub:
+                    if isinstance(item, ast.ExceptHandler):
+                        names |= _bound_names(item.body)
+                    elif isinstance(item, ast.stmt):
+                        names |= _bound_names([item])
+            if isinstance(stmt, ast.For):
+                for leaf in ast.walk(stmt.target):
+                    if isinstance(leaf, ast.Name):
+                        names.add(leaf.id)
+            if isinstance(stmt, ast.With):
+                for it in stmt.items:
+                    if it.optional_vars is not None:
+                        for leaf in ast.walk(it.optional_vars):
+                            if isinstance(leaf, ast.Name):
+                                names.add(leaf.id)
+    return names
+
+
+@register
+class DeadExportChecker(Checker):
+    rule = "dead-export"
+    severity = "error"
+
+    def check_module(self, mod: Module):
+        exports, dynamic = _collect_exports(mod.tree)
+        if dynamic or not exports:
+            return
+        bound = _bound_names(mod.tree.body)
+        if "*" in bound:
+            return  # star import: binding set not statically resolvable
+        for name, node in exports:
+            if name not in bound:
+                yield mod.finding(
+                    self.rule, self.severity, node,
+                    f"`__all__` exports {name!r} but no module-level "
+                    f"binding with that name exists — "
+                    f"`from {mod.path.replace('/', '.')[:-3]} import *` "
+                    f"would raise AttributeError",
+                    context=name)

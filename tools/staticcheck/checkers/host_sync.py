@@ -1,0 +1,88 @@
+"""host-sync: device→host round-trips on hot paths.
+
+`.item()` / `.tolist()` / `np.asarray(tensor)` / `float(tensor)` block on
+the device and break under jit (ConcretizationTypeError on a Tracer). On
+the op/nn/model hot paths every one of these is either a genuine bug or a
+deliberate eager-only design decision — the latter get a
+`# staticcheck: ok[host-sync]` pragma with the rationale, everything else
+fails the ratchet.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import STATIC_ATTRS, attr_root, call_name
+from ..core import Checker, Module, register
+
+HOT_PATH_PREFIXES = (
+    "paddle_tpu/ops/",
+    "paddle_tpu/nn/functional/",
+    "paddle_tpu/models/",
+)
+_SYNC_METHODS = {"item", "tolist"}
+_NUMPY_ROOTS = {"np", "numpy", "_np"}
+_UNWRAP_CALLS = {"_u", "_unwrap", "_v"}
+
+
+def _mentions_tensor_value(node: ast.AST) -> bool:
+    """Does the expression reach into a Tensor's payload — `x._value` or an
+    unwrap helper call? Metadata reads (`_u(x).dtype`) don't count, and
+    `.item()` chains are excluded: the inner call is already flagged on its
+    own, one finding per sync."""
+    found = False
+
+    def visit(n: ast.AST):
+        nonlocal found
+        if found:
+            return
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Attribute) and n.attr == "_value":
+            found = True
+            return
+        if isinstance(n, ast.Call) and call_name(n) in _UNWRAP_CALLS:
+            found = True
+            return
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return found
+
+
+@register
+class HostSyncChecker(Checker):
+    rule = "host-sync"
+    severity = "warning"
+
+    def check_module(self, mod: Module):
+        if not mod.path.startswith(HOT_PATH_PREFIXES):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS \
+                    and not node.args:
+                yield mod.finding(
+                    self.rule, self.severity, node,
+                    f"`.{f.attr}()` forces a device->host sync and breaks "
+                    f"under jit — keep the value on device, or pragma with "
+                    f"the eager-only rationale")
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr in ("asarray", "array") \
+                    and attr_root(f) in _NUMPY_ROOTS \
+                    and any(_mentions_tensor_value(a) for a in node.args):
+                yield mod.finding(
+                    self.rule, self.severity, node,
+                    f"`{ast.unparse(f)}` over a tensor payload materializes "
+                    f"it on host — use jnp, or pragma if the op is "
+                    f"inherently eager (dynamic output shape)")
+            elif isinstance(f, ast.Name) and f.id in ("float", "int", "bool") \
+                    and len(node.args) == 1 \
+                    and _mentions_tensor_value(node.args[0]):
+                yield mod.finding(
+                    self.rule, self.severity, node,
+                    f"`{f.id}()` over a tensor payload is a hidden host "
+                    f"sync — keep it as a 0-d array, or pragma with the "
+                    f"rationale")

@@ -1,0 +1,131 @@
+"""mutable-global: module-level state written outside installer functions.
+
+The dual eager/static recorder (dispatch._static_recorder and friends) is
+module-global by design, but every write to module state must go through a
+named installer (`set_*`, `reset_*`, ...) so the thread-safety story stays
+auditable. Flags (a) `global X; X = ...` rebinding and (b) mutation of
+module-level containers (`CACHE[k] = v`, `REGISTRY.append(...)`) from
+functions whose names don't look like installers.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Checker, Module, register
+
+# installer-shaped function names: writes from these are the sanctioned
+# path. `__enter__`/`__exit__` are the scoped-guard idiom (push/pop of a
+# context) — as auditable as a set_* pair. `export` covers `_export`-style
+# module registrars that build `__all__` at import time.
+_INSTALLER_RE = re.compile(
+    r"^_?(set|install|reset|clear|enable|disable|init|seed|register|"
+    r"unregister|switch|use|load|toggle|push|pop|configure|update|export"
+    r")|^__(enter|exit)__$")
+_MUTATOR_METHODS = {"append", "extend", "insert", "add", "update", "clear",
+                    "setdefault", "pop", "popitem", "remove", "discard"}
+
+
+def _module_level_mutables(tree: ast.Module) -> set[str]:
+    """Names bound at module top level to a mutable literal or constructor."""
+    out: set[str] = set()
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        mutable = isinstance(value, (ast.Dict, ast.List, ast.Set)) or (
+            isinstance(value, ast.Call) and isinstance(value.func, ast.Name)
+            and value.func.id in ("dict", "list", "set", "defaultdict",
+                                  "OrderedDict", "Counter", "deque"))
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _enclosing_function(node: ast.AST) -> ast.FunctionDef | None:
+    cur = getattr(node, "_sc_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = getattr(cur, "_sc_parent", None)
+    return None
+
+
+def _is_local(fn: ast.FunctionDef, name: str) -> bool:
+    """Is `name` rebound locally in fn (param or plain assignment), i.e. the
+    writes we see target a shadowing local, not the module global?"""
+    args = fn.args
+    params = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        params.add(args.vararg.arg)
+    if args.kwarg:
+        params.add(args.kwarg.arg)
+    if name in params:
+        return True
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return True
+        elif isinstance(n, (ast.For, ast.comprehension)):
+            t = n.target
+            for leaf in ast.walk(t):
+                if isinstance(leaf, ast.Name) and leaf.id == name:
+                    return True
+    return False
+
+
+@register
+class MutableGlobalChecker(Checker):
+    rule = "mutable-global"
+    severity = "warning"
+
+    def check_module(self, mod: Module):
+        mutables = _module_level_mutables(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Global):
+                fn = _enclosing_function(node)
+                if fn is None or _INSTALLER_RE.match(fn.name):
+                    continue
+                yield mod.finding(
+                    self.rule, self.severity, node,
+                    f"`global {', '.join(node.names)}` rebound in "
+                    f"{fn.name}() — route module-state writes through a "
+                    f"set_*/reset_* installer so the dual eager/static "
+                    f"recorder stays auditable")
+            elif isinstance(node, (ast.Subscript, ast.Attribute)) \
+                    and isinstance(getattr(node, "_sc_parent", None),
+                                   (ast.Assign, ast.AugAssign)) \
+                    and node._sc_parent.value is not node \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in mutables:
+                fn = _enclosing_function(node)
+                if fn is None or _INSTALLER_RE.match(fn.name) \
+                        or _is_local(fn, node.value.id):
+                    continue
+                yield mod.finding(
+                    self.rule, self.severity, node,
+                    f"module-level container `{node.value.id}` mutated in "
+                    f"{fn.name}() — move the write into a set_*/register_* "
+                    f"installer")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATOR_METHODS \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in mutables:
+                fn = _enclosing_function(node)
+                if fn is None or _INSTALLER_RE.match(fn.name) \
+                        or _is_local(fn, node.func.value.id):
+                    continue
+                yield mod.finding(
+                    self.rule, self.severity, node,
+                    f"module-level container `{node.func.value.id}` mutated "
+                    f"via .{node.func.attr}() in {fn.name}() — move the "
+                    f"write into a set_*/register_* installer")

@@ -1,0 +1,50 @@
+"""numpy-on-tracer: host numpy applied to traced values.
+
+`np.*` functions inside a function handed to the tracing machinery either
+raise TracerArrayConversionError under jit or silently execute on host in
+eager mode, splitting the program into unfusible pieces. Only calls that
+feed a traced parameter (or a value derived from one) are flagged — index
+construction with numpy over static shapes (`np.triu_indices(n)`) is fine
+and common.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import (attr_root, tainted_names, traced_functions,
+                       value_uses)
+from ..core import Checker, Module, register
+
+_NUMPY_ROOTS = {"np", "numpy", "_np"}
+
+
+@register
+class NumpyOnTracerChecker(Checker):
+    rule = "numpy-on-tracer"
+    severity = "error"
+
+    def check_module(self, mod: Module):
+        for fn in traced_functions(mod.tree):
+            tainted, containers = tainted_names(fn)
+            body = fn.node.body if isinstance(fn.node, ast.FunctionDef) \
+                else [fn.node.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if not isinstance(node.func, ast.Attribute):
+                        continue
+                    if attr_root(node.func) not in _NUMPY_ROOTS:
+                        continue
+                    args = list(node.args) + [kw.value for kw in node.keywords]
+                    uses = [u for a in args
+                            for u in value_uses(a, tainted, containers)]
+                    if not uses:
+                        continue
+                    names = ", ".join(sorted({u.id for u in uses}))
+                    yield mod.finding(
+                        self.rule, self.severity, node,
+                        f"numpy call `{ast.unparse(node.func)}` fed traced "
+                        f"value(s) {names} inside a function passed to "
+                        f"{fn.entry}() — use the jnp equivalent so the op "
+                        f"stays traceable/fusible")

@@ -1,0 +1,187 @@
+"""registry-consistency: op_name strings vs the tolerance/coverage registries.
+
+Every op dispatched through apply()/defprim() is supposed to be governed:
+either it has a (per-dtype) tolerance entry in tests/op_tolerances.py or it
+shows up in the OP_COVERAGE.json enumeration the dtype-sweep battery is
+pinned to. Cross-checked both directions:
+
+- an op dispatched in code with neither entry is UNGOVERNED (new ops must
+  register; pre-existing ones are baselined — the ratchet stops the set
+  from growing);
+- a registry name that no dispatch site produces is STALE (a renamed or
+  deleted op whose tolerance/coverage entry now governs nothing).
+
+Op names are extracted statically: `op_name="..."` literals, defprim's
+positional name, the jax-callable's own name when op_name is omitted
+(apply(jnp.tril, ...) -> "tril"), and factory indirection — a function
+whose body calls apply(..., op_name=<param>) propagates string constants
+from its call sites (`abs = _unop("abs", jnp.abs)`).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from ..astutil import call_name
+from ..core import Checker, Finding, Module, Project, register
+
+TOLERANCES_PATH = os.path.join("tests", "op_tolerances.py")
+COVERAGE_PATH = "OP_COVERAGE.json"
+_ENTRY_NAMES = {"apply", "defprim", "_wrap"}
+
+
+def _op_name_of_call(node: ast.Call) -> str | None:
+    """Static op name of one apply()/defprim()/_wrap() call, or None."""
+    for kw in node.keywords:
+        if kw.arg == "op_name":
+            if isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+            return None  # op_name is dynamic — handled by factory pass
+    if call_name(node) == "defprim" and len(node.args) > 1 \
+            and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        return node.args[1].value
+    if node.args:
+        a0 = node.args[0]
+        implied = a0.id if isinstance(a0, ast.Name) else \
+            a0.attr if isinstance(a0, ast.Attribute) else None
+        # local helper names (`apply(f, ...)`, `apply(_impl, ...)`) are not
+        # op names — only believe an implied name that looks like one
+        if implied and len(implied) > 2 and not implied.startswith("_"):
+            return implied
+    return None
+
+
+def _factory_params(tree: ast.AST) -> dict[str, str]:
+    """Functions whose body dispatches with op_name=<param>: name -> param."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = {a.arg for a in node.args.posonlyargs + node.args.args
+                  + node.args.kwonlyargs}
+        for inner in ast.walk(node):
+            if not (isinstance(inner, ast.Call)
+                    and call_name(inner) in _ENTRY_NAMES):
+                continue
+            for kw in inner.keywords:
+                if kw.arg == "op_name" and isinstance(kw.value, ast.Name) \
+                        and kw.value.id in params:
+                    out[node.name] = kw.value.id
+    return out
+
+
+def _factory_arg_index(tree: ast.AST, fname: str, param: str) -> int | None:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == fname:
+            names = [a.arg for a in node.args.posonlyargs + node.args.args]
+            if param in names:
+                return names.index(param)
+    return None
+
+
+def _load_tolerance_names(root: str) -> set[str] | None:
+    """Keys of FWD_OVERRIDES/GRAD_OVERRIDES/SKIPS, parsed without import."""
+    path = os.path.join(root, TOLERANCES_PATH)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    names: set[str] = set()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        target = node.targets[0].id
+        try:
+            value = ast.literal_eval(node.value)
+        except ValueError:
+            continue
+        if target in ("FWD_OVERRIDES", "GRAD_OVERRIDES"):
+            names |= set(value)
+        elif target == "SKIPS":
+            names |= {k[0] for k in value}
+    return names
+
+
+def _load_coverage_names(root: str) -> set[str] | None:
+    path = os.path.join(root, COVERAGE_PATH)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return set(json.load(f).get("counts", {}))
+
+
+@register
+class RegistryConsistencyChecker(Checker):
+    rule = "registry-consistency"
+    severity = "warning"
+
+    def __init__(self):
+        # op name -> first (module, node) dispatch site seen
+        self._sites: dict[str, tuple[Module, ast.AST]] = {}
+        # pending factory indirection, resolved in finalize
+        self._factories: dict[str, tuple[Module, str]] = {}
+        self._calls: list[tuple[Module, ast.Call]] = []
+
+    def check_module(self, mod: Module):
+        if not mod.path.startswith("paddle_tpu"):
+            return ()
+        for fname, param in _factory_params(mod.tree).items():
+            self._factories[fname] = (mod, param)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                self._calls.append((mod, node))
+                if call_name(node) in _ENTRY_NAMES:
+                    name = _op_name_of_call(node)
+                    if name:
+                        self._sites.setdefault(name, (mod, node))
+        return ()
+
+    def _resolve_factory_sites(self):
+        for fname, (fmod, param) in self._factories.items():
+            idx = _factory_arg_index(fmod.tree, fname, param)
+            for mod, node in self._calls:
+                if call_name(node) != fname:
+                    continue
+                value = None
+                for kw in node.keywords:
+                    if kw.arg == param:
+                        value = kw.value
+                if value is None and idx is not None and idx < len(node.args):
+                    value = node.args[idx]
+                if isinstance(value, ast.Constant) \
+                        and isinstance(value.value, str):
+                    self._sites.setdefault(value.value, (mod, node))
+
+    def finalize(self, project: Project):
+        tol = _load_tolerance_names(project.root)
+        cov = _load_coverage_names(project.root)
+        if tol is None and cov is None:
+            return  # no registries in this tree — nothing to cross-check
+        self._resolve_factory_sites()
+        registry = (tol or set()) | (cov or set())
+        for name in sorted(set(self._sites) - registry):
+            mod, node = self._sites[name]
+            yield mod.finding(
+                self.rule, self.severity, node,
+                f"op {name!r} is dispatched here but has no tolerance "
+                f"entry in {TOLERANCES_PATH} and no {COVERAGE_PATH} record "
+                f"— ungoverned ops can silently regress",
+                context=name)
+        for name in sorted(registry - set(self._sites)):
+            where = []
+            if tol and name in tol:
+                where.append(TOLERANCES_PATH)
+            if cov and name in cov:
+                where.append(COVERAGE_PATH)
+            yield Finding(
+                rule=self.rule, severity="error", path=where[0], line=1,
+                col=0, context=name,
+                message=f"registry entry {name!r} ({' + '.join(where)}) "
+                        f"matches no dispatch site in paddle_tpu/ — stale "
+                        f"after a rename/delete, or the extractor can't "
+                        f"see the site (add an explicit op_name=)")

@@ -1,0 +1,45 @@
+"""tracer-branch: Python control flow on traced values.
+
+Inside a function handed to dispatch.apply / defprim / _wrap / jax.jit, a
+Python `if`/`while`/`assert` (or ternary) whose condition reads a traced
+parameter AS A VALUE raises ConcretizationTypeError under trace — or worse,
+silently bakes one branch into the compiled program when the op is first
+run eagerly. Metadata conditions (`v.ndim == 2`, `isinstance(v, ...)`) are
+static under trace and are not flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import tainted_names, traced_functions, value_uses
+from ..core import Checker, Module, register
+
+_STMTS = (ast.If, ast.While, ast.Assert, ast.IfExp)
+_WORDS = {ast.If: "if", ast.While: "while", ast.Assert: "assert",
+          ast.IfExp: "ternary"}
+
+
+@register
+class TracerBranchChecker(Checker):
+    rule = "tracer-branch"
+    severity = "error"
+
+    def check_module(self, mod: Module):
+        for fn in traced_functions(mod.tree):
+            tainted, containers = tainted_names(fn)
+            body = fn.node.body if isinstance(fn.node, ast.FunctionDef) \
+                else [fn.node.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, _STMTS):
+                        continue
+                    uses = value_uses(node.test, tainted, containers)
+                    if not uses:
+                        continue
+                    names = ", ".join(sorted({u.id for u in uses}))
+                    yield mod.finding(
+                        self.rule, self.severity, node,
+                        f"Python `{_WORDS[type(node)]}` on traced value(s) "
+                        f"{names} inside a function passed to "
+                        f"{fn.entry}() — use jnp.where/lax.cond, or hoist "
+                        f"the branch out of the traced function")

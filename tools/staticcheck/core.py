@@ -1,0 +1,181 @@
+"""Analysis core: Finding model, module loading, pragma handling, runner.
+
+A Checker subclass registers itself with @register, visits one parsed
+module at a time via check_module(), and may emit cross-file findings in
+finalize() once every module has been seen (the registry-consistency pass
+needs the whole project before it can report orphans in either direction).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, Iterator
+
+SEVERITIES = ("error", "warning", "info")
+
+# `# staticcheck: ok` suppresses every rule on that line;
+# `# staticcheck: ok[rule-a,rule-b]` suppresses just those rules.
+_PRAGMA_RE = re.compile(r"#\s*staticcheck:\s*ok(?:\[([a-z0-9_,\s-]+)\])?")
+
+DEFAULT_SCAN_PATHS = ("paddle_tpu", "tools")
+EXCLUDE_DIR_NAMES = {"__pycache__", ".git", "fixtures"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str      # project-root-relative, posix separators
+    line: int
+    col: int
+    message: str
+    context: str = ""  # stable baseline key component (defaults to source line)
+
+    @property
+    def key(self) -> str:
+        """Baseline identity. Uses the source-line text (or a checker-chosen
+        stable token) instead of the line number so unrelated edits above a
+        baselined violation don't resurface it as 'new'."""
+        return f"{self.rule}::{self.path}::{self.context}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.severity}] {self.message}")
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Module:
+    """One parsed source file plus the bits checkers keep re-deriving."""
+
+    def __init__(self, root: str, path: str):
+        self.root = root
+        self.abspath = path
+        self.path = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=self.path)
+        # parent links let checkers look outward from a node
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._sc_parent = node  # type: ignore[attr-defined]
+        self._pragmas = self._parse_pragmas()
+
+    def _parse_pragmas(self) -> dict[int, set[str] | None]:
+        out: dict[int, set[str] | None] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if not m:
+                continue
+            rules = m.group(1)
+            if rules is None:
+                out[i] = None  # all rules
+            else:
+                out[i] = {r.strip() for r in rules.split(",") if r.strip()}
+        return out
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if line not in self._pragmas:
+            return False
+        rules = self._pragmas[line]
+        return rules is None or rule in rules
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, severity: str, node: ast.AST, message: str,
+                context: str | None = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, severity=severity, path=self.path,
+                       line=line, col=col, message=message,
+                       context=context if context is not None
+                       else self.line_text(line))
+
+
+class Project:
+    """The set of modules under analysis plus the project root (so cross-file
+    checkers can reach registries that live outside the scan paths)."""
+
+    def __init__(self, root: str, modules: list[Module]):
+        self.root = root
+        self.modules = modules
+
+
+class Checker:
+    rule = ""           # rule id, kebab-case
+    severity = "warning"
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+_CHECKERS: list[type[Checker]] = []
+
+
+def register(cls: type[Checker]) -> type[Checker]:
+    _CHECKERS.append(cls)
+    return cls
+
+
+def all_checkers() -> list[Checker]:
+    from . import checkers  # noqa: F401  — importing populates the registry
+    return [cls() for cls in _CHECKERS]
+
+
+def iter_py_files(root: str, paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        absp = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(absp):
+            yield absp
+            continue
+        for dirpath, dirs, files in os.walk(absp):
+            dirs[:] = sorted(d for d in dirs if d not in EXCLUDE_DIR_NAMES)
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def load_project(root: str, paths: Iterable[str] | None = None) -> Project:
+    paths = tuple(paths) if paths else DEFAULT_SCAN_PATHS
+    modules = []
+    for f in iter_py_files(root, paths):
+        try:
+            modules.append(Module(root, f))
+        except SyntaxError as e:
+            raise SyntaxError(f"staticcheck cannot parse {f}: {e}") from e
+    return Project(root, modules)
+
+
+def run(root: str, paths: Iterable[str] | None = None,
+        rules: Iterable[str] | None = None) -> list[Finding]:
+    """Run every registered checker over the project; returns findings with
+    pragma suppressions already applied, sorted by (path, line, rule)."""
+    project = load_project(root, paths)
+    checkers = all_checkers()
+    if rules is not None:
+        wanted = set(rules)
+        checkers = [c for c in checkers if c.rule in wanted]
+    findings: list[Finding] = []
+    by_path = {m.path: m for m in project.modules}
+    for checker in checkers:
+        for mod in project.modules:
+            findings.extend(checker.check_module(mod))
+        findings.extend(checker.finalize(project))
+    kept = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.col, f.message))
+    return kept

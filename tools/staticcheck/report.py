@@ -1,0 +1,27 @@
+"""Text and JSON reporters for staticcheck findings."""
+from __future__ import annotations
+
+import collections
+import json
+
+from .core import Finding
+
+
+def text_report(findings: list[Finding], verbose_summary: bool = True) -> str:
+    lines = [f.format() for f in findings]
+    if verbose_summary:
+        by_rule = collections.Counter(f.rule for f in findings)
+        if findings:
+            lines.append("")
+        lines.append(f"{len(findings)} finding(s)"
+                     + ("" if not by_rule else " — "
+                        + ", ".join(f"{r}: {n}"
+                                    for r, n in sorted(by_rule.items()))))
+    return "\n".join(lines)
+
+
+def json_report(findings: list[Finding]) -> str:
+    return json.dumps(
+        {"total": len(findings),
+         "findings": [f.to_json() for f in findings]},
+        indent=1)
