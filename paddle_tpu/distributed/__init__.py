@@ -19,7 +19,11 @@ from .auto_parallel import (  # noqa: F401
 )
 from .utils import global_scatter, global_gather  # noqa: F401
 from . import checkpoint  # noqa: F401
-from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointCorruptionError, save_state_dict, load_state_dict,
+)
+from . import chaos  # noqa: F401
+from .ckpt_manager import CheckpointManager  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from . import rpc  # noqa: F401
 from . import auto_tuner  # noqa: F401

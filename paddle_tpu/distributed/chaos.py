@@ -1,0 +1,74 @@
+"""Crash-point fault injection (chaos harness).
+
+The durability claims of the checkpoint layer are only as good as the
+worst place a preemption can land. This module gives every dangerous
+window in the save path a NAME — `crashpoint("ckpt.shard_tmp_written")`
+— and lets a test (or a brave operator) arm one of them through the
+environment:
+
+    PT_CRASHPOINT=ckpt.shard_tmp_written   # die the first time this site
+                                           # is reached
+    PT_CRASHPOINT_HITS=2                   # ... or only on the 2nd hit
+
+An armed crashpoint kills the process with SIGKILL — no atexit handlers,
+no flushing, no cleanup — exactly the failure a fleet preemption or OOM
+kill delivers. Unarmed sites cost one dict lookup and are always safe to
+leave in production code.
+
+Sites register themselves at module import via `register()` so the crash
+matrix in tests/test_ckpt_chaos.py can enumerate every registered site
+and prove recovery from each one, including sites added later: a new
+`crashpoint()` call in the save path automatically widens the matrix.
+"""
+from __future__ import annotations
+
+import os
+import signal
+
+# site name -> short description of the window it guards
+_REGISTRY: dict[str, str] = {}
+
+_hits: dict[str, int] = {}
+
+
+def register(site: str, description: str = "") -> str:
+    """Declare a crash site (idempotent). Returns the site name so callers
+    can write `SITE = register("ckpt.x", "...")` next to the code it guards."""
+    _REGISTRY.setdefault(site, description)
+    return site
+
+
+def registered_sites(prefix: str = "") -> list[str]:
+    """All declared sites (optionally filtered by prefix), sorted — the
+    enumeration the fault-injection matrix parametrizes over."""
+    return sorted(s for s in _REGISTRY if s.startswith(prefix))
+
+
+def describe(site: str) -> str:
+    return _REGISTRY.get(site, "")
+
+
+def crashpoint(site: str) -> None:
+    """Die here (SIGKILL) iff this site is armed via PT_CRASHPOINT.
+
+    PT_CRASHPOINT_HITS=N delays the kill until the Nth time the armed
+    site is reached (default 1), so a test can let generation k commit
+    cleanly and murder the writer inside generation k+1.
+    """
+    if site not in _REGISTRY:
+        register(site)
+    armed = os.environ.get("PT_CRASHPOINT")
+    if armed != site:
+        return
+    _hits[site] = _hits.get(site, 0) + 1  # staticcheck: ok[mutable-global] — per-process hit counter IS the feature (PT_CRASHPOINT_HITS); the process dies on the line below
+    if _hits[site] < int(os.environ.get("PT_CRASHPOINT_HITS", "1") or 1):
+        return
+    # SIGKILL self: the point is that NOTHING after this line runs — no
+    # finally blocks, no buffered writes, no renames. A torn state on disk
+    # is the expected outcome; recovery is the reader's job.
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def reset_hits() -> None:
+    """Forget hit counts (tests that arm several sites in one process)."""
+    _hits.clear()

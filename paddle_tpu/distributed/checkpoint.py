@@ -17,15 +17,120 @@ with checkpoint IO; `wait()` or the next save joins it.
 """
 from __future__ import annotations
 
+import io
 import json
 import os
 import threading
+import zlib
 from typing import Optional
 
 import jax
 import numpy as np
 
 from ..core.tensor import Tensor
+from .chaos import crashpoint, register as _register_crashpoint
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """Bytes on disk do not match the checksum recorded at save time.
+
+    Raised instead of silently loading torn data: a shard whose CRC32
+    disagrees with its sidecar (or the generation manifest) was half
+    written, bit-flipped, or overwritten by a concurrent save."""
+
+
+# every dangerous window in the save path is a named crash site; the
+# fault-injection matrix (tests/test_ckpt_chaos.py) SIGKILLs a writer at
+# each of these and asserts the reader still recovers committed data
+CP_SHARD_TMP = _register_crashpoint(
+    "ckpt.shard_tmp_written", "shard staged+fsynced under tmp name, not renamed")
+CP_SHARD_FINAL = _register_crashpoint(
+    "ckpt.shard_renamed", "shard at final name, checksum sidecar not written")
+CP_SIDECAR = _register_crashpoint(
+    "ckpt.sidecar_written", "shard + sidecar durable, metadata not written")
+CP_META_TMP = _register_crashpoint(
+    "ckpt.metadata_tmp_written", "metadata staged under tmp name, not renamed")
+CP_META_FINAL = _register_crashpoint(
+    "ckpt.metadata_written", "metadata durable (flat-dir checkpoint complete)")
+
+
+def _fsync_dir(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, data: bytes, crash_after_tmp: Optional[str] = None):
+    """tmp + fsync + rename + dir fsync: `path` either holds the complete
+    `data` or its previous content — never a torn prefix. Returns the CRC32
+    of `data` so callers can record it without re-reading."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    if crash_after_tmp is not None:
+        crashpoint(crash_after_tmp)
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _sidecar_path(shard_path: str) -> str:
+    return shard_path + ".crc32"
+
+
+def _write_sidecar(shard_path: str, crc: int, size: int):
+    _atomic_write(_sidecar_path(shard_path),
+                  f"{crc:08x} {size}\n".encode())
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> tuple[int, int]:
+    """(crc32, size) of a file, streamed — never holds the file in memory."""
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+            size += len(buf)
+    return crc & 0xFFFFFFFF, size
+
+
+def _read_sidecar(path: str) -> Optional[tuple[int, int]]:
+    """Parse `path`'s checksum sidecar -> (crc32, size), None if absent.
+    A torn/garbled SIDECAR is the same corruption class as a torn shard:
+    typed error, so fall-back-to-older-generation handlers keep working."""
+    sc = _sidecar_path(path)
+    if not os.path.exists(sc):
+        return None
+    try:
+        with open(sc) as f:
+            parts = f.read().split()
+        return int(parts[0], 16), int(parts[1])
+    except (OSError, ValueError, IndexError) as e:
+        raise CheckpointCorruptionError(
+            f"{sc}: unreadable checksum sidecar ({e}) — cannot verify "
+            f"{path}") from e
+
+
+def _verify_file(path: str):
+    """Check a file against its checksum sidecar (streamed, constant
+    memory). Missing sidecar => legacy/unchecksummed file, nothing to do."""
+    want = _read_sidecar(path)
+    if want is None:
+        return
+    got = _crc32_file(path)
+    if got != want:
+        raise CheckpointCorruptionError(
+            f"{path}: checksum mismatch (got crc32={got[0]:08x} "
+            f"size={got[1]}, sidecar says crc32={want[0]:08x} "
+            f"size={want[1]}) — torn or corrupted shard")
+
 
 _pending: Optional[threading.Thread] = None
 _pending_error: Optional[BaseException] = None
@@ -150,6 +255,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             m = _re.search(r"shard-(\d+)\.npz$", old)
             if m and int(m.group(1)) >= nproc:
                 os.remove(old)
+                if os.path.exists(_sidecar_path(old)):
+                    os.remove(_sidecar_path(old))
 
     meta = {"format": "paddle_tpu.dist_ckpt.v1", "params": {}}
     shards = {}
@@ -181,7 +288,18 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     barrier_tag = _next_barrier_tag(path)
 
     def _write():
-        np.savez(os.path.join(path, f"shard-{proc}.npz"), **shards)
+        shard_path = os.path.join(path, f"shard-{proc}.npz")
+        buf = io.BytesIO()
+        np.savez(buf, **shards)
+        data = buf.getvalue()
+        crc = _atomic_write(shard_path, data, crash_after_tmp=CP_SHARD_TMP)
+        crashpoint(CP_SHARD_FINAL)
+        # checksum sidecar AFTER the shard: a crash in between leaves a
+        # complete shard with a stale/absent sidecar — the generation
+        # manager refuses to commit it, and a flat-dir load detects the
+        # mismatch instead of trusting torn state
+        _write_sidecar(shard_path, crc, len(data))
+        crashpoint(CP_SIDECAR)
         if nproc > 1:
             # All hosts' shards must be durable before metadata announces the
             # checkpoint (readers key on metadata.json presence). This must be
@@ -192,8 +310,10 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             # device streams.
             _host_barrier(barrier_tag)
         if proc == coordinator_rank:
-            with open(os.path.join(path, "metadata.json"), "w") as f:
-                json.dump(meta, f)
+            _atomic_write(os.path.join(path, "metadata.json"),
+                          json.dumps(meta).encode(),
+                          crash_after_tmp=CP_META_TMP)
+            crashpoint(CP_META_FINAL)
 
     if async_save:
         global _pending
@@ -227,10 +347,16 @@ def _index_key(index, shape) -> str:
 class _ShardIndex:
     """One-time index over the checkpoint's npz files: name -> [(file, key)]."""
 
-    def __init__(self, path):
+    def __init__(self, path, verify: bool = True):
         import glob
-        self._files = [np.load(p) for p in
-                       sorted(glob.glob(os.path.join(path, "shard-*.npz")))]
+        self._files = []
+        for p in sorted(glob.glob(os.path.join(path, "shard-*.npz"))):
+            if verify:
+                # streamed CRC first, then a lazy np.load of the same path:
+                # keeps peak memory at one chunk per shard instead of
+                # pinning every shard's full bytes for the index lifetime
+                _verify_file(p)
+            self._files.append(np.load(p))
         if not self._files:
             raise FileNotFoundError(f"no shard files under {path}")
         self._by_name = {}
@@ -320,6 +446,11 @@ def reshard_checkpoint(src_path, dst_path, new_specs=None):
                 meta["params"][name]["spec"] = new_specs[name]
     finally:
         index.close()
-    np.savez(os.path.join(dst_path, "shard-0.npz"), **out)
-    with open(os.path.join(dst_path, "metadata.json"), "w") as f:
-        json.dump(meta, f)
+    buf = io.BytesIO()
+    np.savez(buf, **out)
+    data = buf.getvalue()
+    dst_shard = os.path.join(dst_path, "shard-0.npz")
+    crc = _atomic_write(dst_shard, data)
+    _write_sidecar(dst_shard, crc, len(data))
+    _atomic_write(os.path.join(dst_path, "metadata.json"),
+                  json.dumps(meta).encode())

@@ -1,0 +1,270 @@
+"""Durable checkpoint generations (orbax CheckpointManager analog).
+
+`save_state_dict` makes every FILE atomic (tmp+fsync+rename, CRC32
+sidecars), but a checkpoint is a SET of files — a preemption between the
+last shard and metadata still leaves a directory that looks loadable and
+isn't. This manager adds the directory-level protocol on top:
+
+    root/
+      step-40/   shard-*.npz + *.crc32 + metadata.json + manifest.json + COMMIT
+      step-50/   ...                                                     COMMIT
+      step-60/   shard-0.npz.tmp.1234          <- writer died here: no COMMIT
+
+- each save gets its own generation directory `step-<N>`; nothing is ever
+  rewritten in place, so a crashed save can only produce an UNCOMMITTED
+  directory, never damage a committed one;
+- the coordinator records every file's CRC32 + size in `manifest.json`
+  (checksums come from the sidecars the shard writers produced), then
+  writes the `COMMIT` marker as the LAST durable act — a generation
+  without COMMIT never existed as far as readers are concerned;
+- `latest()` walks generations newest-first and skips uncommitted or
+  structurally broken ones; `restore()` re-verifies shard checksums on
+  read and raises `CheckpointCorruptionError` rather than load torn data;
+- keep-last-K GC runs after commit and never deletes the newest committed
+  generation (keep >= 1 is enforced), so there is always a safe fallback.
+
+Crash sites in the commit path are registered with the chaos harness; the
+fault-injection matrix (tests/test_ckpt_chaos.py) SIGKILLs a writer at
+every one of them and proves `latest()` + `restore()` still land on the
+last committed generation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+from typing import Optional
+
+import jax
+
+from . import checkpoint as _ckpt
+from .chaos import crashpoint, register as _register_crashpoint
+
+CP_GEN_STAGED = _register_crashpoint(
+    "ckpt.generation_staged", "all files durable, manifest not written")
+CP_MANIFEST = _register_crashpoint(
+    "ckpt.manifest_written", "manifest durable, COMMIT not written")
+CP_COMMIT = _register_crashpoint(
+    "ckpt.commit_written", "generation committed, GC not run")
+CP_GC = _register_crashpoint(
+    "ckpt.gc_done", "commit + GC complete")
+
+_GEN_RE = re.compile(r"^step-(\d+)$")
+MANIFEST = "manifest.json"
+COMMIT = "COMMIT"
+
+
+class CheckpointManager:
+    """Generation-directory checkpointing with commit markers and GC."""
+
+    def __init__(self, root: str, keep_last_k: int = 2,
+                 coordinator_rank: int = 0):
+        if keep_last_k < 1:
+            raise ValueError("keep_last_k must be >= 1: the newest committed "
+                             "generation is never garbage-collected")
+        self.root = root
+        self.keep_last_k = keep_last_k
+        self.coordinator_rank = coordinator_rank
+        self._pending: Optional[threading.Thread] = None
+        self._pending_error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        os.makedirs(root, exist_ok=True)
+
+    # ---- naming ----
+    def gen_dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step-{int(step)}")
+
+    def _scan(self) -> list[int]:
+        steps = []
+        try:
+            entries = os.listdir(self.root)
+        except FileNotFoundError:
+            return []
+        for name in entries:
+            m = _GEN_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.root, name)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    # ---- read side ----
+    def all_steps(self, committed_only: bool = True) -> list[int]:
+        steps = self._scan()
+        if committed_only:
+            steps = [s for s in steps if self._committed_and_sound(s)]
+        return steps
+
+    def _committed_and_sound(self, step: int) -> bool:
+        """COMMIT present, manifest parses, and every manifested file exists
+        with the recorded size. Cheap (stat-level) — full CRC verification
+        happens on restore()."""
+        d = self.gen_dir(step)
+        if not os.path.exists(os.path.join(d, COMMIT)):
+            return False
+        try:
+            with open(os.path.join(d, MANIFEST)) as f:
+                man = json.load(f)
+            for fname, rec in man["files"].items():
+                st = os.stat(os.path.join(d, fname))
+                if st.st_size != rec["size"]:
+                    return False
+        except (OSError, ValueError, KeyError):
+            return False
+        return True
+
+    def latest(self) -> Optional[int]:
+        """Newest committed, structurally sound generation (None if none).
+        Uncommitted directories — a writer died mid-save — are skipped, as
+        are committed ones whose files have since gone missing/truncated."""
+        for step in reversed(self._scan()):
+            if self._committed_and_sound(step):
+                return step
+        return None
+
+    def manifest(self, step: int) -> dict:
+        with open(os.path.join(self.gen_dir(step), MANIFEST)) as f:
+            return json.load(f)
+
+    def restore(self, state_dict, step: Optional[int] = None) -> int:
+        """Fill `state_dict` from generation `step` (default: latest()).
+        Shard checksums are re-verified against the save-time sidecars;
+        torn bytes raise CheckpointCorruptionError instead of loading."""
+        if step is None:
+            step = self.latest()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no committed checkpoint generation under {self.root}")
+        d = self.gen_dir(step)
+        if not os.path.exists(os.path.join(d, COMMIT)):
+            raise FileNotFoundError(f"generation step-{step} was never "
+                                    f"committed (writer died mid-save?)")
+        self._verify_against_manifest(d)
+        _ckpt.load_state_dict(state_dict, d)
+        return step
+
+    def _verify_against_manifest(self, d: str):
+        """The manifest's CRCs are the commit-time ground truth. For files
+        whose sidecar survives, checking sidecar == manifest is enough (the
+        load path re-verifies bytes against the sidecar); a file whose
+        sidecar was lost (rsync'd without *.crc32, object-store sync) gets
+        a full streamed CRC here — its corruption must not load silently."""
+        with open(os.path.join(d, MANIFEST)) as f:
+            man = json.load(f)
+        for fname, rec in man["files"].items():
+            path = os.path.join(d, fname)
+            want = (int(rec["crc32"], 16), int(rec["size"]))
+            side = _ckpt._read_sidecar(path)
+            if side is not None:
+                if side != want:
+                    raise _ckpt.CheckpointCorruptionError(
+                        f"{path}: sidecar ({side[0]:08x},{side[1]}) disagrees "
+                        f"with the committed manifest ({want[0]:08x},"
+                        f"{want[1]})")
+                continue
+            got = _ckpt._crc32_file(path)
+            if got != want:
+                raise _ckpt.CheckpointCorruptionError(
+                    f"{path}: checksum mismatch vs committed manifest (got "
+                    f"crc32={got[0]:08x} size={got[1]}, manifest says "
+                    f"crc32={want[0]:08x} size={want[1]})")
+
+    # ---- write side ----
+    def save(self, state_dict, step: int, user_data: Optional[dict] = None,
+             async_save: bool = False):
+        """Write generation `step-<step>`: stage every file, manifest it,
+        COMMIT it, then GC old generations. With async_save the whole
+        protocol runs on a background thread; wait() (or the next save)
+        joins it and re-raises any writer failure."""
+        self.wait()
+        if async_save:
+            def _guarded():
+                try:
+                    self._save_and_commit(state_dict, step, user_data)
+                except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+                    with self._lock:
+                        self._pending_error = e
+            t = threading.Thread(target=_guarded, daemon=False)
+            with self._lock:
+                self._pending = t
+            t.start()
+        else:
+            self._save_and_commit(state_dict, step, user_data)
+
+    def wait(self):
+        """Join an in-flight async save; re-raise its failure exactly once."""
+        with self._lock:
+            t = self._pending
+        if t is not None:
+            t.join()
+        with self._lock:
+            if self._pending is t:
+                self._pending = None
+            err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise RuntimeError("async checkpoint generation failed") from err
+
+    def _save_and_commit(self, state_dict, step: int,
+                         user_data: Optional[dict]):
+        d = self.gen_dir(step)
+        os.makedirs(d, exist_ok=True)
+        # stage: per-file atomicity + sidecars come from the hardened
+        # save_state_dict; sync mode so the files are durable before manifest
+        _ckpt.save_state_dict(state_dict, d,
+                              coordinator_rank=self.coordinator_rank)
+        crashpoint(CP_GEN_STAGED)
+        proc = jax.process_index()
+        if jax.process_count() > 1:
+            _ckpt._host_barrier(_ckpt._next_barrier_tag(d + "/manifest"))
+        if proc == self.coordinator_rank:
+            self._write_manifest(d, step, user_data)
+            crashpoint(CP_MANIFEST)
+            # the COMMIT marker is the LAST durable act: its atomic rename
+            # is the single instant the generation starts to exist
+            _ckpt._atomic_write(os.path.join(d, COMMIT),
+                                f"{int(step)}\n".encode())
+            crashpoint(CP_COMMIT)
+            self._gc()
+            crashpoint(CP_GC)
+        if jax.process_count() > 1:
+            # readers on any host may rely on the commit being visible once
+            # their own save() returned
+            _ckpt._host_barrier(_ckpt._next_barrier_tag(d + "/commit"))
+
+    def _write_manifest(self, d: str, step: int, user_data: Optional[dict]):
+        files = {}
+        for name in sorted(os.listdir(d)):
+            if name in (MANIFEST, COMMIT) or name.endswith(".crc32") \
+                    or ".tmp." in name:
+                continue
+            path = os.path.join(d, name)
+            side = _ckpt._read_sidecar(path)
+            if side is not None:
+                crc, size = side
+                if os.stat(path).st_size != size:
+                    raise _ckpt.CheckpointCorruptionError(
+                        f"{path}: size disagrees with its sidecar — refusing "
+                        f"to commit a torn generation")
+            else:
+                crc, size = _ckpt._crc32_file(path)
+            files[name] = {"crc32": f"{crc:08x}", "size": size}
+        man = {"format": "paddle_tpu.ckpt_gen.v1", "step": int(step),
+               "files": files, "user_data": user_data or {}}
+        _ckpt._atomic_write(os.path.join(d, MANIFEST),
+                            json.dumps(man, indent=1, sort_keys=True).encode())
+
+    # ---- gc ----
+    def _gc(self):
+        committed = [s for s in self._scan() if self._committed_and_sound(s)]
+        if not committed:
+            return
+        newest = committed[-1]
+        doomed = committed[:-self.keep_last_k] if \
+            len(committed) > self.keep_last_k else []
+        for s in self._scan():
+            if s in doomed and s != newest:
+                shutil.rmtree(self.gen_dir(s), ignore_errors=True)
+            elif s < newest and not self._committed_and_sound(s):
+                # a dead writer's uncommitted leftovers; anything newer than
+                # the newest commit might be an IN-FLIGHT save and is spared
+                shutil.rmtree(self.gen_dir(s), ignore_errors=True)

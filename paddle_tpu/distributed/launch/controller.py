@@ -15,7 +15,15 @@ import sys
 import time
 from typing import List, Optional
 
+from ..chaos import crashpoint, register as _register_crashpoint
 from ..store import TCPStore
+
+# chaos sites: a launcher preempted around a pod restart must leave the
+# store's restart-generation machinery in a state peers can still follow
+CP_POD_STOPPING = _register_crashpoint(
+    "launch.pod_stopping", "restart decided, old ranks not yet stopped")
+CP_POD_RESPAWNED = _register_crashpoint(
+    "launch.pod_respawned", "new generation's ranks spawned")
 
 
 class _Proc:
@@ -131,6 +139,7 @@ class CollectiveController:
         sys.stderr.write(
             f"[launch] {reason}; restarting all local ranks "
             f"(generation {gen}, {self.pod_restarts}/{self.ctx.max_restart})\n")
+        crashpoint(CP_POD_STOPPING)
         self.stop(signal.SIGTERM)
         # A fresh coordination-service port per generation: the old service
         # (hosted inside old rank 0) is gone, and rebinding the same port
@@ -160,6 +169,7 @@ class CollectiveController:
         self.procs.clear()
         for local_rank in range(self.ctx.nproc_per_node):
             self._spawn(local_rank, restarts=self.pod_restarts)
+        crashpoint(CP_POD_RESPAWNED)
         return gen
 
     def watch(self, poll: float = 0.2) -> int:

@@ -168,3 +168,7 @@ class ElasticManager:
 
     def stop(self):
         self._stop.set()
+        # join like leave() does: a heartbeat thread past its _stop check
+        # would otherwise re-grant the lease one interval after stop(),
+        # keeping this node "alive" to observers for a full extra TTL
+        self._hb_thread.join(timeout=2 * self.interval + 5)

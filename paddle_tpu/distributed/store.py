@@ -10,15 +10,30 @@ is unavailable.
 """
 from __future__ import annotations
 
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Optional
 
 from ..utils import native
 
 _SET, _GET, _ADD, _WAIT, _DEL, _PING = 1, 2, 3, 4, 5, 6
 _LEASE, _LEASE_CHECK = 7, 8
+
+_BACKOFF_BASE = 0.02   # first retry delay (s)
+_BACKOFF_CAP = 1.0     # ceiling — a late-starting master costs at most 1s/poll
+
+
+def _backoff_delay(attempt: int) -> float:
+    """Bounded exponential backoff with full jitter. A fixed short poll
+    (the old 50ms sleep) synchronizes every connecting rank into thundering
+    retry herds against a master that is still binding; jitter decorrelates
+    them and the exponential cap bounds the tail."""
+    # exponent clamped so a very long wait can't overflow float conversion
+    exp = min(_BACKOFF_CAP, _BACKOFF_BASE * (2 ** min(attempt, 16)))
+    return random.uniform(_BACKOFF_BASE / 2, exp)
 
 
 class _PyStoreServer:
@@ -157,10 +172,27 @@ class TCPStore:
         addr = socket.gethostbyname(host) if host != "localhost" else "127.0.0.1"
         self._lib = lib
         if lib is not None:
-            self._client = lib.pt_store_client_new(addr.encode(), int(port),
-                                                   float(timeout))
-            if not self._client:
-                raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+            # transient-connect retry: non-master ranks race the master's
+            # bind; a refused connection inside the timeout window is
+            # expected startup noise, not an error
+            deadline = time.monotonic() + timeout
+            attempt = 0
+            while True:
+                # each attempt gets only the REMAINING budget (the native
+                # call may itself block polling until its deadline; handing
+                # it the full timeout every round could overshoot ~2x)
+                left = max(0.05, deadline - time.monotonic())
+                self._client = lib.pt_store_client_new(
+                    addr.encode(), int(port), float(left))
+                if self._client:
+                    break
+                if time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"TCPStore: cannot connect {host}:{port} "
+                        f"after {timeout:.0f}s")
+                time.sleep(min(_backoff_delay(attempt),
+                               max(0.0, deadline - time.monotonic())))
+                attempt += 1
         else:
             self._client = _PyClient(addr, int(port), timeout)
 
@@ -253,9 +285,9 @@ class TCPStore:
 
 class _PyClient:
     def __init__(self, addr: str, port: int, timeout: float):
-        import time
         deadline = time.monotonic() + timeout
         last = None
+        attempt = 0
         while time.monotonic() < deadline:
             try:
                 self._sock = socket.create_connection((addr, port), timeout=5)
@@ -267,7 +299,9 @@ class _PyClient:
                     return
             except OSError as e:
                 last = e
-                time.sleep(0.05)
+                time.sleep(min(_backoff_delay(attempt),
+                               max(0.0, deadline - time.monotonic())))
+                attempt += 1
         raise RuntimeError(f"TCPStore: cannot connect {addr}:{port}: {last}")
 
     def rpc(self, cmd: int, key: str, val: bytes = b""):

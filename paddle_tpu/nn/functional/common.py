@@ -155,8 +155,9 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
         else:
             spatial = v.shape[2:]
         if size is not None:
-            out_spatial = [int(s.item() if isinstance(s, Tensor) else s)
-                           for s in (size if isinstance(size, (list, tuple)) else [size])]
+            from ...ops._static_shape import static_int_list
+            out_spatial = static_int_list(
+                size if isinstance(size, (list, tuple)) else [size], "size")
         else:
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
                 else [scale_factor] * spatial_nd

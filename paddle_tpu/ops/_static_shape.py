@@ -1,0 +1,60 @@
+"""Trace-time-constant normalization for shape/axis/size arguments.
+
+Shapes, axes, split sizes and top-k counts must be PYTHON scalars at
+trace time — XLA compiles static shapes only (clean MXU tiling on TPU
+depends on it). The paddle-compatible API accepts Tensors for these
+arguments, so every op used to carry its own `.item()`/`.tolist()`
+normalization: 14 baselined host-sync findings, each an unaudited
+device->host round-trip. This module is now the ONE place that sync
+happens, with the two cases made explicit:
+
+- a CONCRETE tensor syncs, by design: turning it into a python int is the
+  documented contract of a shape/axis argument (the pragma'd lines below
+  are that deliberate, eager-only conversion);
+- a TRACED tensor cannot become a static shape at all — these helpers
+  raise a targeted TypeError naming the offending argument instead of
+  letting jax's ConcretizationTypeError surface three layers down.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _concrete(v, what: str):
+    """Unwrap to a concrete array-like; reject tracers with a usable error."""
+    if isinstance(v, Tensor):
+        v = v._value
+    if isinstance(v, jax.core.Tracer):
+        raise TypeError(
+            f"{what} must be a trace-time constant, got a traced value of "
+            f"shape {getattr(v, 'shape', ())}; pass a python int (or a "
+            f"concrete tensor) — a data-dependent {what} cannot compile to "
+            f"a static XLA shape")
+    return v
+
+
+def static_scalar(v, what: str = "size"):
+    """Python scalar (int stays int, float stays float) from a number or a
+    concrete 0-d tensor — the arange/linspace start/stop/step contract."""
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    return np.asarray(_concrete(v, what)).item()  # staticcheck: ok[host-sync] — the audited static-shape sync: concrete by contract, tracers rejected above
+
+
+def static_int(v, what: str = "size") -> int:
+    """Python int from a number or concrete 0-d tensor (axis, k, dim...)."""
+    return int(static_scalar(v, what))
+
+
+def static_int_list(xs, what: str = "shape") -> list:
+    """List of python ints from an int-vector tensor or a sequence whose
+    elements may themselves be 0-d tensors (paddle shape lists)."""
+    if isinstance(xs, Tensor) or hasattr(xs, "ndim"):
+        arr = np.asarray(_concrete(xs, what))  # staticcheck: ok[host-sync] — the audited static-shape sync: concrete by contract, tracers rejected above
+        return [int(x) for x in arr.reshape(-1)]
+    return [static_int(x, what) for x in xs]

@@ -7,6 +7,7 @@ import numpy as np
 
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor
+from ._static_shape import static_int, static_int_list, static_scalar
 from .dispatch import apply
 
 __all__ = [
@@ -18,11 +19,11 @@ __all__ = [
 
 
 def _norm_shape(shape):
-    if isinstance(shape, Tensor):
-        shape = shape.tolist()
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
-    return tuple(int(s) for s in shape)
+    if isinstance(shape, Tensor) and not shape.shape:
+        return (static_int(shape, "shape"),)
+    return tuple(static_int_list(shape, "shape"))
 
 
 def _resolve_dtype(dtype, data=None):
@@ -81,9 +82,9 @@ def empty(shape, dtype=None):
 
 
 def arange(start=0, end=None, step=1, dtype=None):
-    start = start.item() if isinstance(start, Tensor) else start
-    end = end.item() if isinstance(end, Tensor) else end
-    step = step.item() if isinstance(step, Tensor) else step
+    start = static_scalar(start, "arange start")
+    end = None if end is None else static_scalar(end, "arange end")
+    step = static_scalar(step, "arange step")
     if end is None:
         start, end = 0, start
     if dtype is None:
@@ -95,8 +96,8 @@ def arange(start=0, end=None, step=1, dtype=None):
 
 
 def linspace(start, stop, num, dtype=None):
-    start = start.item() if isinstance(start, Tensor) else start
-    stop = stop.item() if isinstance(stop, Tensor) else stop
+    start = static_scalar(start, "linspace start")
+    stop = static_scalar(stop, "linspace stop")
     dt = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
     return Tensor(jnp.linspace(start, stop, int(num), dtype=dt))
 

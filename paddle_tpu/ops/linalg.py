@@ -115,7 +115,7 @@ def eigh(x, UPLO="L"):
 
 
 def eigvals(x):
-    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)  # staticcheck: ok[host-sync] — XLA has no general eig; np fallback by design (same as eig above)
     return Tensor(jnp.asarray(np.linalg.eigvals(v)))
 
 

@@ -12,6 +12,7 @@ import numpy as np
 
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor
+from ._static_shape import static_int, static_int_list
 from .dispatch import apply
 
 __all__ = []
@@ -30,10 +31,11 @@ def _u(x):
 
 def _static_ints(x):
     if isinstance(x, Tensor):
-        x = x.tolist()
+        return static_int(x, "shape") if not x.shape \
+            else static_int_list(x, "shape")
     if isinstance(x, (int, np.integer)):
         return int(x)
-    return [int(v.item() if isinstance(v, Tensor) else v) for v in x]
+    return static_int_list(x, "shape")
 
 
 @_export
@@ -105,7 +107,7 @@ def unsqueeze(x, axis):
 @_export
 def concat(x, axis=0):
     tensors = list(x)
-    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    ax = static_int(axis, "axis")
     return apply(lambda *vs: jnp.concatenate(vs, axis=ax), *tensors, op_name="concat")
 
 
@@ -117,7 +119,7 @@ def stack(x, axis=0):
 
 @_export
 def split(x, num_or_sections, axis=0):
-    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    ax = static_int(axis, "axis")
     dim = (x.shape[ax] if isinstance(x, Tensor) else x.shape[ax])
     if isinstance(num_or_sections, int):
         sizes = [dim // num_or_sections] * num_or_sections
@@ -204,7 +206,7 @@ def rot90(x, k=1, axes=(0, 1)):
 
 @_export
 def gather(x, index, axis=0):
-    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    ax = static_int(axis, "axis")
     return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=ax), x, index,
                  op_name="gather")
 
@@ -344,7 +346,7 @@ def argsort(x, axis=-1, descending=False, name=None, stable=False):
 
 @_export
 def topk(x, k, axis=-1, largest=True, sorted=True):
-    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+    k = static_int(k, "k")
 
     def f(v):
         ax = axis % v.ndim

@@ -251,7 +251,9 @@ def _axis_arg(axis):
     if axis is None:
         return None
     if isinstance(axis, Tensor):
-        axis = axis.tolist()
+        from ._static_shape import static_int, static_int_list
+        return static_int(axis, "axis") if not axis.shape \
+            else tuple(static_int_list(axis, "axis"))
     if isinstance(axis, (list, tuple)):
         return tuple(int(a) for a in axis)
     return int(axis)

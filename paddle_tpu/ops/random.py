@@ -23,11 +23,12 @@ __all__ = [
 
 
 def _shape(shape):
-    if isinstance(shape, Tensor):
-        shape = shape.tolist()
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
-    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
+    from ._static_shape import static_int, static_int_list
+    if isinstance(shape, Tensor) and not shape.shape:
+        return (static_int(shape, "shape"),)
+    return tuple(static_int_list(shape, "shape"))
 
 
 def _dt(dtype):

@@ -23,6 +23,12 @@ from . import mesh as mesh_mod
 
 SHARD_STATE_MIN_SIZE = 1024  # don't bother sharding tiny states
 
+# dynamic loss scaling never grows past this: with tiny gradients the
+# overflow signal that normally bounds growth never fires, and an f32
+# scale doubled past ~1.7e38 becomes inf — unrecoverable (inf*decr_ratio
+# stays inf), silently skipping every subsequent step
+MAX_LOSS_SCALE = 2.0 ** 31
+
 
 def _param_sharding_spec(p, mesh):
     spec = getattr(p, "_sharding", None)
@@ -112,7 +118,7 @@ class TrainStep:
 
     def __init__(self, model, loss_fn: Callable, optimizer, mesh=None,
                  batch_spec=("dp",), loss_has_aux=False, remat: bool = False,
-                 accumulate_steps: Optional[int] = None):
+                 accumulate_steps: Optional[int] = None, scaler=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -191,7 +197,30 @@ class TrainStep:
 
         self._jitted = None
         self._grad_clip = getattr(base_opt, "_grad_clip", None)
-        self._loss_scale = 1.0
+
+        # ---- self-healing state (device-side; never host-synced in-step) --
+        # An amp.GradScaler supplies the dynamic-loss-scaling config; without
+        # one the step still computes the global grad-finite flag and skips
+        # the param/opt update on nan/inf. All of it lives in a small pytree
+        # of device scalars threaded through (and donated to) the compiled
+        # step, so a thousand skipped steps cost zero host round-trips.
+        self._scaler = scaler
+        self._use_scaling = bool(scaler is not None and scaler.is_enable())
+        self._dynamic_scaling = bool(
+            self._use_scaling and scaler.is_use_dynamic_loss_scaling())
+        init_scale = float(scaler._scale) if self._use_scaling else 1.0
+        self._scale_cfg = dict(
+            incr_ratio=float(getattr(scaler, "_incr_ratio", 2.0)),
+            decr_ratio=float(getattr(scaler, "_decr_ratio", 0.5)),
+            incr_every=int(getattr(scaler, "_incr_every", 1000)),
+            decr_every=int(getattr(scaler, "_decr_every", 1)),
+        )
+        self._health = {
+            "loss_scale": jnp.asarray(init_scale, jnp.float32),
+            "good_steps": jnp.asarray(0, jnp.int32),
+            "bad_steps": jnp.asarray(0, jnp.int32),
+            "skipped": jnp.asarray(0, jnp.int32),
+        }
 
     # ---- pure step ----
     def _build(self, example_inputs):
@@ -203,8 +232,13 @@ class TrainStep:
 
         acc = self._accumulate_steps
         mesh = self.mesh
+        use_scaling = self._use_scaling
+        dynamic = self._dynamic_scaling
+        cfg = self._scale_cfg
 
-        def pure_step(param_vals, opt_state, batch, lr, step, rng):
+        def pure_step(param_vals, opt_state, health, batch, lr, step, rng):
+            scale = health["loss_scale"]
+
             def loss_of(pv, mb, r):
                 saved = [p._value for p in params]
                 savedb = [b._value for b in buffers]
@@ -218,7 +252,12 @@ class TrainStep:
                         p._value = v
                     for b, v in zip(buffers, savedb):
                         b._value = v
-                return loss._value if isinstance(loss, Tensor) else loss
+                loss = loss._value if isinstance(loss, Tensor) else loss
+                if use_scaling:
+                    # scale INSIDE the differentiated fn so the backward pass
+                    # runs on scaled values (the point of loss scaling)
+                    loss = loss * scale.astype(loss.dtype)
+                return loss
 
             if acc > 1:
                 # gradient merge: scan over micro-steps, one live grad buffer
@@ -243,27 +282,76 @@ class TrainStep:
                 loss_val, grads = jax.value_and_grad(loss_of)(
                     param_vals, batch, rng)
 
+            if use_scaling:
+                inv = (1.0 / scale).astype(jnp.float32)
+                grads = [g * inv.astype(g.dtype) for g in grads]
+                loss_val = loss_val * inv.astype(loss_val.dtype)
+
+            # ---- self-healing: global grad-finite flag (no host sync) ----
+            # One scalar AND over every grad; on nan/inf the whole update is
+            # jnp.where-skipped below, so an overflowed step costs nothing
+            # but the wasted compute — params and opt state stay bit-exact.
+            finite = jnp.asarray(True)
+            for g in grads:
+                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+            # sanitize so clip/update math can't poison state with nan
+            # before the where-select discards it
+            grads = [jnp.where(finite, g, jnp.zeros_like(g)) for g in grads]
+
             if clip is not None:
                 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
                 if isinstance(clip, ClipGradByGlobalNorm):
                     gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                                       for g in grads))
-                    scale = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
-                    grads = [g * scale.astype(g.dtype) for g in grads]
+                    # NOT named `scale`: that binding is the loss scale the
+                    # dynamic-scaling update below reads
+                    clip_scale = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
+                    grads = [g * clip_scale.astype(g.dtype) for g in grads]
                 elif isinstance(clip, ClipGradByValue):
                     grads = [jnp.clip(g, clip.min, clip.max) for g in grads]
 
             new_vals, new_state = self._opt_update(
                 list(param_vals), list(grads), list(opt_state), lr, step)
-            return loss_val, new_vals, new_state
 
-        donate = (0, 1)
+            # skip the update on a non-finite step: select OLD values. The
+            # old buffers are donated, but donation aliases buffers at the
+            # XLA level — inside the program both operands of the select are
+            # ordinary values, so this is donation-safe.
+            new_vals = [jnp.where(finite, nv, ov)
+                        for nv, ov in zip(new_vals, param_vals)]
+            new_state = jax.tree_util.tree_map(
+                lambda ns, os_: jnp.where(finite, ns, os_),
+                list(new_state), list(opt_state))
+
+            ok = finite.astype(jnp.int32)
+            new_health = dict(health)
+            new_health["skipped"] = health["skipped"] + (1 - ok)
+            if dynamic:
+                # branchless GradScaler update (AmpScaler.update semantics):
+                # shrink after decr_every consecutive bad steps (floor 1.0),
+                # grow after incr_every consecutive good ones
+                good = jnp.where(finite, health["good_steps"] + 1, 0)
+                bad = jnp.where(finite, 0, health["bad_steps"] + 1)
+                grow = good >= cfg["incr_every"]
+                shrink = bad >= cfg["decr_every"]
+                new_scale = jnp.where(
+                    shrink, jnp.maximum(scale * cfg["decr_ratio"], 1.0),
+                    jnp.where(grow, jnp.minimum(scale * cfg["incr_ratio"],
+                                                MAX_LOSS_SCALE), scale))
+                new_health["loss_scale"] = new_scale
+                new_health["good_steps"] = jnp.where(grow, 0, good)
+                new_health["bad_steps"] = jnp.where(shrink, 0, bad)
+            return loss_val, new_vals, new_state, new_health
+
+        donate = (0, 1, 2)
         if self.mesh is not None:
             # structures must match the argument containers (lists of
-            # shardings / list of dicts), not tuples
+            # shardings / list of dicts), not tuples; the health scalars are
+            # replicated (None = no constraint)
             in_shardings = (
                 list(self._param_shardings),
                 [dict(s) for s in self._state_shardings],
+                None,
                 jax.tree_util.tree_map(
                     lambda v: self._batch_sharding(v.ndim), example_inputs,
                     is_leaf=lambda x: hasattr(x, "ndim")),
@@ -285,11 +373,30 @@ class TrainStep:
         step = jnp.asarray(self._step_count, jnp.int32)
         rng = gen.next_key()
         param_vals = [p._value for p in self._params]
-        loss, new_vals, self._opt_state = self._jitted(
-            param_vals, self._opt_state, batch_vals, lr, step, rng)
+        loss, new_vals, self._opt_state, self._health = self._jitted(
+            param_vals, self._opt_state, self._health, batch_vals, lr, step,
+            rng)
         for p, v in zip(self._params, new_vals):
             p._value = v
         return Tensor(loss)
+
+    # ---- self-healing telemetry (explicit host syncs, OUTSIDE the step) ----
+    @property
+    def skipped_steps(self) -> int:
+        """Steps whose update was skipped because a grad went nan/inf."""
+        return int(self._health["skipped"])
+
+    @property
+    def loss_scale(self) -> float:
+        """Current (device-side) dynamic loss scale."""
+        return float(self._health["loss_scale"])
+
+    def sync_scaler(self):
+        """Copy the device-side scale back into the attached GradScaler so
+        its state_dict()/checkpointing observes what the compiled path did."""
+        if self._scaler is not None and self._use_scaling:
+            self._scaler._scale = float(self._health["loss_scale"])
+        return self._scaler
 
     def lower_text(self, batch):
         """Compiler IR for inspection/debugging."""
@@ -313,11 +420,18 @@ class TrainStep:
         step = jnp.asarray(1, jnp.int32)
         rng = gen.next_key()
         param_vals = [p._value for p in self._params]
-        return self._jitted.lower(param_vals, self._opt_state, batch_vals,
-                                  lr, step, rng).compile().memory_analysis()
+        return self._jitted.lower(param_vals, self._opt_state, self._health,
+                                  batch_vals, lr, step,
+                                  rng).compile().memory_analysis()
 
 
 def compile_train_step(model, loss_fn, optimizer, mesh=None, **kw) -> TrainStep:
     """loss_fn(model, batch) -> scalar loss Tensor. Returns a TrainStep whose
-    __call__(batch) runs one fully-compiled step and returns the loss."""
+    __call__(batch) runs one fully-compiled step and returns the loss.
+
+    Pass `scaler=amp.GradScaler(...)` to run dynamic loss scaling inside the
+    compiled step (scale/unscale, skip-on-overflow, backoff/growth — all
+    device-side, no host sync). Even without a scaler the step self-heals:
+    a nan/inf gradient skips that update (params/opt state bit-exact) and
+    increments `step.skipped_steps`."""
     return TrainStep(model, loss_fn, optimizer, mesh=mesh, **kw)

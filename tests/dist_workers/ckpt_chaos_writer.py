@@ -1,0 +1,44 @@
+"""Crash-matrix writer payload (tests/test_ckpt_chaos.py).
+
+argv: out_dir — commit generation step-1, then attempt generation step-2.
+The parent arms ONE crash site through the child's environment:
+
+    PT_CRASHPOINT=ckpt.<site>  PT_CRASHPOINT_HITS=2
+
+Every ckpt.* site fires exactly once per save in this single-process,
+single-shard job, so hit #1 lands in the (allowed-to-complete) step-1 save
+and hit #2 SIGKILLs the writer mid-step-2 — at the armed site. The parent
+then proves a fresh reader recovers the last COMMITTED generation.
+
+Deterministic content: parameter values are functions of the step, so the
+parent can tell exactly which generation a restore produced.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed.ckpt_manager import CheckpointManager  # noqa: E402
+
+out_dir = sys.argv[1]
+
+
+def state_for(step: int) -> dict:
+    return {"w": np.full((8, 8), float(step), np.float32),
+            "b": (np.arange(6, dtype=np.float32) + 1) * step}
+
+
+mgr = CheckpointManager(os.path.join(out_dir, "ckpt"), keep_last_k=2)
+mgr.save(state_for(1), 1)
+mgr.save(state_for(2), 2)   # dies at the armed crash site (hit #2)
+
+# reachable only if the armed site never fired twice — the matrix treats
+# a surviving writer as a broken crashpoint wiring, not a pass
+with open(os.path.join(out_dir, "survived"), "w") as f:
+    f.write(os.environ.get("PT_CRASHPOINT", "?"))

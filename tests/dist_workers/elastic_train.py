@@ -2,7 +2,9 @@
 reference fleet/elastic/manager.py ETCD-lease liveness + whole-job restart).
 
 argv: out_dir n_steps.  A 2-rank dp job; rank 1 SIGKILLs itself mid-step
-once; the relaunched generation resumes from the sharded checkpoint.
+once; the relaunched generation resumes from the newest COMMITTED
+checkpoint generation (CheckpointManager — a kill mid-save can only leave
+an uncommitted step-N dir, which restore skips).
 Writes done{rank}.json with the resume point and the post-resume losses.
 """
 import json
@@ -14,7 +16,7 @@ import numpy as np
 
 import paddle_tpu as P
 import paddle_tpu.distributed as dist
-import paddle_tpu.distributed.checkpoint as dck
+from paddle_tpu.distributed.ckpt_manager import CheckpointManager
 
 out_dir = sys.argv[1]
 n_steps = int(sys.argv[2])
@@ -28,13 +30,11 @@ P.seed(0)
 model = P.nn.Linear(8, 4)
 opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
 
-start = 0
-meta = os.path.join(ckpt, "step.json")
-if os.path.exists(meta):
-    with open(meta) as f:
-        start = json.load(f)["step"]
+mgr = CheckpointManager(ckpt, keep_last_k=2)
+start = mgr.latest() or 0
+if start:
     state = {"params": {n: p._value for n, p in model.named_parameters()}}
-    dck.load_state_dict(state, ckpt)
+    mgr.restore(state, start)
     for n, p in model.named_parameters():
         p._set_value(state["params"][n])
 
@@ -51,14 +51,10 @@ for step in range(n_steps):
     opt.clear_grad()
     losses.append(float(loss.numpy()))
 
-    dck.save_state_dict(
-        {"params": {n: p._value for n, p in model.named_parameters()}}, ckpt)
-    dck.wait()
-    dist.barrier()
-    if rank == 0:
-        with open(meta, "w") as f:
-            json.dump({"step": step + 1}, f)
-    dist.barrier()
+    # one generation per step; COMMIT (inside save) is the durability point,
+    # so a kill landing anywhere in here costs at most one step of progress
+    mgr.save({"params": {n: p._value for n, p in model.named_parameters()}},
+             step + 1)
 
     # FAULT: rank 1 dies hard mid-run, once
     if rank == 1 and step == 1 and not os.path.exists(kill_marker):

@@ -1,0 +1,47 @@
+"""key-reuse known-answer fixture (AST-only, never imported).
+
+Each function is one case asserted by tests/test_staticcheck.py: two
+positive reuses, the safe split-and-rebind idiom, mutually-exclusive
+branches, and a pragma suppression.
+"""
+import jax
+
+
+def reuse_same_key(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))       # key-reuse: second draw
+    return a + b
+
+
+def split_then_reuse_original():
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    c = jax.random.normal(key, (2,))        # key-reuse: key already split
+    return k1, k2, c
+
+
+def fresh_subkeys_ok():
+    key = jax.random.PRNGKey(0)
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (2,))
+    key, sub = jax.random.split(key)        # rebound: not a reuse
+    b = jax.random.normal(sub, (2,))
+    return a + b
+
+
+def branch_exclusive_ok(key, flag):
+    if flag:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))    # sibling arm: never both taken
+
+
+def suppressed_reuse(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.normal(key, (4,))  # staticcheck: ok[key-reuse] — fixture: pragma-suppressed on purpose
+    return a + b
+
+
+def non_random_jax_call_ok(key):
+    key = jax.device_put(key)               # not jax.random: no consumption
+    jax.block_until_ready(key)
+    return jax.random.normal(key, (2,))     # first (only) draw: quiet

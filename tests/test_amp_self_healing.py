@@ -1,0 +1,192 @@
+"""Self-healing compiled train step + in-step dynamic loss scaling.
+
+The laws under test (ISSUE 3 tentpole, leg 2):
+- a nan/inf gradient SKIPS that update: the skipped-step counter increments
+  and params/opt state stay bit-identical to pre-step;
+- steps after the skip match an uninterrupted run exactly (the poisoned
+  step has no residue);
+- amp.GradScaler's backoff/growth runs INSIDE the jitted step — the scale
+  halves on overflow and grows after N good steps without any host sync or
+  recompilation — and a scaled run converges like an unscaled one.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.parallel.trainer import compile_train_step
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _loss_fn(m, b):
+    return P.nn.functional.mse_loss(m(b["x"]), b["y"])
+
+
+def _make_step(scaler=None, acc=None, seed=3):
+    P.seed(seed)
+    model = P.nn.Linear(8, 4)
+    opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = compile_train_step(model, _loss_fn, opt, accumulate_steps=acc,
+                              scaler=scaler)
+    return model, step
+
+
+def _batch(seed, nan=False, batch=4):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(batch, 8).astype(np.float32)
+    if nan:
+        x[0, 0] = np.nan
+    y = rng.randn(batch, 4).astype(np.float32)
+    return {"x": P.to_tensor(x), "y": P.to_tensor(y)}
+
+
+def _params(model):
+    return {n: np.asarray(p._value) for n, p in model.named_parameters()}
+
+
+def test_nan_grad_skips_step_params_bit_identical():
+    model, step = _make_step()
+    step(_batch(0))
+    before = _params(model)
+    state_before = [
+        {k: np.asarray(v) for k, v in st.items()} for st in step._opt_state]
+
+    loss = step(_batch(1, nan=True))
+    assert not np.isfinite(float(loss.numpy()))
+    assert step.skipped_steps == 1
+    after = _params(model)
+    for n in before:
+        np.testing.assert_array_equal(
+            after[n], before[n],
+            err_msg=f"param {n} changed on a skipped (nan-grad) step")
+    for st_a, st_b in zip(step._opt_state, state_before):
+        for k in st_b:
+            np.testing.assert_array_equal(np.asarray(st_a[k]), st_b[k])
+
+    # a later clean step applies normally
+    step(_batch(2))
+    assert step.skipped_steps == 1
+    assert any(not np.array_equal(_params(model)[n], before[n])
+               for n in before)
+
+
+def test_post_skip_steps_match_uninterrupted_run():
+    model_a, step_a = _make_step(seed=5)
+    step_a(_batch(0))
+    step_a(_batch(1, nan=True))          # skipped
+    step_a(_batch(2))
+    step_a(_batch(3))
+
+    model_b, step_b = _make_step(seed=5)
+    step_b(_batch(0))
+    step_b(_batch(2))
+    step_b(_batch(3))
+
+    pa, pb = _params(model_a), _params(model_b)
+    for n in pa:
+        np.testing.assert_array_equal(
+            pa[n], pb[n],
+            err_msg=f"poisoned step left residue in {n}")
+    assert step_a.skipped_steps == 1 and step_b.skipped_steps == 0
+
+
+def test_gradscaler_backoff_and_growth_inside_compiled_step():
+    scaler = GradScaler(init_loss_scaling=1024.0, incr_ratio=2.0,
+                        decr_ratio=0.5, incr_every_n_steps=2,
+                        decr_every_n_nan_or_inf=1)
+    model, step = _make_step(scaler=scaler)
+
+    step(_batch(0))
+    assert step.loss_scale == 1024.0      # 1 good step: no growth yet
+    step(_batch(1))
+    assert step.loss_scale == 2048.0      # growth after incr_every=2
+    jitted = step._jitted
+
+    step(_batch(2, nan=True))             # overflow: backoff + skip
+    assert step.loss_scale == 1024.0
+    assert step.skipped_steps == 1
+    assert step._jitted is jitted         # same compiled program throughout
+
+    # good-step streak restarts after the overflow
+    step(_batch(3))
+    assert step.loss_scale == 1024.0
+    step(_batch(4))
+    assert step.loss_scale == 2048.0
+
+    # device-side scale flows back into the scaler object on request
+    step.sync_scaler()
+    assert scaler._scale == 2048.0
+
+
+def test_loss_scale_growth_is_capped():
+    """With tiny gradients the overflow signal never bounds growth — the
+    scale must saturate at MAX_LOSS_SCALE, not double its way to inf
+    (inf is unrecoverable: every later step would skip forever)."""
+    from paddle_tpu.parallel.trainer import MAX_LOSS_SCALE
+
+    scaler = GradScaler(init_loss_scaling=MAX_LOSS_SCALE / 4,
+                        incr_every_n_steps=1)
+    model, step = _make_step(scaler=scaler)
+    for i in range(5):   # uncapped this would reach MAX*8
+        step(_batch(i))
+    assert step.loss_scale == MAX_LOSS_SCALE
+    assert step.skipped_steps == 0   # scaled grads stayed finite
+
+
+def test_scaled_run_matches_unscaled_run():
+    """Scale/unscale must be value-neutral on finite data — even across a
+    growth event — so a scaled run's losses and params track an unscaled
+    run's to fp tolerance."""
+    scaler = GradScaler(init_loss_scaling=256.0, incr_every_n_steps=2)
+    model_s, step_s = _make_step(scaler=scaler, seed=9)
+    model_u, step_u = _make_step(seed=9)
+
+    for i in range(5):
+        ls = float(step_s(_batch(i)).numpy())
+        lu = float(step_u(_batch(i)).numpy())
+        np.testing.assert_allclose(ls, lu, rtol=1e-5)
+    assert step_s.loss_scale > 256.0      # growth actually happened
+    ps, pu = _params(model_s), _params(model_u)
+    for n in ps:
+        np.testing.assert_allclose(ps[n], pu[n], rtol=1e-5, atol=1e-6)
+
+
+def test_loss_scale_unaffected_by_global_norm_clip():
+    """Regression: the clip branch's grad-rescale factor must not leak into
+    the dynamic loss-scale update (a `scale` name collision once collapsed
+    the loss scale to the clip ratio on every step)."""
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+    scaler = GradScaler(init_loss_scaling=4096.0, incr_every_n_steps=100)
+    P.seed(13)
+    model = P.nn.Linear(8, 4)
+    opt = P.optimizer.SGD(learning_rate=0.1, parameters=model.parameters(),
+                          grad_clip=ClipGradByGlobalNorm(0.01))
+    step = compile_train_step(model, _loss_fn, opt, scaler=scaler)
+    for i in range(3):
+        step(_batch(i))
+    # no overflow, incr_every not reached: the scale must still be the init
+    assert step.loss_scale == 4096.0
+    # and a nan step still halves it from there, not from the clip ratio
+    step(_batch(9, nan=True))
+    assert step.loss_scale == 2048.0 and step.skipped_steps == 1
+
+
+def test_nan_in_one_microbatch_skips_whole_accumulated_step():
+    """Gradient merge: the finite flag is computed over the MERGED grads, so
+    one poisoned micro-batch skips the whole accumulated update."""
+    model, step = _make_step(acc=2)
+    step(_batch(0, batch=8))
+    before = _params(model)
+    step(_batch(1, nan=True, batch=8))    # nan lands in micro-batch 0
+    assert step.skipped_steps == 1
+    after = _params(model)
+    for n in before:
+        np.testing.assert_array_equal(after[n], before[n])

@@ -1,0 +1,223 @@
+"""Crash-point fault-injection matrix for the durable checkpoint layer.
+
+For EVERY crash site registered in the save/commit path, a writer child
+commits generation step-1, then is SIGKILLed at the armed site inside the
+step-2 save (PT_CRASHPOINT env + PT_CRASHPOINT_HITS=2 — see
+dist_workers/ckpt_chaos_writer.py). The reader-side law under test:
+
+    CheckpointManager.latest() + restore() always recover the newest
+    COMMITTED generation — step-1 for any kill before the COMMIT marker
+    rename, step-2 at-or-after it — and never torn bytes.
+
+Corruption that a kill cannot produce (bit flips on committed data) is
+injected directly: checksum verification must reject it with the typed
+CheckpointCorruptionError, never silently load it.
+"""
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed import checkpoint as ckpt  # noqa: F401 — registers ckpt.* sites
+from paddle_tpu.distributed.checkpoint import CheckpointCorruptionError
+from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WRITER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "dist_workers", "ckpt_chaos_writer.py")
+
+# expected surviving generation per kill site: COMMIT's atomic rename is the
+# durability point, so everything upstream of it loses step-2 and everything
+# at-or-after it keeps step-2
+EXPECTED_LATEST = {
+    "ckpt.shard_tmp_written": 1,
+    "ckpt.shard_renamed": 1,
+    "ckpt.sidecar_written": 1,
+    "ckpt.metadata_tmp_written": 1,
+    "ckpt.metadata_written": 1,
+    "ckpt.generation_staged": 1,
+    "ckpt.manifest_written": 1,
+    "ckpt.commit_written": 2,
+    "ckpt.gc_done": 2,
+}
+
+
+def _state_for(step):
+    return {"w": np.full((8, 8), float(step), np.float32),
+            "b": (np.arange(6, dtype=np.float32) + 1) * step}
+
+
+def test_matrix_covers_every_registered_site():
+    """Adding a crashpoint() to the save path must widen this matrix: an
+    unmapped registered site fails here until EXPECTED_LATEST says which
+    generation survives a kill there."""
+    assert set(chaos.registered_sites("ckpt.")) == set(EXPECTED_LATEST)
+
+
+def test_crash_matrix_recovers_last_committed_generation(tmp_path):
+    """SIGKILL the writer at every registered ckpt.* site (concurrently);
+    a fresh reader must land on the expected committed generation with
+    bit-exact content."""
+    env_base = dict(os.environ,
+                    PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""),
+                    PT_CRASHPOINT_HITS="2")
+    children = {}
+    for site in sorted(EXPECTED_LATEST):
+        out_dir = tmp_path / site.replace(".", "_")
+        out_dir.mkdir()
+        env = dict(env_base, PT_CRASHPOINT=site)
+        children[site] = (out_dir, subprocess.Popen(
+            [sys.executable, WRITER, str(out_dir)], cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True))
+
+    for site, (out_dir, proc) in children.items():
+        _, err = proc.communicate(timeout=240)
+        assert proc.returncode == -signal.SIGKILL, (
+            f"{site}: writer was supposed to die by SIGKILL at the armed "
+            f"site, got rc={proc.returncode}\n{err[-2000:]}")
+        assert not (out_dir / "survived").exists(), (
+            f"{site}: writer ran past the armed crash site")
+
+        want = EXPECTED_LATEST[site]
+        mgr = CheckpointManager(str(out_dir / "ckpt"))
+        got = mgr.latest()
+        assert got == want, (
+            f"{site}: latest() -> {got}, want committed generation {want} "
+            f"(dir: {sorted(os.listdir(out_dir / 'ckpt'))})")
+        state = {"w": np.zeros((8, 8), np.float32),
+                 "b": np.zeros(6, np.float32)}
+        assert mgr.restore(state) == want
+        expect = _state_for(want)
+        np.testing.assert_array_equal(state["w"], expect["w"],
+                                      err_msg=f"{site}: torn 'w' restored")
+        np.testing.assert_array_equal(state["b"], expect["b"],
+                                      err_msg=f"{site}: torn 'b' restored")
+
+
+def test_corrupted_committed_shard_rejected_not_loaded(tmp_path):
+    """Bit-flip a committed generation's shard: restore must raise the typed
+    CheckpointCorruptionError (checksum mismatch), and the previous
+    generation must still restore cleanly."""
+    mgr = CheckpointManager(str(tmp_path / "c"), keep_last_k=2)
+    mgr.save(_state_for(1), 1)
+    mgr.save(_state_for(2), 2)
+
+    shard = os.path.join(mgr.gen_dir(2), "shard-0.npz")
+    data = bytearray(open(shard, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(shard, "wb") as f:
+        f.write(bytes(data))
+
+    fresh = {"w": np.zeros((8, 8), np.float32), "b": np.zeros(6, np.float32)}
+    with pytest.raises(CheckpointCorruptionError):
+        mgr.restore(dict(fresh), 2)
+    # the intact older generation is still a valid fallback
+    state = dict(fresh)
+    assert mgr.restore(state, 1) == 1
+    np.testing.assert_array_equal(state["w"], _state_for(1)["w"])
+
+
+def test_flat_checkpoint_corruption_detected(tmp_path):
+    """The hardened base layer (save_state_dict/load_state_dict) detects a
+    torn shard via its CRC32 sidecar even without the manager."""
+    import paddle_tpu.distributed as dist
+
+    d = str(tmp_path / "flat")
+    dist.save_state_dict(_state_for(3), d)
+    shard = os.path.join(d, "shard-0.npz")
+    data = bytearray(open(shard, "rb").read())
+    data[10] ^= 0x55
+    with open(shard, "wb") as f:
+        f.write(bytes(data))
+    with pytest.raises(CheckpointCorruptionError):
+        dist.load_state_dict(_state_for(3), d)
+
+
+def test_garbled_sidecar_raises_typed_error(tmp_path):
+    """A torn checksum SIDECAR is the same corruption class as a torn shard:
+    restore must raise CheckpointCorruptionError (not ValueError) so
+    fall-back-to-older-generation handlers keep working."""
+    mgr = CheckpointManager(str(tmp_path / "c"), keep_last_k=2)
+    mgr.save(_state_for(1), 1)
+    with open(os.path.join(mgr.gen_dir(1), "shard-0.npz.crc32"), "w") as f:
+        f.write("not hex garbage\x00")
+    with pytest.raises(CheckpointCorruptionError, match="sidecar"):
+        mgr.restore({"w": np.zeros((8, 8), np.float32),
+                     "b": np.zeros(6, np.float32)}, 1)
+
+
+def test_lost_sidecars_fall_back_to_manifest_crcs(tmp_path):
+    """Tooling that drops *.crc32 sidecars (rsync patterns, object-store
+    sync) must not disable verification: restore falls back to the CRCs
+    committed in manifest.json and still rejects a bit-flipped shard."""
+    import glob
+
+    mgr = CheckpointManager(str(tmp_path / "c"), keep_last_k=2)
+    mgr.save(_state_for(1), 1)
+    for sc in glob.glob(os.path.join(mgr.gen_dir(1), "*.crc32")):
+        os.remove(sc)
+    # intact files still restore fine without sidecars...
+    state = {"w": np.zeros((8, 8), np.float32), "b": np.zeros(6, np.float32)}
+    assert mgr.restore(state, 1) == 1
+    np.testing.assert_array_equal(state["w"], _state_for(1)["w"])
+    # ...but a flipped byte is caught by the manifest CRC
+    shard = os.path.join(mgr.gen_dir(1), "shard-0.npz")
+    data = bytearray(open(shard, "rb").read())
+    data[len(data) // 3] ^= 0x0F
+    with open(shard, "wb") as f:
+        f.write(bytes(data))
+    with pytest.raises(CheckpointCorruptionError, match="manifest"):
+        mgr.restore(dict(state), 1)
+
+
+def test_latest_skips_uncommitted_and_unsound_generations(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"), keep_last_k=3)
+    mgr.save(_state_for(1), 1)
+    # a dead writer's uncommitted leftovers at a NEWER step
+    os.makedirs(mgr.gen_dir(9), exist_ok=True)
+    with open(os.path.join(mgr.gen_dir(9), "shard-0.npz"), "wb") as f:
+        f.write(b"half a shar")
+    assert mgr.latest() == 1
+    # a committed generation whose file was truncated after commit is unsound
+    mgr.save(_state_for(5), 5)
+    shard = os.path.join(mgr.gen_dir(5), "shard-0.npz")
+    with open(shard, "wb") as f:
+        f.write(b"stub")
+    assert mgr.latest() == 1
+
+
+def test_gc_keeps_last_k_and_reaps_dead_attempts(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"), keep_last_k=2)
+    mgr.save(_state_for(1), 1)
+    # fake an uncommitted older attempt, then commit two more generations
+    os.makedirs(mgr.gen_dir(2), exist_ok=True)
+    with open(os.path.join(mgr.gen_dir(2), "junk"), "w") as f:
+        f.write("dead writer droppings")
+    mgr.save(_state_for(3), 3)
+    mgr.save(_state_for(4), 4)
+    assert mgr.all_steps() == [3, 4]
+    assert not os.path.exists(mgr.gen_dir(1))   # beyond keep_last_k
+    assert not os.path.exists(mgr.gen_dir(2))   # dead uncommitted attempt
+    with pytest.raises(ValueError):
+        CheckpointManager(str(tmp_path / "c2"), keep_last_k=0)
+
+
+def test_manager_async_save_commits_and_reraises_once(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"), keep_last_k=2)
+    mgr.save(_state_for(7), 7, async_save=True)
+    mgr.wait()
+    assert mgr.latest() == 7
+    # failure path: a file squatting on the generation dir name makes the
+    # background writer die; wait() must re-raise exactly once and clear
+    # the pending slot
+    with open(mgr.gen_dir(8), "w") as f:
+        f.write("not a directory")
+    mgr.save(_state_for(8), 8, async_save=True)
+    with pytest.raises(RuntimeError, match="async checkpoint generation"):
+        mgr.wait()
+    mgr.wait()                     # second wait: error already consumed
+    assert mgr.latest() == 7       # step-8 never committed

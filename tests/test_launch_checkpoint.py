@@ -89,6 +89,36 @@ def test_checkpoint_async_save(tmp_path):
     np.testing.assert_allclose(model2.weight.numpy(), w0)
 
 
+def test_checkpoint_async_save_failure_reraised_exactly_once(tmp_path,
+                                                             monkeypatch):
+    """A background-writer exception must surface in wait() — once — and
+    leave the module ready for the next save (pending slot + error cleared,
+    no metadata.json announcing the failed checkpoint)."""
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    model = nn.Linear(4, 4)
+
+    def boom(*a, **k):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(ckpt, "_atomic_write", boom)
+    ckpt.save_state_dict(model.state_dict(), str(tmp_path / "c"),
+                         async_save=True)
+    with pytest.raises(RuntimeError, match="async checkpoint save") as ei:
+        ckpt.wait()
+    assert isinstance(ei.value.__cause__, OSError)
+    assert ckpt._pending is None and ckpt._pending_error is None
+    ckpt.wait()      # second wait: no re-raise, error consumed
+    assert not (tmp_path / "c" / "metadata.json").exists()
+
+    # the module recovered: the next async save succeeds end to end
+    monkeypatch.undo()
+    ckpt.save_state_dict(model.state_dict(), str(tmp_path / "c"),
+                         async_save=True)
+    ckpt.wait()
+    assert (tmp_path / "c" / "metadata.json").exists()
+
+
 def test_checkpoint_optimizer_state(tmp_path):
     """Nested optimizer state dicts round-trip (list/dict trees)."""
     model = nn.Linear(4, 4)

@@ -44,7 +44,7 @@ def test_all_rules_fire_on_fixtures(fixture_findings):
     rules = {f.rule for f in fixture_findings}
     assert rules >= {"tracer-branch", "numpy-on-tracer", "host-sync",
                      "registry-consistency", "mutable-global",
-                     "dead-export"}, rules
+                     "dead-export", "key-reuse"}, rules
     assert len(rules) >= 5  # the acceptance floor, trivially exceeded
 
 
@@ -88,6 +88,21 @@ def test_mutable_global_installer_sanctioned(fixture_findings):
 def test_dead_export_detected(fixture_findings):
     de = [f for f in fixture_findings if f.rule == "dead-export"]
     assert [f.context for f in de] == ["ghost_export"]
+
+
+def test_key_reuse_known_answers(fixture_findings):
+    """key_hazards.py: the two positive reuses fire (same-key double draw,
+    draw off an already-split key); the split-and-rebind idiom, mutually
+    exclusive branches, and the pragma'd copy stay quiet."""
+    kr = [f for f in fixture_findings if f.rule == "key-reuse"]
+    assert all(f.path == "paddle_tpu/ops/key_hazards.py" for f in kr), kr
+    assert {f.line for f in kr} == {12, 19}, kr
+    assert all(f.severity == "warning" for f in kr)
+    # and no OTHER rule trips over the key fixture (it is hazard-free
+    # apart from the deliberate key reuses)
+    others = [f for f in fixture_findings
+              if f.path.endswith("key_hazards.py") and f.rule != "key-reuse"]
+    assert others == [], others
 
 
 # ---------------- pragma suppression ----------------

@@ -2,6 +2,7 @@
 from . import (  # noqa: F401
     dead_export,
     host_sync,
+    key_reuse,
     mutable_global,
     numpy_on_tracer,
     registry_consistency,

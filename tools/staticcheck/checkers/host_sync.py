@@ -21,7 +21,7 @@ HOT_PATH_PREFIXES = (
 )
 _SYNC_METHODS = {"item", "tolist"}
 _NUMPY_ROOTS = {"np", "numpy", "_np"}
-_UNWRAP_CALLS = {"_u", "_unwrap", "_v"}
+_UNWRAP_CALLS = {"_u", "_unwrap", "_v", "_concrete"}
 
 
 def _mentions_tensor_value(node: ast.AST) -> bool:

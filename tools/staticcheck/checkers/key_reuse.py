@@ -1,0 +1,223 @@
+"""key-reuse: the same jax.random key consumed twice.
+
+JAX PRNG keys are single-use values: every draw must come off a FRESH key
+(`key, sub = jax.random.split(key)`); feeding the same key to two
+`jax.random.*` calls — or using a key again after splitting it — yields
+correlated "random" numbers that silently wreck initialization and
+dropout independence. The deferred ROADMAP rule, now implemented.
+
+Heuristic, per scope (function body or module top level), in source order:
+- a name becomes a KEY when it is assigned from a producer call
+  (`jax.random.key/PRNGKey/split/fold_in/clone`, `next_key()`) or is a
+  parameter with a key-like name (`key`, `rng`, `*_key`);
+- passing a key as the first positional argument (or `key=` keyword) of a
+  `jax.random.*` call CONSUMES it — including `split`/`fold_in` (using the
+  parent key after splitting it is the classic form of this bug);
+- rebinding the name un-consumes it;
+- two consuming uses in SIBLING branches of the same `if` are mutually
+  exclusive and never flagged; a use whose branch path is a prefix of the
+  other's (same straight line, or one nested under the other) is.
+
+Uses inside loop bodies appear once to this linear scan, so a key consumed
+once per iteration without rebinding is not caught — fold_in with the loop
+index (the repo idiom) is the fix for those sites anyway.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name
+from ..core import Checker, Module, register
+
+_PRODUCER_NAMES = {"key", "PRNGKey", "split", "fold_in", "clone", "next_key",
+                   "wrap_key_data"}
+_KEYISH_PARAMS = ("key", "rng")
+
+
+def _is_random_call(node: ast.Call) -> bool:
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        # bare next_key() / key_override-style helpers
+        return isinstance(f, ast.Name) and f.id in ("next_key",)
+    # must be the jax.random NAMESPACE, not just anything rooted at `jax`:
+    # jax.device_put(key) / jax.vmap(f)(key) do not consume the key
+    chain = []
+    cur = f
+    while isinstance(cur, ast.Attribute):
+        chain.append(cur.attr)
+        cur = cur.value
+    root = cur.id if isinstance(cur, ast.Name) else None
+    if root == "jax":
+        return len(chain) >= 2 and chain[-1] == "random"
+    # `import jax.random as X` aliases: X.split / X.normal
+    return root in ("random", "jrandom", "jr") and len(chain) == 1
+
+
+def _is_producer(node: ast.Call) -> bool:
+    return _is_random_call(node) and call_name(node) in _PRODUCER_NAMES
+
+
+def _consumed_key_arg(node: ast.Call):
+    """The ast.Name this jax.random call consumes as its key, if any."""
+    if not _is_random_call(node) or call_name(node) in ("key", "PRNGKey"):
+        return None  # seed-int producers consume no key
+    if node.args and isinstance(node.args[0], ast.Name):
+        return node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Name):
+            return kw.value
+    return None
+
+
+def _branch_path(node: ast.AST, scope: ast.AST) -> tuple:
+    """(id(if_node), arm) pairs from `scope` down to `node` — two uses
+    conflict only when one path is a prefix of the other (mutually
+    exclusive if/else arms are not both taken)."""
+    path = []
+    cur = node
+    while cur is not None and cur is not scope:
+        parent = getattr(cur, "_sc_parent", None)
+        if isinstance(parent, (ast.If, ast.Try)):
+            for arm in ("body", "orelse", "handlers", "finalbody"):
+                block = getattr(parent, arm, None)
+                if isinstance(block, list) and cur in block:
+                    path.append((id(parent), arm))
+                    break
+        cur = parent
+    return tuple(reversed(path))
+
+
+_TERMINATORS = (ast.Return, ast.Raise, ast.Continue, ast.Break)
+
+
+def _arm_terminates(owner: ast.AST, arm: str) -> bool:
+    """Does this if/try arm end in return/raise/continue/break? If so, code
+    AFTER the statement is mutually exclusive with the arm's interior."""
+    block = getattr(owner, arm, None)
+    if not isinstance(block, list) or not block:
+        return False
+    last = block[-1]
+    return isinstance(last, _TERMINATORS)
+
+
+def _conflicting(prev_path: tuple, new_path: tuple,
+                 owners: dict[int, ast.AST]) -> bool:
+    """prev (earlier in source) and new conflict unless control flow makes
+    them mutually exclusive: sibling arms of one if, or prev inside an arm
+    that terminates before new's straight-line position."""
+    common = 0
+    while common < len(prev_path) and common < len(new_path) \
+            and prev_path[common] == new_path[common]:
+        common += 1
+    if common < len(prev_path) and common < len(new_path):
+        return False  # diverge into sibling arms: never both taken
+    if common == len(prev_path):
+        return True   # prev dominates new (same line of flow, or new nested)
+    # prev is deeper: reaching new means prev's arm exited or wasn't taken
+    owner_id, arm = prev_path[common]
+    return not _arm_terminates(owners.get(owner_id), arm)
+
+
+def _assigned_names(stmt: ast.AST):
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    out = []
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.append(n)
+    return out
+
+
+@register
+class KeyReuseChecker(Checker):
+    rule = "key-reuse"
+    severity = "warning"
+
+    def check_module(self, mod: Module):
+        scopes = [mod.tree]
+        scopes += [n for n in ast.walk(mod.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from self._check_scope(mod, scope)
+
+    def _check_scope(self, mod: Module, scope: ast.AST):
+        own_fns = {id(n) for n in ast.walk(scope)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and n is not scope} if not isinstance(scope, ast.Module) \
+            else {id(n) for n in ast.walk(scope)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+        def nodes_of(kind):
+            for n in ast.walk(scope):
+                if not isinstance(n, kind) or n is scope:
+                    continue
+                # stay in THIS scope: skip anything inside a nested function
+                cur = getattr(n, "_sc_parent", None)
+                nested = False
+                while cur is not None and cur is not scope:
+                    if id(cur) in own_fns:
+                        nested = True
+                        break
+                    cur = getattr(cur, "_sc_parent", None)
+                if not nested:
+                    yield n
+
+        owners = {id(n): n for n in ast.walk(scope)
+                  if isinstance(n, (ast.If, ast.Try))}
+
+        keys: set[str] = set()
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for a in scope.args.posonlyargs + scope.args.args \
+                    + scope.args.kwonlyargs:
+                if a.arg in _KEYISH_PARAMS or a.arg.endswith("_key"):
+                    keys.add(a.arg)
+
+        # events in source order: (line, col, kind, payload)
+        events = []
+        for call in nodes_of(ast.Call):
+            name_node = _consumed_key_arg(call)
+            if name_node is not None:
+                events.append((name_node.lineno, name_node.col_offset,
+                               "use", (name_node, call)))
+        for stmt in nodes_of((ast.Assign, ast.AugAssign, ast.AnnAssign,
+                              ast.For, ast.AsyncFor)):
+            value = getattr(stmt, "value", None) or getattr(stmt, "iter", None)
+            produced = any(_is_producer(c) for c in ast.walk(value)
+                           if isinstance(c, ast.Call)) if value is not None \
+                else False
+            for n in _assigned_names(stmt):
+                # bindings land AFTER the value's uses on the same line
+                events.append((n.lineno, n.col_offset + 10_000, "bind",
+                               (n.id, produced)))
+        events.sort(key=lambda e: (e[0], e[1]))
+
+        spent: dict[str, ast.AST] = {}
+        for _, _, kind, payload in events:
+            if kind == "bind":
+                name, produced = payload
+                spent.pop(name, None)
+                if produced:
+                    keys.add(name)
+            else:
+                name_node, call = payload
+                name = name_node.id
+                if name not in keys:
+                    continue
+                prev = spent.get(name)
+                if prev is not None and _conflicting(
+                        _branch_path(prev, scope),
+                        _branch_path(name_node, scope), owners):
+                    yield mod.finding(
+                        self.rule, self.severity, call,
+                        f"key {name!r} already consumed at line "
+                        f"{prev.lineno} — split a fresh subkey "
+                        f"(`{name}, sub = jax.random.split({name})`) instead "
+                        f"of drawing twice from the same key")
+                else:
+                    spent[name] = name_node
