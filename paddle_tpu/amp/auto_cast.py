@@ -54,7 +54,13 @@ def amp_state() -> _AmpState:
 
 def amp_dtype_for(op_name: str):
     """Called by ops.dispatch: returns the target dtype if this op should be
-    autocast, else None."""
+    autocast, else None.
+
+    The returned dtype is also a component of the compiled-op cache key
+    (ops/_op_cache.py): the cast is applied to the inputs BEFORE keying, so
+    the same op under a different autocast regime (O1 bf16 vs fp32, custom
+    white/black lists) lands on a different compiled executable instead of
+    reusing a stale one."""
     if not _state.enabled:
         return None
     name = op_name.lower()

@@ -31,6 +31,10 @@ def _differentiable_vjp(node, cots):
 
     `cots` is a list of cotangent Tensors (one per node output). Returns a
     tuple of Tensor grads, one per node.inputs entry.
+
+    `vjp_op` closes over the recompute ingredients (concrete arrays), which
+    makes it uncacheable by the compiled-op cache on purpose: higher-order
+    grads re-derive the vjp fresh so the grad-of-grad graph stays exact.
     """
     from ..ops import dispatch
 
@@ -172,6 +176,10 @@ def backward(tensors: List[Tensor], grad_tensors: Optional[List[Optional[Tensor]
                     ready.append(parent)
 
         if not retain_graph:
+            # drops the pullback closure — for dispatch's cached-vjp path
+            # this releases the compiled pullback's residual arrays (a
+            # jax.tree_util.Partial pytree) exactly like the plain jax.vjp
+            # closure, so cache reuse never extends activation lifetime
             node.vjp_fn = None
             node.inputs = []
             node.recompute = None

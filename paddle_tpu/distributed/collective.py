@@ -566,7 +566,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
         def f(v):
             g = jax.lax.all_gather(v, ax)
-            return g[src_map[jax.lax.axis_index(ax)]]
+            return g[src_map[jax.lax.axis_index(ax)]]  # staticcheck: ok[closure-capture] — static rank->src routing table, identical on every call
         out = apply(f, tensor, op_name="recv")
     _update_inplace(tensor, out)
     return _Task(tensor)

@@ -31,8 +31,8 @@ class Beta(ExponentialFamily):
         out_shape = self._extend_shape(shape)
 
         def f(a, b):
-            ga = jax.random.gamma(k1, jnp.broadcast_to(a, out_shape))
-            gb = jax.random.gamma(k2, jnp.broadcast_to(b, out_shape))
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, out_shape))  # staticcheck: ok[closure-capture] — fresh PRNG key per rsample; baking it would freeze the randomness
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, out_shape))  # staticcheck: ok[closure-capture] — fresh PRNG key per rsample; baking it would freeze the randomness
             return ga / (ga + gb)
         return _wrap(f, self.alpha, self.beta, op_name="beta_rsample")
 

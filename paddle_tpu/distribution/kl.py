@@ -4,14 +4,17 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..utils.memo import LockedLRU
 from .distribution import Distribution, _wrap
 
-_REGISTRY = {}
+# audited registry (utils/memo.py): (type_p, type_q) -> closed-form KL fn;
+# unbounded by design (registrations are module-import-time and finite)
+_REGISTRY = LockedLRU(maxsize=None)
 
 
 def register_kl(cls_p, cls_q):
     def deco(fn):
-        _REGISTRY[(cls_p, cls_q)] = fn
+        _REGISTRY.put((cls_p, cls_q), fn)
         return fn
     return deco
 

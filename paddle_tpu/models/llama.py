@@ -117,9 +117,14 @@ class LlamaAttention(Layer):
         q = manip.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = manip.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         v = manip.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        # cache note: `off` (KV decode offset) is closed over by the op
+        # lambdas below, which keeps the decode-step ops out of the compiled-op
+        # cache; it is a non-differentiable host scalar, so the only cost is
+        # uncached dispatch. Threading it through apply() as a traced arg
+        # would make decode steps cacheable — a follow-up, not a hazard.
         off = position_offset._value if isinstance(position_offset, Tensor) \
             else position_offset
-        out = apply(lambda qq, kk: _rope(qq, kk, self.config.rope_theta, off),
+        out = apply(lambda qq, kk: _rope(qq, kk, self.config.rope_theta, off),  # staticcheck: ok[closure-capture] — decode offset is a non-diff host scalar; see cache note above
                     q, k, op_name="rope")
         q, k = out[0], out[1]
         # heads sharded over mp
@@ -136,7 +141,7 @@ class LlamaAttention(Layer):
 
             def upd(kc, vc, kn, vn):
                 z = jnp.asarray(0, jnp.int32)
-                start = (z, jnp.asarray(off, jnp.int32), z, z)
+                start = (z, jnp.asarray(off, jnp.int32), z, z)  # staticcheck: ok[closure-capture] — decode offset, as above
                 return (jax.lax.dynamic_update_slice(kc, kn.astype(kc.dtype),
                                                      start),
                         jax.lax.dynamic_update_slice(vc, vn.astype(vc.dtype),
@@ -159,7 +164,7 @@ class LlamaAttention(Layer):
                     from ..ops.pallas.decode_attention import (
                         ragged_decode_attention)
                     lengths = jnp.full((qq.shape[0],),
-                                       jnp.asarray(off, jnp.int32) + 1)
+                                       jnp.asarray(off, jnp.int32) + 1)  # staticcheck: ok[closure-capture] — decode offset, as above
                     return ragged_decode_attention(qq, kc, vc, lengths)
 
                 attn = apply(rag, q, k_cache, v_cache,
@@ -167,7 +172,7 @@ class LlamaAttention(Layer):
             else:
                 def mk_mask(_shape_ref):
                     j = jnp.arange(s_max)[None, :]
-                    i = jnp.arange(s)[:, None] + jnp.asarray(off, jnp.int32)
+                    i = jnp.arange(s)[:, None] + jnp.asarray(off, jnp.int32)  # staticcheck: ok[closure-capture] — decode offset, as above
                     allowed = j <= i
                     return jnp.where(allowed, 0.0, -1e30)[None, None]
 

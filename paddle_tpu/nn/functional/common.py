@@ -263,13 +263,13 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1):
-    def f(l):
-        k = l.shape[-1]
-        if prior_dist is not None:
-            pd = prior_dist._value if isinstance(prior_dist, Tensor) else prior_dist
-            return (1 - epsilon) * l + epsilon * pd
-        return (1 - epsilon) * l + epsilon / k
-    return apply(f, label, op_name="label_smooth")
+    # prior_dist rides through apply() as a positional arg (not a closure):
+    # it stays on the tape / under AMP and the op stays cacheable
+    if prior_dist is not None:
+        return apply(lambda l, pd: (1 - epsilon) * l + epsilon * pd,
+                     label, prior_dist, op_name="label_smooth")
+    return apply(lambda l: (1 - epsilon) * l + epsilon / l.shape[-1],
+                 label, op_name="label_smooth")
 
 
 def bilinear(x1, x2, weight, bias=None):

@@ -335,7 +335,7 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     def f(x, lab, w, table, codes, *b):
         t = table[lab]          # (N, D) weight rows along the path
         c = codes[lab]          # (N, D) branch bits
-        if lengths is not None:
+        if lengths is not None:  # staticcheck: ok[closure-capture] — per-row path lengths: static int table, not a differentiable payload
             valid = jnp.arange(t.shape[1])[None, :] < lengths[lab][:, None]
         else:
             # padded custom paths: a row repeated at its own position-0 id

@@ -43,8 +43,9 @@ def _install():
     T.__pos__ = lambda s: s
     T.__abs__ = lambda s: math.abs(s)
     T.__matmul__ = lambda s, o: math.matmul(s, o)
-    T.__rmatmul__ = lambda s, o: apply(lambda v: jnp.matmul(
-        o._value if isinstance(o, Tensor) else o, v), s, op_name="rmatmul")
+    # both operands through apply(): the left operand lands on the tape and
+    # under AMP instead of being baked into the op closure
+    T.__rmatmul__ = lambda s, o: apply(jnp.matmul, o, s, op_name="rmatmul")
     T.__invert__ = lambda s: math.bitwise_not(s)
     T.__and__ = lambda s, o: math.bitwise_and(s, o)
     T.__or__ = lambda s, o: math.bitwise_or(s, o)

@@ -1,0 +1,485 @@
+"""Aval-keyed compiled-executable cache for eager op dispatch.
+
+The paper's L1 layer makes every op a jax function, but eager `apply()`
+(ops/dispatch.py) runs that function untraced on every call: each eager op
+pays full per-primitive JAX dispatch, and the autograd path re-traces
+`jax.vjp` per call even when shapes/dtypes are identical across a training
+loop. This module memoizes a `jax.jit`-compiled executable per
+
+    (op_name, jax_fn identity*, input avals (shape+dtype+weak_type),
+     frozen static args/kwargs, amp dtype, fwd-vs-vjp, diff positions)
+
+— the kernel-reuse discipline of a (name, backend, dtype)-keyed kernel
+factory, rebuilt on aval identity instead (arXiv:2304.12576 argues the same
+compiled-kernel-reuse point for CPU loop/tensor abstractions).
+
+*fn identity: module-level functions key by the function object; per-call
+lambdas key by (code object, frozen closure cells, frozen defaults) so the
+`apply(lambda v: ..., x)` idiom hits the cache across calls. A closure cell
+holding an array/Tensor payload makes the op uncacheable (and is flagged by
+the staticcheck `closure-capture` rule — payloads belong in positional
+args).
+
+Autograd: a cache hit runs a jitted vjp-BUILD wrapper returning
+`(outputs, pullback)`; the pullback is a `jax.tree_util.Partial` pytree, so
+its residuals flow OUT of the compiled forward as arrays and back INTO a
+jitted pullback call — forward and backward each compile exactly once per
+key, and GradNode semantics (recompute tuple, consumer registry, multi-
+output avals) are untouched because dispatch still records the same node.
+
+Safety: the first call per key runs the plain eager path (bit-identical to
+the uncached behavior) and only then installs an executable; any exception
+while the executable traces/runs poisons the entry and falls back to eager
+forever (ops with data-dependent output shapes or host syncs inside the fn
+stay eager-only). Tracer inputs, an installed static recorder, and
+unhashable statics bypass the cache entirely, so `to_static` and jitted
+train steps see identical behavior.
+
+Env knobs: `PT_OP_CACHE=0` disables; `PT_OP_CACHE_SIZE` bounds the LRU
+(default 512 entries); `PT_OP_CACHE_COMPILE_AFTER` sets how many
+identical-key calls arrive before compiling (default 2 — the second call
+compiles; raise it for workloads dominated by twice-run ops).
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..utils.memo import LockedLRU
+
+__all__ = [
+    "cached_forward", "cached_vjp", "cache_info", "cache_clear",
+    "set_enabled", "set_maxsize", "set_compile_after", "enabled",
+]
+
+_UNHASHABLE = object()
+
+_enabled = os.environ.get("PT_OP_CACHE", "1").lower() not in ("0", "false")
+_compile_after = max(1, int(os.environ.get("PT_OP_CACHE_COMPILE_AFTER", "2")))
+_cache = LockedLRU(maxsize=max(1, int(os.environ.get("PT_OP_CACHE_SIZE",
+                                                     "512"))))
+
+
+def set_enabled(on: bool):
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_maxsize(n: int):
+    _cache.set_maxsize(max(1, int(n)))
+
+
+def set_compile_after(n: int):
+    global _compile_after
+    _compile_after = max(1, int(n))
+
+
+# ---------------------------------------------------------------------------
+# per-op observability counters
+# ---------------------------------------------------------------------------
+
+class _OpStats:
+    __slots__ = ("hits", "misses", "retraces", "bwd_retraces", "bypasses",
+                 "bailouts", "deferred", "last_bailout")
+
+    def __init__(self):
+        self.hits = 0          # calls served by a compiled executable
+        self.misses = 0        # first-seen keys (ran eager, entry installed)
+        self.retraces = 0      # forward wrapper trace count (jit tracings)
+        self.bwd_retraces = 0  # pullback wrapper trace count
+        self.bypasses = 0      # uncacheable calls (tracer/unhashable/...)
+        self.bailouts = 0      # executable failed -> entry poisoned
+        self.deferred = 0      # warm calls below the compile_after threshold
+        self.last_bailout = ""
+
+    def snapshot(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "retraces": self.retraces, "bwd_retraces": self.bwd_retraces,
+                "bypasses": self.bypasses, "bailouts": self.bailouts,
+                "deferred": self.deferred,
+                **({"last_bailout": self.last_bailout}
+                   if self.last_bailout else {})}
+
+
+# Per-op counters are append-only monotonic telemetry guarded by _STATS_LOCK;
+# a set_*/register_* installer per dynamically-discovered op name is not a
+# meaningful audit unit here, so the write is sanctioned in place.
+_STATS: dict = {}
+_STATS_LOCK = threading.Lock()
+
+
+def _stats_for(name: str) -> _OpStats:
+    s = _STATS.get(name)
+    if s is None:
+        with _STATS_LOCK:
+            s = _STATS.setdefault(name, _OpStats())  # staticcheck: ok[mutable-global] — locked, append-only per-op telemetry; see comment above
+    return s
+
+
+# ---------------------------------------------------------------------------
+# key construction
+# ---------------------------------------------------------------------------
+
+_ATOMS = (bool, int, float, complex, str, bytes)
+
+
+def _freeze(v) -> Any:
+    """A hashable, value-equal token for a static argument — or _UNHASHABLE
+    when the value may not be baked into a compiled executable (array
+    payloads, Tensors, mutable objects we cannot prove stable)."""
+    if v is None or v is Ellipsis:
+        return v
+    t = type(v)
+    if t in _ATOMS:
+        # type name disambiguates hash-equal cross-type values (True vs 1)
+        return (t.__name__, v)
+    if t in (tuple, list):
+        parts = []
+        for e in v:
+            f = _freeze(e)
+            if f is _UNHASHABLE:
+                return _UNHASHABLE
+            parts.append(f)
+        return (t.__name__, tuple(parts))
+    if t is dict:
+        try:
+            items = sorted(v.items())
+        except TypeError:
+            return _UNHASHABLE
+        parts = []
+        for k, e in items:
+            f = _freeze(e)
+            if f is _UNHASHABLE:
+                return _UNHASHABLE
+            parts.append((k, f))
+        return ("dict", tuple(parts))
+    if t is slice:
+        return ("slice", _freeze(v.start), _freeze(v.stop), _freeze(v.step))
+    if isinstance(v, (jax.core.Tracer, jax.Array, jax.ShapeDtypeStruct,
+                      np.ndarray, Tensor)):
+        return _UNHASHABLE  # payloads are runtime inputs, never baked keys
+    if isinstance(v, np.dtype) or (isinstance(v, type)):
+        return v  # dtype objects / classes: stable, hashable
+    if isinstance(v, np.generic):
+        return (t.__name__, v)
+    if inspect.ismethod(v):
+        return _UNHASHABLE  # bound method: reads mutable self state
+    if inspect.isfunction(v) or inspect.isbuiltin(v):
+        fk = _fn_key(v)
+        return fk if fk is not None else _UNHASHABLE
+    try:
+        hash(v)
+    except TypeError:
+        return _UNHASHABLE
+    # identity-hashable unknown objects could mutate under a baked
+    # executable; only enums and similar value-hashed types are safe
+    if getattr(t, "__hash__", None) is object.__hash__:
+        return _UNHASHABLE
+    return (t.__name__, v)
+
+
+def _fn_key(fn: Callable):
+    """Stable identity for the op's jax function.
+
+    Module-level callables key by the object itself; lambdas / local defs
+    (fresh objects each call) key by their code object plus frozen closure
+    cells and defaults, so the pervasive `apply(lambda v: f(v, cfg), x)`
+    idiom reuses one executable per distinct cfg. Returns None when the
+    function cannot be keyed safely (array captured in a cell, bound
+    method, unreadable cell)."""
+    if inspect.ismethod(fn):
+        return None
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn if callable(fn) else None
+    cells = []
+    for c in (getattr(fn, "__closure__", None) or ()):
+        try:
+            fv = _freeze(c.cell_contents)
+        except ValueError:  # empty cell
+            return None
+        if fv is _UNHASHABLE:
+            return None
+        cells.append(fv)
+    dflts = []
+    for d in (getattr(fn, "__defaults__", None) or ()):
+        fd = _freeze(d)
+        if fd is _UNHASHABLE:
+            return None
+        dflts.append(fd)
+    return (code, tuple(cells), tuple(dflts))
+
+
+def _args_key(vals: Sequence[Any]):
+    """-> (per-position key tuple, traced array positions), or (None, None)
+    when this call must bypass (tracer present / unfreezable static)."""
+    parts = []
+    arr_pos = []
+    for i, v in enumerate(vals):
+        if isinstance(v, jax.core.Tracer):
+            return None, None  # inside an enclosing trace: stay transparent
+        if isinstance(v, jax.Array):
+            arr_pos.append(i)
+            parts.append((v.shape, str(v.dtype),
+                          bool(getattr(v, "weak_type", False))))
+        else:
+            f = _freeze(v)
+            if f is _UNHASHABLE:
+                return None, None
+            parts.append(("S", f))
+    return tuple(parts), tuple(arr_pos)
+
+
+def _kwargs_key(static_kwargs: dict):
+    if not static_kwargs:
+        return ()
+    try:
+        items = sorted(static_kwargs.items())
+    except TypeError:
+        return None
+    parts = []
+    for k, v in items:
+        f = _freeze(v)
+        if f is _UNHASHABLE:
+            return None
+        parts.append((k, f))
+    return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# entries + executable builders
+# ---------------------------------------------------------------------------
+
+class _Entry:
+    __slots__ = ("arr_pos", "calls", "poisoned", "exec_fwd", "exec_bwd")
+
+    def __init__(self, arr_pos):
+        self.arr_pos = arr_pos
+        self.calls = 1
+        self.poisoned = False
+        self.exec_fwd = None
+        self.exec_bwd = None
+
+
+def norm_fn_of(jax_fn: Callable) -> Callable:
+    """jax_fn with NamedTuple outputs (EighResult, SVDResult, ...) flattened
+    to plain tuples: the backward pass builds cotangents as tuples and
+    jax.vjp requires the EXACT output pytree type. The single definition
+    shared by the cached-vjp builder AND dispatch.apply's uncached path, so
+    the two pytree contracts cannot drift."""
+    def norm_fn(*a, **k):
+        out = jax_fn(*a, **k)
+        if isinstance(out, tuple) and type(out) is not tuple:
+            return tuple(out)
+        return out
+    return norm_fn
+
+
+def _rebuilder(nargs: int, arr_pos, statics):
+    def rebuild(arrs):
+        vv = [None] * nargs
+        for p, a in zip(arr_pos, arrs):
+            vv[p] = a
+        for p, s in statics:
+            vv[p] = s
+        return vv
+    return rebuild
+
+
+def _build_fwd(jax_fn, vals, static_kwargs, arr_pos, stats, name):
+    taken = set(arr_pos)
+    statics = [(i, vals[i]) for i in range(len(vals)) if i not in taken]
+    rebuild = _rebuilder(len(vals), arr_pos, statics)
+
+    def _pt_cached_op(*arrs):
+        stats.retraces += 1
+        return jax_fn(*rebuild(arrs), **static_kwargs)
+
+    _pt_cached_op.__name__ = f"ptcache_{name}"
+    return jax.jit(_pt_cached_op)
+
+
+def _build_vjp(jax_fn, vals, static_kwargs, arr_pos, diff_idx, stats, name):
+    taken = set(arr_pos)
+    statics = [(i, vals[i]) for i in range(len(vals)) if i not in taken]
+    rebuild = _rebuilder(len(vals), arr_pos, statics)
+
+    _norm_fn = norm_fn_of(jax_fn)
+
+    def _pt_cached_vjp_build(*arrs):
+        stats.retraces += 1
+        vv = rebuild(arrs)
+        diff_vals = [vv[i] for i in diff_idx]
+
+        def f(*dv):
+            vv2 = list(vv)
+            for k, i in enumerate(diff_idx):
+                vv2[i] = dv[k]
+            return _norm_fn(*vv2, **static_kwargs)
+
+        # the pullback is a jax.tree_util.Partial: a pytree whose leaves are
+        # the residual arrays, so it flows OUT of this jitted forward
+        return jax.vjp(f, *diff_vals)
+
+    def _pt_cached_vjp_pull(pullback, cots):
+        stats.bwd_retraces += 1
+        return pullback(cots)
+
+    _pt_cached_vjp_build.__name__ = f"ptcache_{name}_vjp"
+    _pt_cached_vjp_pull.__name__ = f"ptcache_{name}_grad"
+    return jax.jit(_pt_cached_vjp_build), jax.jit(_pt_cached_vjp_pull)
+
+
+def _all_array_leaves(raw) -> bool:
+    """May this output structure round-trip through jit unchanged? Only
+    pure array pytrees qualify — a python-scalar or arbitrary-object output
+    would come back as a committed array and change eager semantics."""
+    outs = raw if isinstance(raw, (tuple, list)) else (raw,)
+    return all(isinstance(o, jax.Array) for o in outs)
+
+
+def _poison(entry: _Entry, stats: _OpStats, exc: Exception):
+    entry.poisoned = True
+    entry.exec_fwd = None
+    entry.exec_bwd = None
+    stats.bailouts += 1
+    stats.last_bailout = f"{type(exc).__name__}: {exc}"[:200]
+
+
+# ---------------------------------------------------------------------------
+# dispatch-facing API
+# ---------------------------------------------------------------------------
+
+def _lookup(kind, name, jax_fn, vals, static_kwargs, amp_dt, diff_idx,
+            stats):
+    """-> (entry | None, arr_pos). Entry None means bypass/uncacheable."""
+    fnk = _fn_key(jax_fn)
+    if fnk is None:
+        stats.bypasses += 1
+        return None, None
+    args_k, arr_pos = _args_key(vals)
+    if args_k is None:
+        stats.bypasses += 1
+        return None, None
+    kw_k = _kwargs_key(static_kwargs)
+    if kw_k is None:
+        stats.bypasses += 1
+        return None, None
+    key = (kind, name, fnk, args_k, kw_k,
+           None if amp_dt is None else str(np.dtype(amp_dt)), diff_idx)
+    entry = _cache.get(key)
+    if entry is None:
+        entry = _Entry(arr_pos)
+        _cache.put(key, entry)
+        stats.misses += 1
+        return None, None  # first sighting: caller runs the eager path
+    entry.calls += 1
+    if entry.poisoned:
+        stats.bypasses += 1
+        return None, None
+    if entry.calls < _compile_after:
+        stats.deferred += 1
+        return None, None
+    return entry, entry.arr_pos
+
+
+def cached_forward(name, jax_fn, vals, static_kwargs, amp_dt):
+    """Serve a no-grad eager op from the cache.
+
+    Returns (handled, raw): handled False -> caller must run its own eager
+    path (bypass / first sighting / poisoned entry)."""
+    if not _enabled:
+        return False, None
+    stats = _stats_for(name)
+    entry, arr_pos = _lookup("fwd", name, jax_fn, vals, static_kwargs,
+                             amp_dt, (), stats)
+    if entry is None:
+        return False, None
+    # work from a LOCAL executable ref: a concurrent thread's _poison may
+    # null the entry fields between the check and the call
+    fwd_exec = entry.exec_fwd
+    if fwd_exec is None:
+        fwd_exec = _build_fwd(jax_fn, vals, static_kwargs, arr_pos,
+                              stats, name)
+        entry.exec_fwd = fwd_exec
+    try:
+        raw = fwd_exec(*(vals[p] for p in arr_pos))
+    except Exception as e:  # noqa: BLE001 — correctness net: poison + eager
+        _poison(entry, stats, e)
+        return False, None
+    if not _all_array_leaves(raw):
+        # output carries non-array leaves: jit coerced them, so the eager
+        # result is authoritative — poison and rerun uncached
+        _poison(entry, stats,
+                TypeError("non-array output leaves; op is eager-only"))
+        return False, None
+    stats.hits += 1
+    return True, raw
+
+
+def cached_vjp(name, jax_fn, vals, static_kwargs, amp_dt, diff_idx):
+    """Serve a grad-recorded op from the cache.
+
+    Returns None when the caller must run the uncached jax.vjp path, else
+    (raw_outputs, vjp_fn) with vjp_fn matching jax.vjp's pullback contract
+    (cotangent pytree in, per-diff-input gradient tuple out)."""
+    if not _enabled:
+        return None
+    stats = _stats_for(name)
+    entry, arr_pos = _lookup("vjp", name, jax_fn, vals, static_kwargs,
+                             amp_dt, diff_idx, stats)
+    if entry is None:
+        return None
+    # LOCAL refs to both executables: the two-field entry store is not
+    # atomic and a concurrent _poison may null them mid-flight — the
+    # pullback closure must never capture a None bwd
+    fwd_exec, bwd_exec = entry.exec_fwd, entry.exec_bwd
+    if fwd_exec is None or bwd_exec is None:
+        fwd_exec, bwd_exec = _build_vjp(
+            jax_fn, vals, static_kwargs, arr_pos, diff_idx, stats, name)
+        entry.exec_fwd, entry.exec_bwd = fwd_exec, bwd_exec
+    try:
+        raw, pullback = fwd_exec(*(vals[p] for p in arr_pos))
+    except Exception as e:  # noqa: BLE001 — correctness net: poison + eager
+        _poison(entry, stats, e)
+        return None
+    stats.hits += 1
+
+    def vjp_fn(cots, _pullback=pullback, _bwd=bwd_exec):
+        return _bwd(_pullback, cots)
+
+    return raw, vjp_fn
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def cache_info() -> dict:
+    """Cache-wide and per-op counters (the `dispatch.cache_info()` API)."""
+    with _STATS_LOCK:
+        per_op = {k: v.snapshot() for k, v in sorted(_STATS.items())}
+    totals = {f: sum(s[f] for s in per_op.values())
+              for f in ("hits", "misses", "retraces", "bwd_retraces",
+                        "bypasses", "bailouts", "deferred")}
+    return {"enabled": _enabled, "size": len(_cache),
+            "maxsize": _cache.maxsize, "compile_after": _compile_after,
+            "evictions": _cache.evictions, **totals, "per_op": per_op}
+
+
+def cache_clear():
+    """Drop every compiled executable and reset all counters."""
+    _cache.clear()
+    with _STATS_LOCK:
+        _STATS.clear()  # staticcheck: ok[mutable-global] — locked full reset; the public API name mirrors functools' cache_clear
+    _cache.evictions = 0

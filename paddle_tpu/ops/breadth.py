@@ -107,7 +107,7 @@ def combinations(x, r=2, with_replacement=False, name=None):
     it = itertools.combinations_with_replacement(range(n), r) \
         if with_replacement else itertools.combinations(range(n), r)
     idx = jnp.asarray(np.asarray(list(it), np.int32).reshape(-1, r))
-    return apply(lambda v: v[idx], x, op_name="combinations")
+    return apply(lambda v: v[idx], x, op_name="combinations")  # staticcheck: ok[closure-capture] — host-hoisted static index table (see comment above)
 
 
 @_export
@@ -237,7 +237,7 @@ def pdist(x, p=2.0, name=None):
     i, j = (jnp.asarray(a) for a in np.triu_indices(int(x.shape[0]), k=1))
 
     def f(v):
-        d = v[i] - v[j]
+        d = v[i] - v[j]  # staticcheck: ok[closure-capture] — host-hoisted static pair indices (see comment above)
         if p == 2.0:
             return jnp.sqrt(jnp.sum(d * d, axis=-1))
         if p == 0:

@@ -12,6 +12,13 @@ Autograd: when grad recording is on and any differentiable input requires grad, 
 run the op under `jax.vjp` and record a GradNode holding the vjp closure — the
 define-by-run tape (analog of GradNodeBase + TensorWrapper,
 paddle/fluid/eager/grad_node_info.h:168).
+
+Hot-path caching: repeated eager calls with identical (op, input avals, static
+args, amp dtype) are served by a memoized `jax.jit` executable — including the
+vjp path, whose traced forward+pullback pair compiles once per key — see
+ops/_op_cache.py and `cache_info()`. Tracer inputs, static mode, and
+unhashable statics bypass the cache, so traced/to_static behavior is
+unchanged.
 """
 from __future__ import annotations
 
@@ -24,8 +31,12 @@ import jax.numpy as jnp
 from ..autograd.grad_mode import is_grad_enabled
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor
+from ..utils import memo
+from . import _op_cache
 
-__all__ = ["apply", "GradNode", "defprim", "set_static_recorder"]
+__all__ = ["apply", "GradNode", "defprim", "set_static_recorder",
+           "cache_info", "cache_clear", "set_op_cache_enabled",
+           "set_op_cache_maxsize", "set_op_cache_compile_after"]
 
 # Static-graph capture hook (installed by paddle_tpu.static.framework when
 # static mode is enabled). The analog of the reference's dual-world dispatch:
@@ -85,15 +96,27 @@ def _wrap_outputs(raw, op_name):
     return Tensor(raw), False
 
 
-_amp_dtype_for = None
+def _import_amp_hook():
+    from ..amp.auto_cast import amp_dtype_for
+    return amp_dtype_for
 
 
-def _get_amp_hook():
-    global _amp_dtype_for
-    if _amp_dtype_for is None:
-        from ..amp.auto_cast import amp_dtype_for
-        _amp_dtype_for = amp_dtype_for
-    return _amp_dtype_for
+# deferred so paddle_tpu.amp can finish importing; memo.Lazy is the audited
+# replacement for the `global _amp_dtype_for` rebind this used to do
+_get_amp_hook = memo.Lazy(_import_amp_hook)
+
+
+# ---------------------------------------------------------------------------
+# Compiled-op cache (the aval-keyed executable memo — see ops/_op_cache.py).
+# Public knobs re-exported here so callers configure dispatch, not the
+# internal module. README "Eager dispatch" documents key/bypass semantics.
+# ---------------------------------------------------------------------------
+
+cache_info = _op_cache.cache_info
+cache_clear = _op_cache.cache_clear
+set_op_cache_enabled = _op_cache.set_enabled
+set_op_cache_maxsize = _op_cache.set_maxsize
+set_op_cache_compile_after = _op_cache.set_compile_after
 
 
 # Observability hooks (host tracer + nan/inf guard). Kept as plain module
@@ -194,7 +217,10 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
 
     if not diff_idx or not is_grad_enabled():
         try:
-            raw = jax_fn(*vals, **static_kwargs)
+            handled, raw = _op_cache.cached_forward(name, jax_fn, vals,
+                                                    static_kwargs, amp_dt)
+            if not handled:
+                raw = jax_fn(*vals, **static_kwargs)
         except (TypeError, ValueError, IndexError) as e:
             _op_error(name, vals, e)
         out, multi = _wrap_outputs(raw, name)
@@ -205,15 +231,9 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
         return out
 
     diff_vals = [vals[i] for i in diff_idx]
-
-    def norm_fn(*a, **k):
-        """jax_fn with NamedTuple outputs (EighResult, SVDResult, ...)
-        flattened to plain tuples: the backward pass builds cotangents as
-        tuples, and jax.vjp requires the EXACT output pytree type."""
-        out = jax_fn(*a, **k)
-        if isinstance(out, tuple) and type(out) is not tuple:
-            return tuple(out)
-        return out
+    # NamedTuple-to-tuple output flattening, shared with the cached-vjp
+    # builder so the two pytree contracts cannot drift
+    norm_fn = _op_cache.norm_fn_of(jax_fn)
 
     def f(*dv):
         vv = list(vals)
@@ -222,7 +242,12 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
         return norm_fn(*vv, **static_kwargs)
 
     try:
-        raw, vjp_fn = jax.vjp(f, *diff_vals)
+        cached = _op_cache.cached_vjp(name, jax_fn, vals, static_kwargs,
+                                      amp_dt, tuple(diff_idx))
+        if cached is not None:
+            raw, vjp_fn = cached
+        else:
+            raw, vjp_fn = jax.vjp(f, *diff_vals)
     except (TypeError, ValueError, IndexError) as e:
         _op_error(name, vals, e)
     out, multi = _wrap_outputs(raw, name)

@@ -296,7 +296,7 @@ def masked_select(x, mask):
     # positions — a gather's vjp does exactly that).
     m = np.asarray(_u(mask)).astype(bool).reshape(-1)  # staticcheck: ok[host-sync] — dynamic output shape, eager-only by contract
     idx = jnp.asarray(np.nonzero(m)[0])
-    return apply(lambda v: v.reshape(-1)[idx], x, op_name="masked_select")
+    return apply(lambda v: v.reshape(-1)[idx], x, op_name="masked_select")  # staticcheck: ok[closure-capture] — dynamic-output-shape gather indices, eager-only by contract (see comment above)
 
 
 @_export
@@ -491,7 +491,7 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
 @_export
 def repeat_interleave(x, repeats, axis=None):
     r = _u(repeats) if isinstance(repeats, Tensor) else repeats
-    return apply(lambda v: jnp.repeat(v, r, axis=axis), x, op_name="repeat_interleave")
+    return apply(lambda v: jnp.repeat(v, r, axis=axis), x, op_name="repeat_interleave")  # staticcheck: ok[closure-capture] — tensor repeats imply a data-dependent output shape; eager-only by contract
 
 
 @_export

@@ -139,9 +139,11 @@ rad2deg = _unop("rad2deg", jnp.rad2deg)
 
 
 def clip(x, min=None, max=None):
-    lo = _u(min) if min is not None else None
-    hi = _u(max) if max is not None else None
-    return apply(lambda v: jnp.clip(v, lo, hi), x, op_name="clip")
+    # min/max ride through apply() as positional args (not a closure): Tensor
+    # bounds stay on the tape / under AMP, and scalar/None bounds key the
+    # compiled executable by value instead of making the op uncacheable
+    return apply(lambda v, lo, hi: jnp.clip(v, lo, hi), x, min, max,
+                 op_name="clip")
 _export("clip", clip)
 
 

@@ -25,6 +25,36 @@ def aggregate(events: List[dict]) -> Dict[str, dict]:
     return stats
 
 
+def op_cache_summary(sorted_by: str = "hits") -> str:
+    """Compiled-op dispatch-cache counters as a table — the profiler-side
+    view of `ops.dispatch.cache_info()` (per-op hit/miss/retrace), so a
+    recompile storm shows up next to the op timings instead of staying
+    silent. A healthy steady-state loop shows retraces pinned at 1 per key
+    and hits climbing; climbing retraces mean the key churns (shapes,
+    statics, or fresh closures) and the op recompiles."""
+    from ..ops import dispatch
+
+    info = dispatch.cache_info()
+    key = sorted_by if sorted_by in ("hits", "misses", "retraces",
+                                     "bwd_retraces", "bypasses", "bailouts",
+                                     "deferred") else "hits"
+    rows = sorted(info["per_op"].items(), key=lambda kv: -kv[1][key])
+    head = (f"{'Op':<28} {'Hits':>8} {'Miss':>6} {'Retrace':>8} "
+            f"{'BwdRetrace':>11} {'Bypass':>7} {'Bailout':>8} {'Defer':>6}")
+    lines = [
+        f"op cache: enabled={info['enabled']} size={info['size']}/"
+        f"{info['maxsize']} evictions={info['evictions']} "
+        f"hits={info['hits']} misses={info['misses']}",
+        head, "-" * len(head),
+    ]
+    for name, s in rows[:64]:
+        lines.append(
+            f"{name[:28]:<28} {s['hits']:>8} {s['misses']:>6} "
+            f"{s['retraces']:>8} {s['bwd_retraces']:>11} {s['bypasses']:>7} "
+            f"{s['bailouts']:>8} {s['deferred']:>6}")
+    return "\n".join(lines)
+
+
 def summary(events: List[dict], sorted_by: str = "total",
             time_unit: str = "ms") -> str:
     stats = aggregate(events)

@@ -89,7 +89,7 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     def f(*vals):
         res = jax.pure_callback(
             lambda *a: func(*[np.asarray(v) for v in a]),
-            shapes if len(shapes) > 1 else shapes[0], *vals)
+            shapes if len(shapes) > 1 else shapes[0], *vals)  # staticcheck: ok[closure-capture] — pure_callback result SPECS (ShapeDtypeStructs), not payloads
         return res
     result = apply(f, *xs, op_name="py_func")
     return result

@@ -357,7 +357,15 @@ def _recorder(jax_fn, args, static_kwargs, name):
 
 
 def enable_static():
-    """Switch to static-graph mode (analog of paddle.enable_static)."""
+    """Switch to static-graph mode (analog of paddle.enable_static).
+
+    Installing the recorder also sidelines dispatch's compiled-op cache:
+    `apply` consults the recorder BEFORE the cache, so every Variable-
+    touching op takes the record-then-replay path, never an eager
+    executable; as defense in depth the cache itself refuses to key on the
+    symbolic `ShapeDtypeStruct` payloads Variables carry. Calls that fall
+    through (concrete tensors only, no Variable) are ordinary eager ops and
+    cache as usual."""
     global _static_mode
     _static_mode = True
     _dispatch.set_static_recorder(_recorder)

@@ -5,20 +5,24 @@ import logging
 import os
 import sys
 
-_LOGGERS = {}
+from .memo import LockedLRU
+
+# audited registry (utils/memo.py): logger names are a bounded keyspace, so
+# no eviction; writes happen inside the instance lock, not on a module dict
+_LOGGERS = LockedLRU(maxsize=None)
 
 
 def get_logger(name: str = "paddle_tpu", level=None):
-    if name in _LOGGERS:
-        return _LOGGERS[name]
-    logger = logging.getLogger(name)
-    if not logger.handlers:
-        h = logging.StreamHandler(sys.stderr)
-        h.setFormatter(logging.Formatter(
-            "%(asctime)s %(levelname).1s %(name)s] %(message)s"))
-        logger.addHandler(h)
-    lvl = level or os.environ.get("PADDLE_TPU_LOG_LEVEL", "INFO")
-    logger.setLevel(lvl.upper() if isinstance(lvl, str) else lvl)
-    logger.propagate = False
-    _LOGGERS[name] = logger
-    return logger
+    def _build():
+        logger = logging.getLogger(name)
+        if not logger.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(logging.Formatter(
+                "%(asctime)s %(levelname).1s %(name)s] %(message)s"))
+            logger.addHandler(h)
+        lvl = level or os.environ.get("PADDLE_TPU_LOG_LEVEL", "INFO")
+        logger.setLevel(lvl.upper() if isinstance(lvl, str) else lvl)
+        logger.propagate = False
+        return logger
+
+    return _LOGGERS.get_or_create(name, _build)

@@ -1,0 +1,131 @@
+"""Audited module-state caches: a locked bounded LRU and a lazy singleton.
+
+Ad-hoc module-level dicts mutated from arbitrary call sites are exactly the
+`mutable-global` hazard staticcheck ratchets (tools/staticcheck/checkers/
+mutable_global.py): the thread-safety story of the dual eager/static
+dispatch machinery stays auditable only when every module-state write goes
+through a named installer or an audited container. This module is that
+audited container: state lives on class instances (never on module-level
+dicts), every write happens under the instance lock, and the call sites
+stay declarative. Users today: the compiled-op dispatch cache
+(paddle_tpu/ops/_op_cache.py), the logger registry (utils/log.py), the
+KL-divergence dispatch table (distribution/kl.py), and dispatch's lazy AMP
+hook import (ops/dispatch.py).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+
+class LockedLRU:
+    """Thread-safe bounded LRU map.
+
+    `maxsize=None` disables eviction (an audited registry rather than a
+    cache — use for genuinely bounded keyspaces like logger names or
+    registered type pairs). Eviction count is exposed for observability.
+    """
+
+    __slots__ = ("_d", "_lock", "_maxsize", "evictions")
+
+    def __init__(self, maxsize: Optional[int] = 128):
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self._maxsize = maxsize
+        self.evictions = 0
+
+    @property
+    def maxsize(self) -> Optional[int]:
+        return self._maxsize
+
+    def set_maxsize(self, maxsize: Optional[int]):
+        with self._lock:
+            self._maxsize = maxsize
+            self._shrink_locked()
+
+    def _shrink_locked(self):
+        if self._maxsize is None:
+            return
+        while len(self._d) > self._maxsize:
+            self._d.popitem(last=False)
+            self.evictions += 1
+
+    def get(self, key, default=None):
+        with self._lock:
+            try:
+                v = self._d[key]
+            except KeyError:
+                return default
+            self._d.move_to_end(key)
+            return v
+
+    def put(self, key, value):
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            self._shrink_locked()
+
+    def get_or_create(self, key, factory: Callable[[], Any]):
+        """Return the cached value, creating it via `factory()` on first use.
+
+        The factory runs OUTSIDE the lock (it may be slow or re-enter the
+        cache); if two threads race, the first stored value wins and both
+        callers observe it.
+        """
+        with self._lock:
+            try:
+                v = self._d[key]
+                self._d.move_to_end(key)
+                return v
+            except KeyError:
+                pass
+        created = factory()
+        with self._lock:
+            v = self._d.setdefault(key, created)
+            self._d.move_to_end(key)
+            self._shrink_locked()
+            return v
+
+    def pop(self, key, default=None):
+        with self._lock:
+            return self._d.pop(key, default)
+
+    def clear(self):
+        with self._lock:
+            self._d.clear()
+
+    def items(self):
+        with self._lock:
+            return list(self._d.items())
+
+    def __len__(self):
+        with self._lock:
+            return len(self._d)
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._d
+
+
+class Lazy:
+    """Thread-safe memoized zero-arg factory — the audited replacement for
+    the `global _thing; if _thing is None: _thing = ...` lazy-import idiom
+    (which staticcheck flags as a mutable-global rebind)."""
+
+    __slots__ = ("_factory", "_lock", "_value", "_ready")
+
+    def __init__(self, factory: Callable[[], Any]):
+        self._factory = factory
+        self._lock = threading.Lock()
+        self._value = None
+        self._ready = False
+
+    def __call__(self):
+        if self._ready:
+            return self._value
+        with self._lock:
+            if not self._ready:
+                self._value = self._factory()
+                self._ready = True
+        return self._value

@@ -1,0 +1,39 @@
+"""closure-capture known-answer fixture (AST-only — never imported).
+
+Positive captures (payload attribute, hoisted array, host copy), the
+sanctioned pass-it-through / static-config idioms (quiet), and a pragma'd
+copy — asserted line-by-line by tests/test_staticcheck.py.
+"""
+import jax.numpy as jnp
+
+from .dispatch import apply
+
+
+def captured_payload(x, y):
+    return apply(lambda v: v + y._value, x, op_name="covered_op")
+
+
+def captured_hoisted_array(x, mask):
+    m = jnp.asarray(mask)
+    return apply(lambda v: jnp.where(m, v, 0.0), x, op_name="covered_op")
+
+
+def captured_host_copy(x, y):
+    return apply(lambda v: v * y.numpy(), x, op_name="covered_op")
+
+
+def passed_through_ok(x, y):
+    return apply(lambda v, w: v + w, x, y, op_name="covered_op")
+
+
+def static_config_ok(x, axis=1):
+    return apply(lambda v: jnp.sum(v, axis=axis), x, op_name="covered_op")
+
+
+def metadata_only_ok(x, y):
+    k = y._value.shape
+    return apply(lambda v: jnp.reshape(v, k), x, op_name="covered_op")
+
+
+def suppressed_capture(x, y):
+    return apply(lambda v: v * y._value, x, op_name="covered_op")  # staticcheck: ok[closure-capture] — fixture: pragma'd copy of captured_payload

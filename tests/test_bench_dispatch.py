@@ -1,0 +1,59 @@
+"""Smoke-level guard for the dispatch microbenchmark.
+
+bench_dispatch must stay CPU-runnable and keep its one-JSON-line contract
+(it is the perf trajectory when the TPU probe reports tpu-unavailable), so
+a tiny-iteration run lives in tier-1. It is slow-exempt by design — a few
+seconds — but skips cleanly when the wall-clock budget is tight
+(PT_TIGHT_BUDGET=1) since it is a perf artifact, not a correctness gate.
+The >=3x acceptance ratio itself is asserted only in the slow battery:
+tiny iteration counts on a loaded CI box make ratios noisy.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(iters: int):
+    env = dict(os.environ, PT_DISPATCH_BENCH_ITERS=str(iters),
+               PT_DISPATCH_BENCH_WARMUP="5")
+    r = subprocess.run([sys.executable, os.path.join(REPO,
+                                                     "bench_dispatch.py")],
+                       capture_output=True, text=True, timeout=600, env=env,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout  # exactly ONE JSON line on stdout
+    return json.loads(lines[0]), r.stderr
+
+
+@pytest.mark.skipif(os.environ.get("PT_TIGHT_BUDGET") == "1",
+                    reason="wall-clock budget is tight; perf smoke skipped")
+def test_bench_dispatch_smoke_json_contract(tmp_path):
+    payload, stderr = _run_bench(iters=40)
+    assert payload["metric"] == "eager_dispatch_cached_speedup"
+    assert payload["unit"] == "x"
+    assert payload["value"] > 0 and payload["vs_baseline"] > 0
+    for wl in ("softmax_fwd", "gelu_fwd", "linear_train"):
+        assert f"{wl}_speedup" in payload
+    assert "artifact ->" in stderr
+    # artifact parses and carries the per-workload detail + cache counters
+    art = stderr.split("artifact ->", 1)[1].strip().splitlines()[0]
+    with open(art) as f:
+        self_json = json.load(f)
+    assert set(self_json["detail"]["workloads"]) == {
+        "softmax_fwd", "gelu_fwd", "linear_train"}
+    for wl, per in self_json["detail"]["workloads"].items():
+        ci = per["cache_info"]  # snapshot of the CACHED leg, per workload
+        assert ci["hits"] > 0 and ci["per_op"], (wl, ci)
+    os.unlink(art)  # tiny-iteration artifacts are not trajectory evidence
+
+
+@pytest.mark.slow
+def test_bench_dispatch_meets_acceptance_floor():
+    payload, _ = _run_bench(iters=300)
+    assert payload["value"] >= 3.0, payload

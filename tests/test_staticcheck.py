@@ -44,7 +44,7 @@ def test_all_rules_fire_on_fixtures(fixture_findings):
     rules = {f.rule for f in fixture_findings}
     assert rules >= {"tracer-branch", "numpy-on-tracer", "host-sync",
                      "registry-consistency", "mutable-global",
-                     "dead-export", "key-reuse"}, rules
+                     "dead-export", "key-reuse", "closure-capture"}, rules
     assert len(rules) >= 5  # the acceptance floor, trivially exceeded
 
 
@@ -102,6 +102,22 @@ def test_key_reuse_known_answers(fixture_findings):
     # apart from the deliberate key reuses)
     others = [f for f in fixture_findings
               if f.path.endswith("key_hazards.py") and f.rule != "key-reuse"]
+    assert others == [], others
+
+
+def test_closure_capture_known_answers(fixture_findings):
+    """closure_hazards.py: the three positive captures fire (payload
+    attribute, enclosing-scope hoisted array, host `.numpy()` copy); the
+    pass-through idiom, static config capture, metadata-only use
+    (`y._value.shape`), and the pragma'd copy stay quiet."""
+    cc = [f for f in fixture_findings if f.rule == "closure-capture"]
+    assert all(f.path == "paddle_tpu/ops/closure_hazards.py" for f in cc), cc
+    assert {f.line for f in cc} == {13, 18, 22}, cc
+    assert all(f.severity == "warning" for f in cc)
+    # and no OTHER rule trips over the closure fixture
+    others = [f for f in fixture_findings
+              if f.path.endswith("closure_hazards.py")
+              and f.rule != "closure-capture"]
     assert others == [], others
 
 

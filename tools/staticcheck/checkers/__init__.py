@@ -1,5 +1,6 @@
 """Checker registry — importing this package registers every checker."""
 from . import (  # noqa: F401
+    closure_capture,
     dead_export,
     host_sync,
     key_reuse,

@@ -1,0 +1,186 @@
+"""closure-capture: tensor payloads closed over by op lambdas.
+
+A function handed to `dispatch.apply`/`defprim` executes with its
+POSITIONAL params as the traced inputs. Any array payload it instead pulls
+from the enclosing scope rides along as a baked constant: it bypasses the
+autograd tape (no gradient flows to it), AMP casting, AND the compiled-op
+cache key (ops/_op_cache.py refuses to key on array-bearing closures, so
+the op silently stays uncached). masked_fill had exactly this bug; the fix
+is always to pass the payload through `apply()` as a positional argument.
+The long-deferred ROADMAP rule, now implemented.
+
+Two triggers, per traced function (entry apply/defprim/_wrap — jit-ed
+train steps legitimately close over parameter pytrees and are exempt):
+- direct: the body reads `X._value` / `X.numpy()` for a free variable X —
+  an unwrapped Tensor payload crossing the closure boundary;
+- indirect: a free variable X is used as a value and an enclosing
+  function assigns X from array-producing code (`jnp.*`/`jax.*` calls,
+  `_unwrap`/`_u`/`to_tensor`/`asarray`, or a `._value` unwrap).
+
+Free config captures (ints, axis tuples, flags) are the sanctioned idiom
+and never match either trigger. Module-level constants are exempt: they
+cannot go stale under a compiled executable and carry no per-call grad.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import STATIC_ATTRS, attr_root, call_name, traced_functions
+from ..core import Checker, Module, register
+
+_OP_ENTRIES = {"apply", "defprim", "_wrap"}
+_UNWRAP_CALLS = {"_unwrap", "_u", "to_tensor", "asarray", "array"}
+_ARRAY_ROOTS = {"jnp", "jax"}
+# jnp/jax calls that return shape/dtype metadata, not arrays
+_NONARRAY_CALLS = {"broadcast_shapes", "result_type", "promote_types",
+                   "issubdtype", "ndim", "shape", "size", "eval_shape"}
+
+
+def _is_payload_read(n: ast.Attribute) -> bool:
+    """`X._value` (payload crossing the closure) or `X.numpy()` (host copy
+    of it). Metadata chained off the payload (`X._value.shape`) and module
+    paths (`jax.numpy.flip`) are static and do not count."""
+    parent = getattr(n, "_sc_parent", None)
+    if n.attr == "_value":
+        return not (isinstance(parent, ast.Attribute)
+                    and parent.attr in STATIC_ATTRS)
+    if n.attr == "numpy":
+        return isinstance(parent, ast.Call) and parent.func is n
+    return False
+
+
+def _bound_names(fn_node: ast.AST) -> set[str]:
+    """Every name bound within the traced function (params of it and of any
+    nested function, assignment/loop/comprehension/with targets)."""
+    out: set[str] = set()
+    nodes = [fn_node]
+    for n in ast.walk(fn_node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            nodes.append(n)
+    for n in nodes:
+        a = n.args
+        for p in (a.posonlyargs + a.args + a.kwonlyargs):
+            out.add(p.arg)
+        if a.vararg:
+            out.add(a.vararg.arg)
+        if a.kwarg:
+            out.add(a.kwarg.arg)
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+            out.add(n.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)) and n is not fn_node:
+            out.add(n.name)
+    return out
+
+
+def _body_nodes(fn_node: ast.AST):
+    body = fn_node.body if isinstance(fn_node, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef)) \
+        else [fn_node.body]
+    for stmt in body:
+        yield from ast.walk(stmt)
+
+
+def _is_array_expr(expr: ast.AST) -> bool:
+    """Does this assignment RHS produce an array payload? (`x._value`,
+    `_unwrap(x)`, `jnp.tril(...)`, `t.numpy()`, ...).
+
+    Evidence is judged on the EXPRESSION HEAD (through tuple/comprehension/
+    conditional structure), not on arbitrary sub-expressions — a dict of
+    lambdas that mention `jax.lax` builds a function table, not an array.
+    A payload `._value` read anywhere in the RHS counts, except under a
+    metadata attribute (`t._value.shape` is a static shape)."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and n.attr == "_value" \
+                and not (isinstance(getattr(n, "_sc_parent", None),
+                                    ast.Attribute)
+                         and n._sc_parent.attr in STATIC_ATTRS):
+            return True
+    heads = [expr]
+    while heads:
+        e = heads.pop()
+        if isinstance(e, (ast.Tuple, ast.List)):
+            heads.extend(e.elts)
+        elif isinstance(e, ast.IfExp):
+            heads.extend((e.body, e.orelse))
+        elif isinstance(e, (ast.ListComp, ast.GeneratorExp)):
+            heads.append(e.elt)
+        elif isinstance(e, ast.Call):
+            name = call_name(e)
+            if name in _NONARRAY_CALLS:
+                continue
+            if name in _UNWRAP_CALLS or name == "numpy":
+                return True
+            if isinstance(e.func, ast.Attribute) \
+                    and attr_root(e.func) in _ARRAY_ROOTS:
+                return True
+    return False
+
+
+def _enclosing_functions(node: ast.AST):
+    cur = getattr(node, "_sc_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield cur
+        cur = getattr(cur, "_sc_parent", None)
+
+
+def _array_evidenced_names(traced_node: ast.AST) -> set[str]:
+    """Names assigned from array-producing expressions in any enclosing
+    function of the traced fn (module-level constants intentionally
+    excluded — see module docstring)."""
+    out: set[str] = set()
+    for fn in _enclosing_functions(traced_node):
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and _is_array_expr(n.value):
+                for t in n.targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            out.add(leaf.id)
+            elif isinstance(n, ast.AnnAssign) and n.value is not None \
+                    and _is_array_expr(n.value):
+                if isinstance(n.target, ast.Name):
+                    out.add(n.target.id)
+    return out
+
+
+@register
+class ClosureCaptureChecker(Checker):
+    rule = "closure-capture"
+    severity = "warning"
+
+    def check_module(self, mod: Module):
+        for traced in traced_functions(mod.tree):
+            if traced.entry not in _OP_ENTRIES:
+                continue
+            bound = _bound_names(traced.node)
+            evidenced = None  # computed lazily: most fns have no candidates
+            seen: set[str] = set()
+            for n in _body_nodes(traced.node):
+                if isinstance(n, ast.Attribute) \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id not in bound \
+                        and n.value.id not in seen \
+                        and _is_payload_read(n):
+                    seen.add(n.value.id)
+                    yield mod.finding(
+                        self.rule, self.severity, n,
+                        f"op function captures tensor payload "
+                        f"`{n.value.id}.{n.attr}` from the enclosing scope — "
+                        f"pass it through apply() as a positional arg "
+                        f"(closures bypass the tape, AMP, and the "
+                        f"compiled-op cache key)")
+                elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                        and n.id not in bound and n.id not in seen:
+                    if evidenced is None:
+                        evidenced = _array_evidenced_names(traced.node)
+                    if n.id in evidenced:
+                        seen.add(n.id)
+                        yield mod.finding(
+                            self.rule, self.severity, n,
+                            f"op function closes over `{n.id}`, an array "
+                            f"built in the enclosing function — pass it "
+                            f"through apply() as a positional arg (closures "
+                            f"bypass the tape, AMP, and the compiled-op "
+                            f"cache key)")
