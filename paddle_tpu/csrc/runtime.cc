@@ -13,7 +13,10 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
+
+#include <cerrno>
 
 #include <atomic>
 #include <chrono>
@@ -174,6 +177,8 @@ PT_API void pt_queue_free(void* q_) { delete (BlockingQueue*)q_; }
 //       4=WAIT(blocking) 5=DELETE 6=PING
 //       7=LEASE(grant/refresh; val = i64 ttl_ms; expiry on the SERVER clock)
 //       8=LEASE_CHECK(status 1 = alive, 0 = expired/absent)
+//       9=WAIT_TIMEOUT(val = i64 timeout_ms; status 0 = key present,
+//         -3 = server-side deadline expired — the no-hang variant of WAIT)
 // Leases give ETCD-style store-side liveness (reference
 // fleet/elastic/manager.py:126): expiry is decided by the store's own
 // clock, so every observer agrees regardless of its local timing.
@@ -181,13 +186,17 @@ PT_API void pt_queue_free(void* q_) { delete (BlockingQueue*)q_; }
 namespace {
 
 constexpr uint8_t kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kDel = 5, kPing = 6,
-                  kLease = 7, kLeaseCheck = 8;
+                  kLease = 7, kLeaseCheck = 8, kWaitT = 9;
 
 bool read_full(int fd, void* buf, size_t n) {
   auto* p = (uint8_t*)buf;
   while (n > 0) {
     ssize_t r = ::recv(fd, p, n, 0);
-    if (r <= 0) return false;
+    if (r == 0) {
+      errno = ECONNRESET;  // clean peer close must not report a stale EAGAIN
+      return false;
+    }
+    if (r < 0) return false;
     p += r;
     n -= (size_t)r;
   }
@@ -252,6 +261,24 @@ struct StoreServer {
             status = -1;
           } else if (cmd == kGet) {
             reply = kv[key];
+          }
+          break;
+        }
+        case kWaitT: {
+          // bounded WAIT: the server's own clock enforces the deadline, so
+          // a waiter never hangs on a key its peer will never publish
+          int64_t timeout_ms = 0;
+          if (val.size() == 8) memcpy(&timeout_ms, val.data(), 8);
+          std::unique_lock<std::mutex> lk(mu);
+          bool ok = cv.wait_for(
+              lk, std::chrono::milliseconds(timeout_ms),
+              [&] { return stopping.load() || kv.count(key) > 0; });
+          if (kv.count(key) > 0) {
+            status = 0;
+          } else if (stopping.load()) {
+            status = -1;
+          } else {
+            status = ok ? -1 : -3;  // -3: deadline expired key still absent
           }
           break;
         }
@@ -402,21 +429,95 @@ namespace {
 struct StoreClient {
   int fd = -1;
   std::mutex mu;  // one request/response in flight per client
+  double op_timeout_s = 0;  // 0 = unbounded (SO_RCVTIMEO/SO_SNDTIMEO off)
+  // last transport error: 0 ok, -1 connection lost, -3 socket deadline
+  // expired (the Python layer maps these to typed errors)
+  std::atomic<int> last_err{0};
+  // poisoned: a failed/interrupted rpc shutdown() the stream. The fd is
+  // NOT closed until pt_store_client_free so pt_store_client_shutdown can
+  // always safely shutdown() it from another thread (shutdown on a live
+  // fd is thread-safe; close would let the number be recycled under a
+  // concurrent recv).
+  std::atomic<bool> dead{false};
 };
 
+void set_socket_deadline(int fd, double secs) {
+  timeval tv{};
+  if (secs > 0) {
+    tv.tv_sec = (time_t)secs;
+    tv.tv_usec = (suseconds_t)((secs - (double)tv.tv_sec) * 1e6);
+  }
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+// deadline_s >= 0 overrides the client's default socket deadline for THIS
+// call only (used by the bounded wait, whose server-side timeout outlives
+// the per-op budget). The override is applied and restored under c->mu so
+// a concurrent rpc on the same client never sees a foreign deadline.
 bool client_rpc(StoreClient* c, uint8_t cmd, const std::string& key,
                 const void* val, uint32_t vlen, int64_t* status,
-                std::vector<uint8_t>* reply) {
+                std::vector<uint8_t>* reply, double deadline_s = -1.0) {
   std::lock_guard<std::mutex> lk(c->mu);
-  uint32_t klen = (uint32_t)key.size();
-  if (!write_full(c->fd, &cmd, 1) || !write_full(c->fd, &klen, 4) ||
-      (klen && !write_full(c->fd, key.data(), klen)) ||
-      !write_full(c->fd, &vlen, 4) || (vlen && !write_full(c->fd, val, vlen)))
+  auto fail = [&]() {
+    // a deadline expiry mid-message leaves the stream desynced: poison the
+    // connection so no later op reads a stale half-reply as its own
+    // (shutdown, not close — see StoreClient::dead)
+    c->last_err.store((errno == EAGAIN || errno == EWOULDBLOCK) ? -3 : -1);
+    if (c->fd >= 0) ::shutdown(c->fd, SHUT_RDWR);
+    c->dead.store(true);
     return false;
-  uint32_t rlen;
-  if (!read_full(c->fd, status, 8) || !read_full(c->fd, &rlen, 4)) return false;
-  reply->resize(rlen);
-  if (rlen && !read_full(c->fd, reply->data(), rlen)) return false;
+  };
+  if (c->fd < 0 || c->dead.load()) {
+    c->last_err.store(-1);
+    return false;
+  }
+  // one CUMULATIVE deadline across every chunk of the whole rpc: each
+  // chunk re-arms SO_RCVTIMEO/SO_SNDTIMEO from the REMAINING budget, so a
+  // peer trickling one byte per poll can't stretch one logical call past
+  // the bound (mirrors utils/deadline.py recv_exact on the Python side)
+  double eff = deadline_s >= 0 ? deadline_s : c->op_timeout_s;
+  double abs_dl = eff > 0 ? now_monotonic_s() + eff : 0;
+  auto io_full = [&](void* buf, size_t n, bool reading) {
+    auto* p = (uint8_t*)buf;
+    while (n > 0) {
+      if (abs_dl > 0) {
+        double left = abs_dl - now_monotonic_s();
+        if (left <= 0) {
+          errno = EAGAIN;  // classify as deadline expiry in fail()
+          return false;
+        }
+        set_socket_deadline(c->fd, left < 0.01 ? 0.01 : left);
+      }
+      ssize_t r = reading ? ::recv(c->fd, p, n, 0)
+                          : ::send(c->fd, p, n, MSG_NOSIGNAL);
+      if (r == 0 && reading) {
+        errno = ECONNRESET;
+        return false;
+      }
+      if (r <= 0) return false;
+      p += r;
+      n -= (size_t)r;
+    }
+    return true;
+  };
+  auto io = [&]() {
+    uint32_t klen = (uint32_t)key.size();
+    uint8_t cmd_b = cmd;
+    if (!io_full(&cmd_b, 1, false) || !io_full(&klen, 4, false) ||
+        (klen && !io_full((void*)key.data(), klen, false)) ||
+        !io_full(&vlen, 4, false) ||
+        (vlen && !io_full((void*)val, vlen, false)))
+      return false;
+    uint32_t rlen;
+    if (!io_full(status, 8, true) || !io_full(&rlen, 4, true)) return false;
+    reply->resize(rlen);
+    if (rlen && !io_full(reply->data(), rlen, true)) return false;
+    return true;
+  };
+  if (!io()) return fail();
+  if (abs_dl > 0) set_socket_deadline(c->fd, c->op_timeout_s);
+  c->last_err.store(0);
   return true;
 }
 
@@ -439,11 +540,17 @@ PT_API void* pt_store_client_new(const char* host, int port, double timeout_s) {
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       auto* c = new StoreClient();
       c->fd = fd;
+      // bound the handshake: a listener that accepts but never answers the
+      // ping (half-up master, wrong service) must not wedge the connect
+      set_socket_deadline(fd, 5.0);
       int64_t status = 0;
       std::vector<uint8_t> reply;
-      if (client_rpc(c, kPing, "", nullptr, 0, &status, &reply) && status == 42)
+      if (client_rpc(c, kPing, "", nullptr, 0, &status, &reply) &&
+          status == 42) {
+        set_socket_deadline(c->fd, c->op_timeout_s);
         return c;
-      ::close(fd);
+      }
+      if (c->fd >= 0) ::close(c->fd);  // sole owner: safe to really close
       delete c;
       return nullptr;
     }
@@ -488,6 +595,59 @@ PT_API int pt_store_wait(void* c_, const char* key) {
   if (!client_rpc((StoreClient*)c_, kWait, key, nullptr, 0, &status, &reply))
     return -1;
   return status < 0 ? -1 : 0;
+}
+
+// Bounded wait: the SERVER enforces timeout_s (kWaitT) while the client
+// socket deadline is temporarily widened past it, so the reply — present,
+// timed out, or stopping — always arrives instead of the client guessing.
+// Returns 0 key present, -3 deadline expired, -1 transport/server error.
+PT_API int pt_store_wait_timeout(void* c_, const char* key, double timeout_s) {
+  auto* c = (StoreClient*)c_;
+  if (timeout_s < 0) timeout_s = 0;
+  int64_t ms = (int64_t)(timeout_s * 1e3);
+  int64_t status = 0;
+  std::vector<uint8_t> reply;
+  // per-call socket-deadline override is applied inside client_rpc under
+  // c->mu, so a concurrent rpc on this client never runs with our widened
+  // deadline (or has its fd's options mutated mid-read)
+  bool ok = client_rpc(c, kWaitT, key, &ms, 8, &status, &reply,
+                       timeout_s + 5.0);
+  if (!ok) return c->last_err.load() == -3 ? -3 : -1;
+  return status == 0 ? 0 : (status == -3 ? -3 : -1);
+}
+
+// Per-operation socket deadline for every subsequent rpc on this client
+// (0 disables). A partitioned master then fails each call within the bound
+// instead of hanging recv() forever.
+PT_API void pt_store_client_set_op_timeout(void* c_, double secs) {
+  auto* c = (StoreClient*)c_;
+  std::lock_guard<std::mutex> lk(c->mu);
+  c->op_timeout_s = secs > 0 ? secs : 0;
+  if (c->fd >= 0) set_socket_deadline(c->fd, c->op_timeout_s);
+}
+
+// Last transport error on this client: 0 ok, -1 connection lost,
+// -3 socket deadline expired (typed-error mapping happens in Python).
+PT_API int pt_store_client_last_error(void* c_) {
+  return ((StoreClient*)c_)->last_err.load();
+}
+
+// Interrupt an in-flight rpc from another thread: shutdown() wakes a
+// blocked recv immediately and poisons the client, so stop() never waits
+// out a long server-side wait. Safe without c->mu — the fd stays
+// allocated until pt_store_client_free.
+PT_API void pt_store_client_shutdown(void* c_) {
+  auto* c = (StoreClient*)c_;
+  c->dead.store(true);
+  if (c->fd >= 0) ::shutdown(c->fd, SHUT_RDWR);
+}
+
+// 1 iff the client can still carry requests (connected and not poisoned).
+// Lets the op layer detect dead-at-entry BEFORE sending, where reconnect
+// is single-send safe even for non-idempotent ops like add.
+PT_API int pt_store_client_ok(void* c_) {
+  auto* c = (StoreClient*)c_;
+  return (c->fd >= 0 && !c->dead.load()) ? 1 : 0;
 }
 
 PT_API int pt_store_delete(void* c_, const char* key) {

@@ -1,4 +1,4 @@
-"""Crash-point fault injection (chaos harness).
+"""Crash-point and fault-mode injection (chaos harness).
 
 The durability claims of the checkpoint layer are only as good as the
 worst place a preemption can land. This module gives every dangerous
@@ -15,15 +15,35 @@ no flushing, no cleanup — exactly the failure a fleet preemption or OOM
 kill delivers. Unarmed sites cost one dict lookup and are always safe to
 leave in production code.
 
-Sites register themselves at module import via `register()` so the crash
-matrix in tests/test_ckpt_chaos.py can enumerate every registered site
-and prove recovery from each one, including sites added later: a new
-`crashpoint()` call in the save path automatically widens the matrix.
+`faultpoint()` generalizes the same pattern to the LIVENESS failures a
+distributed job actually sees (ISSUE 5 — the no-hang guarantee). A
+registered fault site sits on a blocking primitive's hot path and can be
+armed with one of four modes:
+
+    PT_FAULTPOINT=store.client.rpc      # the armed site
+    PT_FAULTPOINT_MODE=delay:2.5        # crash | delay:<secs> | error | drop
+    PT_FAULTPOINT_HITS=1                # fire on this many hits, then disarm
+                                        # (0 or 'inf' = every hit)
+    PT_FAULTPOINT_SKIP=2                # let the first N hits pass clean
+
+  - crash        SIGKILL, same as crashpoint (a preempted peer)
+  - delay:<secs> sleep at the site (a partitioned/hung peer; the caller's
+                 deadline must convert the stall into a typed timeout)
+  - error        raise FaultInjected (a peer that answers garbage)
+  - drop         raise FaultDrop, a ConnectionError (the wire died
+                 mid-operation; retry/reconnect paths must absorb it)
+
+Sites register themselves at module import via `register()` /
+`register_fault()` so the fault matrices (tests/test_ckpt_chaos.py,
+tests/test_no_hang.py) can enumerate every registered site and prove
+recovery from each one, including sites added later: a new `crashpoint()`
+or `faultpoint()` call automatically widens the matrix.
 """
 from __future__ import annotations
 
 import os
 import signal
+import time
 
 # site name -> short description of the window it guards
 _REGISTRY: dict[str, str] = {}
@@ -72,3 +92,84 @@ def crashpoint(site: str) -> None:
 def reset_hits() -> None:
     """Forget hit counts (tests that arm several sites in one process)."""
     _hits.clear()
+    _fault_hits.clear()
+
+
+# ---------------------------------------------------------------------------
+# faultpoint(): mode-carrying fault injection for blocking primitives
+# ---------------------------------------------------------------------------
+
+class FaultInjected(RuntimeError):
+    """An armed `error`-mode faultpoint fired (a peer answered garbage)."""
+
+    def __init__(self, site: str, mode: str = "error"):
+        self.site = site
+        self.mode = mode
+        super().__init__(f"injected fault at {site!r} (mode={mode})")
+
+
+class FaultDrop(FaultInjected, ConnectionError):
+    """An armed `drop`-mode faultpoint fired: the wire died mid-operation.
+    Subclasses ConnectionError so the call site's real reconnect/retry
+    path handles it exactly like a genuine connection loss."""
+
+    def __init__(self, site: str):
+        super().__init__(site, mode="drop")
+
+
+# fault site name -> short description of the blocking window it guards
+_FAULTS: dict[str, str] = {}
+
+_fault_hits: dict[str, int] = {}
+
+
+def register_fault(site: str, description: str = "") -> str:
+    """Declare a fault site (idempotent), mirroring register()."""
+    _FAULTS.setdefault(site, description)
+    return site
+
+
+def fault_sites(prefix: str = "") -> list[str]:
+    """All declared fault sites (optionally prefix-filtered), sorted — the
+    enumeration the no-hang fault matrix parametrizes over."""
+    return sorted(s for s in _FAULTS if s.startswith(prefix))
+
+
+def describe_fault(site: str) -> str:
+    return _FAULTS.get(site, "")
+
+
+def _fault_should_fire(site: str) -> bool:
+    """Deterministic hit counting: skip the first PT_FAULTPOINT_SKIP hits,
+    then fire PT_FAULTPOINT_HITS times (default 1; 0/'inf' = forever)."""
+    _fault_hits[site] = _fault_hits.get(site, 0) + 1  # staticcheck: ok[mutable-global] — per-process hit counter IS the feature (PT_FAULTPOINT_HITS/SKIP determinism)
+    hit = _fault_hits[site]
+    skip = int(os.environ.get("PT_FAULTPOINT_SKIP", "0") or 0)
+    if hit <= skip:
+        return False
+    raw = os.environ.get("PT_FAULTPOINT_HITS", "1") or "1"
+    if raw.lower() in ("0", "inf"):
+        return True
+    return hit - skip <= int(raw)
+
+
+def faultpoint(site: str) -> None:
+    """Inject the armed fault mode here iff this site is armed via
+    PT_FAULTPOINT. Unarmed sites cost one dict lookup plus one getenv."""
+    if site not in _FAULTS:
+        register_fault(site)
+    if os.environ.get("PT_FAULTPOINT") != site:
+        return
+    if not _fault_should_fire(site):
+        return
+    mode = os.environ.get("PT_FAULTPOINT_MODE", "error").strip()
+    if mode == "crash":
+        # identical contract to crashpoint(): nothing after this line runs
+        os.kill(os.getpid(), signal.SIGKILL)
+    if mode.startswith("delay"):
+        _, _, secs = mode.partition(":")
+        time.sleep(float(secs or 1.0))
+        return
+    if mode == "drop":
+        raise FaultDrop(site)
+    raise FaultInjected(site)

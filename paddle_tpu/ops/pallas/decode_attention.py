@@ -75,7 +75,7 @@ def _kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems, *,
                 dma.start()
 
         for dma in chunk_dma(ik, slot):
-            dma.wait()
+            dma.wait()  # staticcheck: ok[unbounded-blocking] — on-device DMA issued by this kernel's own schedule; completion is guaranteed by construction, there is no peer to time out on
         k = k_buf[slot]
         v = v_buf[slot]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),

@@ -15,3 +15,7 @@ def run_check():
     return True
 from .compat import deprecated, require_version, try_import  # noqa: E402,F401
 from . import dlpack  # noqa: E402,F401
+from .deadline import (  # noqa: E402,F401
+    DataLoaderTimeout, Deadline, DeadlineExceeded, RpcTimeout,
+    StoreConnectionError, StoreTimeout,
+)

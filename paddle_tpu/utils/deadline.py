@@ -1,0 +1,132 @@
+"""Deadlines and the typed no-hang error hierarchy.
+
+PR 2 made the checkpoint layer durable (a killed writer never corrupts
+state); this module is the *liveness* half of the fault story: no blocking
+primitive in paddle_tpu may wait unboundedly. Every hang-prone site — store
+RPCs, `TCPStore.wait`, the rpc transport, DataLoader batch handoffs — takes
+a budget and raises a subclass of `DeadlineExceeded` when it runs out, so a
+partitioned master or a hung peer fails fast into the elastic restart path
+instead of wedging the job silently (a hung trainer is worse than a dead
+one: nothing relaunches it).
+
+The `Deadline` helper carries one budget across a multi-step operation
+(connect, send, read header, read payload): each step asks `remaining()`
+for what is left rather than re-spending the full timeout.
+"""
+from __future__ import annotations
+
+import time
+
+
+class DeadlineExceeded(TimeoutError):
+    """A blocking primitive exceeded its time budget.
+
+    Carries the site ("what was being waited on") and the budget, so the
+    error names the stuck dependency instead of a bare "timed out".
+    """
+
+    def __init__(self, what: str, timeout: float | None = None,
+                 detail: str = ""):
+        self.what = what
+        self.timeout = timeout
+        msg = f"deadline exceeded: {what}"
+        if timeout is not None:
+            msg += f" (budget {timeout:.3g}s)"
+        if detail:
+            msg += f" — {detail}"
+        super().__init__(msg)
+
+
+class StoreTimeout(DeadlineExceeded):
+    """A TCPStore operation (rpc / wait) ran out of budget."""
+
+
+class RpcTimeout(DeadlineExceeded):
+    """A distributed.rpc call ran out of budget."""
+
+
+class DataLoaderTimeout(DeadlineExceeded):
+    """No batch arrived from the DataLoader workers within `timeout`."""
+
+
+class StoreConnectionError(ConnectionError):
+    """Terminal store-client failure: the connection died (or desynced
+    mid-message) and reconnect-plus-retry did not recover it."""
+
+
+class Deadline:
+    """One time budget shared across the steps of a blocking operation.
+
+    `Deadline(None)` is unbounded (remaining() returns None, check() never
+    raises) so call sites can thread an optional timeout without branching.
+    """
+
+    __slots__ = ("timeout", "what", "_expiry")
+
+    def __init__(self, timeout: float | None, what: str = ""):
+        self.timeout = timeout
+        self.what = what
+        self._expiry = None if timeout is None \
+            else time.monotonic() + max(0.0, timeout)
+
+    @property
+    def expired(self) -> bool:
+        return self._expiry is not None and time.monotonic() >= self._expiry
+
+    def remaining(self, floor: float = 0.0) -> float | None:
+        """Budget left (clamped at `floor`), or None when unbounded. A
+        positive floor keeps socket timeouts from degenerating to zero —
+        the expiry itself is still enforced by check()."""
+        if self._expiry is None:
+            return None
+        return max(floor, self._expiry - time.monotonic())
+
+    def check(self, what: str = "", exc: type = DeadlineExceeded,
+              detail: str = "") -> None:
+        """Raise `exc` (a DeadlineExceeded subclass) if the budget is gone."""
+        if self.expired:
+            raise exc(what or self.what or "blocking operation",
+                      self.timeout, detail)
+
+    def sleep(self, secs: float) -> None:
+        """Sleep at most `secs`, never past the deadline."""
+        rem = self.remaining()
+        time.sleep(secs if rem is None else min(secs, rem))
+
+
+def recv_exact(sock, n: int, dl: "Deadline | None" = None,
+               closed_exc: type = ConnectionError,
+               what: str = "peer closed mid-message") -> bytes:
+    """Exact n-byte socket read shared by the store and rpc transports.
+
+    With a Deadline, every chunk re-arms the socket timeout from the
+    REMAINING budget and expiry is enforced BETWEEN chunks — the floor
+    keeps settimeout positive, so without the explicit expired check a
+    peer trickling one byte per poll could stretch one logical read
+    forever. Without a Deadline the read is unbounded by design
+    (server-side handler threads own their teardown).
+    """
+    import socket as _socket
+    buf = b""
+    while len(buf) < n:
+        if dl is not None:
+            if dl.expired:
+                raise _socket.timeout("read deadline exhausted")
+            sock.settimeout(dl.remaining(floor=0.01))
+        chunk = sock.recv(n - len(buf))  # staticcheck: ok[unbounded-blocking] — bounded by the Deadline when one is given; deadline-less callers are server handlers that own their teardown
+        if not chunk:
+            raise closed_exc(what)
+        buf += chunk
+    return buf
+
+
+def env_timeout(name: str, default: float) -> float:
+    """Read a timeout knob from the environment (seconds; <=0 means the
+    default — an accidental PT_*=0 must not disable the no-hang guarantee)."""
+    import os
+    raw = os.environ.get(name, "")
+    try:
+        val = float(raw)
+    except ValueError:
+        return default
+    return val if val > 0 else default

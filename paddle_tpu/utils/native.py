@@ -112,6 +112,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "pt_store_get": (c.c_long, [c.c_void_p, c.c_char_p, c.POINTER(c.c_void_p)]),
         "pt_store_add": (c.c_longlong, [c.c_void_p, c.c_char_p, c.c_longlong]),
         "pt_store_wait": (c.c_int, [c.c_void_p, c.c_char_p]),
+        "pt_store_wait_timeout": (c.c_int, [c.c_void_p, c.c_char_p,
+                                            c.c_double]),
+        "pt_store_client_set_op_timeout": (None, [c.c_void_p, c.c_double]),
+        "pt_store_client_last_error": (c.c_int, [c.c_void_p]),
+        "pt_store_client_shutdown": (None, [c.c_void_p]),
+        "pt_store_client_ok": (c.c_int, [c.c_void_p]),
         "pt_store_delete": (c.c_int, [c.c_void_p, c.c_char_p]),
         "pt_store_lease": (c.c_int, [c.c_void_p, c.c_char_p, c.c_longlong]),
         "pt_store_lease_check": (c.c_int, [c.c_void_p, c.c_char_p]),

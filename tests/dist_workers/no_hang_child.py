@@ -1,0 +1,67 @@
+"""Child payload for the no-hang fault matrix (tests/test_no_hang.py).
+
+Performs ONE blocking operation chosen by argv[1] (a registered fault
+site), with the fault armed via PT_FAULTPOINT* env by the parent, and
+reports the outcome on stdout:
+
+    CLEAN                    the op completed (fault absorbed or latency-only)
+    TYPED <ExceptionName>    a typed error was raised (never a hang)
+
+crash-mode faults SIGKILL this process instead — the parent asserts the
+-9 return code. Every blocking call below carries a small explicit budget
+(PT_TEST_BUDGET, default 1s) so even a regression that un-types an error
+still exits quickly rather than eating the matrix's subprocess timeout.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+BUDGET = float(os.environ.get("PT_TEST_BUDGET", "1.0"))
+
+
+def main(site: str) -> None:
+    if site == "store.client.rpc":
+        from paddle_tpu.distributed.store import create_master_store
+        s = create_master_store()
+        s.set("k", b"v")
+        assert s.get("k") == b"v"
+        s.stop()
+    elif site == "store.wait":
+        from paddle_tpu.distributed.store import create_master_store
+        s = create_master_store()
+        s.set("k", b"v")
+        s.wait("k", timeout=BUDGET)
+        s.stop()
+    elif site == "rpc.invoke":
+        from paddle_tpu.distributed import rpc
+        rpc.init_rpc("solo", rank=0, world_size=1)
+        try:
+            assert rpc.rpc_sync("solo", int, args=("7",),
+                                timeout=BUDGET) == 7
+        finally:
+            rpc.shutdown()
+    elif site == "io.worker_batch":
+        import numpy as np
+        import paddle_tpu.io as io
+
+        class _DS(io.Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.full((2,), i, np.float32)
+
+        list(io.DataLoader(_DS(), batch_size=4, num_workers=1,
+                           timeout=BUDGET))
+    else:
+        raise ValueError(f"unknown fault site {site!r}")
+
+
+if __name__ == "__main__":
+    try:
+        main(sys.argv[1])
+    except BaseException as e:  # noqa: BLE001 — the TYPE is the result
+        print(f"TYPED {type(e).__name__}", flush=True)
+        sys.exit(3)
+    print("CLEAN", flush=True)

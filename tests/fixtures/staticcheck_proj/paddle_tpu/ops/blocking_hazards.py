@@ -1,0 +1,44 @@
+"""unbounded-blocking known-answer fixtures.
+
+Positives: an argless queue get, a store-style wait keyed by a string, a
+predicate wait_for with no bound, and a raw socket recv. Negatives: every
+bounded variant (timeout kwarg, numeric positional, interval-named bound),
+dict-style get with a key, and the pragma'd copy.
+"""
+
+
+def q_get_forever(q):
+    return q.get()
+
+
+def store_wait_forever(store):
+    store.wait("roster_ready")
+
+
+def cond_wait_forever(cond):
+    with cond:
+        cond.wait_for(lambda: False)
+
+
+def raw_recv(sock):
+    return sock.recv(4096)
+
+
+def bounded_ok(q, ev, store, popen):
+    q.get(timeout=1.0)
+    ev.wait(0.5)
+    store.wait("key", timeout=2.0)
+    popen.wait(timeout=3)
+
+
+def dict_get_ok(d, env):
+    return d.get("key", 0), env.get("PT_FLAG")
+
+
+def interval_bound_ok(stop, cfg):
+    stop.wait(cfg.interval)
+    stop.wait(cfg.poll_timeout)
+
+
+def suppressed_get(q):
+    return q.get()  # staticcheck: ok[unbounded-blocking] — fixture: pragma must silence the rule

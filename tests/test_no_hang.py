@@ -1,0 +1,472 @@
+"""No-hang fault matrix (ISSUE 5) — liveness complement to test_ckpt_chaos.
+
+The law under test: NO blocking primitive in paddle_tpu waits unboundedly.
+For every fault site registered in distributed/chaos.py, arming each
+applicable mode (delay / drop / error / crash) must end in a typed error —
+`StoreTimeout`, `RpcTimeout`, `DataLoaderTimeout`, `DataLoaderWorkerError`,
+`FaultInjected` — or a clean absorb (retry/reconnect), always within an
+explicit bound. A hang here is itself the bug, so every potentially
+blocking assertion runs under `run_bounded` (a daemon-thread watchdog)
+and every subprocess case carries its own communicate() timeout: an
+accidental regression fails in seconds instead of eating the tier-1
+budget.
+
+Quick cases run in tier-1; the full site x mode subprocess matrix is
+`slow`.
+"""
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.io as io
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed import rpc as rpc_mod
+from paddle_tpu.distributed import store as store_mod
+from paddle_tpu.distributed.store import _GET, _PyStoreServer
+from paddle_tpu.io.dataloader import DataLoaderWorkerError
+from paddle_tpu.utils.deadline import (DataLoaderTimeout, RpcTimeout,
+                                       StoreConnectionError, StoreTimeout)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "dist_workers", "no_hang_child.py")
+
+# (site, mode) -> expected outcome of one end-to-end child operation:
+#   sigkill          the process dies at the armed site (crash mode)
+#   clean            the fault is absorbed (retry/reconnect/latency-only)
+#   typed <Name>     the op raises exactly this typed error — never hangs
+MATRIX = {
+    ("store.client.rpc", "crash"):    ("sigkill", None),
+    ("store.client.rpc", "delay:1.5"): ("clean", None),
+    ("store.client.rpc", "error"):    ("typed", "FaultInjected"),
+    ("store.client.rpc", "drop"):     ("clean", None),
+    ("store.wait", "crash"):          ("sigkill", None),
+    ("store.wait", "delay:2.0"):      ("typed", "StoreTimeout"),
+    ("store.wait", "error"):          ("typed", "FaultInjected"),
+    ("store.wait", "drop"):           ("clean", None),
+    ("rpc.invoke", "crash"):          ("sigkill", None),
+    ("rpc.invoke", "delay:2.0"):      ("typed", "RpcTimeout"),
+    ("rpc.invoke", "error"):          ("typed", "FaultInjected"),
+    ("rpc.invoke", "drop"):           ("typed", "FaultDrop"),
+    ("io.worker_batch", "crash"):     ("typed", "DataLoaderWorkerError"),
+    ("io.worker_batch", "delay:30"):  ("typed", "DataLoaderTimeout"),
+    ("io.worker_batch", "error"):     ("typed", "RuntimeError"),
+    ("io.worker_batch", "drop"):      ("typed", "RuntimeError"),
+}
+
+
+def run_bounded(fn, budget: float, what: str):
+    """Run `fn` under a watchdog: a hang past `budget` fails the test NOW
+    (daemon thread — an abandoned hang can't block interpreter exit)."""
+    result = {}
+
+    def target():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            result["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(budget)
+    if t.is_alive():
+        pytest.fail(f"HANG: {what} still blocked after {budget}s — "
+                    f"the no-hang guarantee is broken")
+    if "error" in result:
+        raise result["error"]
+    return result.get("value")
+
+
+@pytest.fixture
+def arm(monkeypatch):
+    """Arm one faultpoint via env (auto-disarmed + hit counters reset)."""
+    def _arm(site, mode, hits="1", skip="0"):
+        monkeypatch.setenv("PT_FAULTPOINT", site)
+        monkeypatch.setenv("PT_FAULTPOINT_MODE", mode)
+        monkeypatch.setenv("PT_FAULTPOINT_HITS", hits)
+        monkeypatch.setenv("PT_FAULTPOINT_SKIP", skip)
+        chaos.reset_hits()
+    yield _arm
+    chaos.reset_hits()
+
+
+@pytest.fixture(params=["native", "py"])
+def master_store(request, monkeypatch):
+    """One master TCPStore per backend: the native C++ server/client pair
+    and the pure-Python fallback (both speak the same wire protocol)."""
+    if request.param == "py":
+        class _NoNative:
+            @staticmethod
+            def get_lib():
+                return None
+        monkeypatch.setattr(store_mod, "native", _NoNative)
+    elif store_mod.native.get_lib() is None:
+        pytest.skip("native runtime unavailable")
+    s = store_mod.TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    yield s
+    s.stop()
+
+
+# ---------------- registry coverage ----------------
+
+def test_matrix_covers_every_registered_fault_site():
+    """Adding a faultpoint() to a blocking primitive must widen this
+    matrix: a registered site absent from MATRIX fails here until the
+    matrix says what every mode must do there."""
+    assert {s for s, _ in MATRIX} == set(chaos.fault_sites())
+    # every site is exercised in all four modes
+    for site in chaos.fault_sites():
+        modes = {m.split(":")[0] for s, m in MATRIX if s == site}
+        assert modes == {"crash", "delay", "error", "drop"}, (site, modes)
+
+
+def test_faultpoint_hit_accounting(arm):
+    """PT_FAULTPOINT_SKIP skips, PT_FAULTPOINT_HITS fires-then-disarms —
+    the determinism the drop-retry semantics rely on."""
+    site = chaos.register_fault("test.hits")
+    arm(site, "error", hits="2", skip="1")
+    chaos.faultpoint(site)                      # skip window
+    for _ in range(2):                          # firing window
+        with pytest.raises(chaos.FaultInjected):
+            chaos.faultpoint(site)
+    chaos.faultpoint(site)                      # disarmed again
+    arm(site, "error", hits="inf")
+    for _ in range(3):                          # unlimited firing
+        with pytest.raises(chaos.FaultInjected):
+            chaos.faultpoint(site)
+
+
+# ---------------- store: bounded waits, drop-retry, partition ----------------
+
+def test_store_wait_times_out_on_absent_key(master_store):
+    t0 = time.monotonic()
+    with pytest.raises(StoreTimeout):
+        run_bounded(lambda: master_store.wait("never/published", timeout=0.4),
+                    10.0, "TCPStore.wait on an absent key")
+    assert time.monotonic() - t0 < 5.0
+    # present keys still return immediately
+    master_store.set("present", b"1")
+    run_bounded(lambda: master_store.wait("present", timeout=5.0),
+                10.0, "TCPStore.wait on a present key")
+
+
+def test_store_client_survives_one_drop_then_succeeds(master_store, arm):
+    master_store.set("k", b"v")
+    arm("store.client.rpc", "drop", hits="1")
+    # the injected wire death is absorbed by reconnect + single retry
+    assert run_bounded(lambda: master_store.get("k"), 30.0,
+                       "store get under one drop fault") == b"v"
+    # and the fault really fired (not a no-op pass)
+    assert chaos._fault_hits.get("store.client.rpc", 0) >= 1
+
+
+def test_store_wait_delay_fault_raises_typed_timeout(master_store, arm):
+    master_store.set("k", b"v")
+    arm("store.wait", "delay:1.0")
+    t0 = time.monotonic()
+    with pytest.raises(StoreTimeout):
+        run_bounded(lambda: master_store.wait("k", timeout=0.3),
+                    10.0, "store wait under delay fault")
+    # the stall became a typed error at ~the injected delay, not a hang
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_store_error_fault_propagates_typed(master_store, arm):
+    arm("store.client.rpc", "error")
+    with pytest.raises(chaos.FaultInjected):
+        run_bounded(lambda: master_store.get("k"), 10.0,
+                    "store get under error fault")
+
+
+class _HalfDeadServer:
+    """Answers the PING handshake, then never replies again — the
+    partitioned master from the audit (store.py used to settimeout(None)
+    after connect, hanging every subsequent rpc here forever)."""
+
+    def __init__(self):
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                fd, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(fd,),
+                             daemon=True).start()
+
+    def _serve(self, fd):
+        try:
+            while True:
+                hdr = _PyStoreServer._read_full(fd, 5)
+                if hdr is None:
+                    return
+                cmd, klen = struct.unpack("<BI", hdr)
+                if klen:
+                    _PyStoreServer._read_full(fd, klen)
+                (vlen,) = struct.unpack(
+                    "<I", _PyStoreServer._read_full(fd, 4))
+                if vlen:
+                    _PyStoreServer._read_full(fd, vlen)
+                if cmd == 6:  # PING: let the handshake pass...
+                    fd.sendall(struct.pack("<qI", 42, 0))
+                # ...then silence on everything else: the partition
+        except OSError:
+            pass
+
+    def close(self):
+        self._srv.close()
+
+
+def test_partitioned_master_raises_typed_timeout_then_terminal():
+    srv = _HalfDeadServer()
+    try:
+        c = store_mod._PyClient("127.0.0.1", srv.port, timeout=10.0)
+        t0 = time.monotonic()
+        with pytest.raises(StoreTimeout):
+            run_bounded(lambda: c.rpc(_GET, "k", timeout=0.4), 10.0,
+                        "py client rpc against a partitioned master")
+        assert time.monotonic() - t0 < 5.0
+        # desync law: the timed-out connection is poisoned, later calls
+        # get the typed terminal error instead of parsing a stale reply
+        with pytest.raises(StoreConnectionError, match="disconnected"):
+            c.rpc(_GET, "k", timeout=0.4)
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_add_on_poisoned_client_heals_at_entry_exactly_once(master_store):
+    """add() never retries after a send (double-apply would break the
+    exact-count rendezvous), but a connection POISONED by an earlier op is
+    detected before anything is sent — reconnect there is single-send safe
+    and the counter advances exactly once."""
+    assert master_store.add("cnt", 1) == 1
+    if master_store._lib is not None:
+        master_store._lib.pt_store_client_shutdown(master_store._client)
+    else:
+        master_store._client._teardown()
+    assert run_bounded(lambda: master_store.add("cnt", 1), 30.0,
+                       "add on a poisoned client") == 2
+
+
+def test_stop_interrupts_inflight_wait(master_store):
+    """stop() must not wait out an in-flight wait()'s full budget: the
+    shutdown-based interrupt wakes the blocked recv, the waiter gets a
+    typed error, and teardown completes in seconds."""
+    errs = {}
+
+    def waiter():
+        try:
+            master_store.wait("never/while/stopping", timeout=30.0)
+        except Exception as e:  # noqa: BLE001 — the type is the assertion
+            errs["e"] = e
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.3)  # let the wait reach the server
+    t0 = time.monotonic()
+    master_store.stop()
+    assert time.monotonic() - t0 < 5.0, "stop() waited out the wait budget"
+    t.join(10.0)
+    assert not t.is_alive(), "waiter still blocked after stop()"
+    assert isinstance(errs.get("e"),
+                      (StoreConnectionError, StoreTimeout, RuntimeError)), errs
+
+
+def test_ops_after_stop_raise_typed_never_crash(monkeypatch):
+    """A stopped store's client handle is gone: later ops (e.g. a
+    straggler heartbeat) must get the typed StoreConnectionError after a
+    SHORT reconnect budget — never a NULL handle into the C library."""
+    monkeypatch.setenv("PT_STORE_RECONNECT_TIMEOUT", "0.5")
+    s = store_mod.create_master_store()
+    s.set("k", b"v")
+    s.stop()
+    t0 = time.monotonic()
+    with pytest.raises((StoreConnectionError, StoreTimeout)):
+        run_bounded(lambda: s.get("k"), 30.0, "store op after stop()")
+    assert time.monotonic() - t0 < 10.0
+
+
+class _TrickleServer(_HalfDeadServer):
+    """Keeps the stream alive but delivers each reply one byte per 100ms —
+    the trickle that defeats per-recv socket timeouts unless the client
+    also enforces the overall Deadline between chunks."""
+
+    def _serve(self, fd):
+        try:
+            while True:
+                hdr = _PyStoreServer._read_full(fd, 5)
+                if hdr is None:
+                    return
+                cmd, klen = struct.unpack("<BI", hdr)
+                if klen:
+                    _PyStoreServer._read_full(fd, klen)
+                (vlen,) = struct.unpack(
+                    "<I", _PyStoreServer._read_full(fd, 4))
+                if vlen:
+                    _PyStoreServer._read_full(fd, vlen)
+                reply = struct.pack("<qI", 42 if cmd == 6 else 0, 0)
+                if cmd == 6:  # PING: answer promptly so the handshake passes
+                    fd.sendall(reply)
+                    continue
+                for i in range(len(reply)):
+                    fd.sendall(reply[i:i + 1])
+                    time.sleep(0.1)
+        except OSError:
+            pass
+
+
+def test_trickling_master_cannot_stretch_the_deadline():
+    """Each 1-byte chunk arrives well inside the per-recv floor; only the
+    cross-chunk Deadline check bounds the logical read (review finding)."""
+    srv = _TrickleServer()
+    try:
+        c = store_mod._PyClient("127.0.0.1", srv.port, timeout=10.0)
+        t0 = time.monotonic()
+        with pytest.raises(StoreTimeout):
+            run_bounded(lambda: c.rpc(_GET, "k", timeout=0.5), 10.0,
+                        "py client rpc against a trickling master")
+        assert time.monotonic() - t0 < 3.0
+        c.close()
+    finally:
+        srv.close()
+
+
+# ---------------- rpc ----------------
+
+@pytest.fixture
+def solo_rpc():
+    rpc_mod.init_rpc("solo", rank=0, world_size=1)
+    yield
+    rpc_mod.shutdown()
+
+
+def test_rpc_delay_fault_raises_rpc_timeout(solo_rpc, arm):
+    arm("rpc.invoke", "delay:1.0")
+    t0 = time.monotonic()
+    with pytest.raises(RpcTimeout):
+        run_bounded(
+            lambda: rpc_mod.rpc_sync("solo", int, args=("7",), timeout=0.3),
+            10.0, "rpc_sync under delay fault")
+    assert time.monotonic() - t0 < 5.0
+    # the agent is still healthy afterwards
+    chaos.reset_hits()
+    assert rpc_mod.rpc_sync("solo", int, args=("8",)) == 8
+
+
+def test_rpc_drop_fault_raises_connection_error(solo_rpc, arm):
+    arm("rpc.invoke", "drop")
+    with pytest.raises(ConnectionError):
+        run_bounded(lambda: rpc_mod.rpc_sync("solo", int, args=("7",)),
+                    10.0, "rpc_sync under drop fault")
+
+
+# ---------------- DataLoader ----------------
+
+class _DS(io.Dataset):
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        return np.full((4,), i, np.float32)
+
+
+def test_dataloader_worker_sigkill_raises_typed_error(arm):
+    """A SIGKILLed worker mid-epoch (the OOM-kill scenario) surfaces as
+    DataLoaderWorkerError naming the worker and signal — the old receiver
+    spun on data_queue.get(timeout=0.2) forever."""
+    arm("io.worker_batch", "crash")
+    t0 = time.monotonic()
+    with pytest.raises(DataLoaderWorkerError) as ei:
+        run_bounded(
+            lambda: list(io.DataLoader(_DS(), batch_size=8, num_workers=2)),
+            30.0, "DataLoader with a SIGKILLed worker")
+    assert time.monotonic() - t0 < 20.0
+    assert ei.value.exitcode == -signal.SIGKILL
+    assert "signal 9" in str(ei.value)
+
+
+def test_dataloader_stalled_worker_raises_timeout(arm):
+    arm("io.worker_batch", "delay:30", hits="inf")
+    t0 = time.monotonic()
+    with pytest.raises(DataLoaderTimeout):
+        run_bounded(
+            lambda: list(io.DataLoader(_DS(), batch_size=8, num_workers=2,
+                                       timeout=0.7)),
+            30.0, "DataLoader with stalled workers and timeout=")
+    assert time.monotonic() - t0 < 20.0
+
+
+def test_dataloader_unaffected_when_unarmed():
+    batches = list(io.DataLoader(_DS(), batch_size=8, num_workers=2))
+    assert len(batches) == 4
+
+
+# ---------------- subprocess crash + the full slow matrix ----------------
+
+def _spawn_case(site, mode, tmp_dir):
+    env = dict(os.environ,
+               PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu",
+               PT_FAULTPOINT=site,
+               PT_FAULTPOINT_MODE=mode,
+               PT_FAULTPOINT_HITS="1",
+               PT_FAULTPOINT_SKIP="0",
+               PT_TEST_BUDGET="1.0")
+    return subprocess.Popen([sys.executable, CHILD, site], cwd=str(tmp_dir),
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _assert_case(site, mode, proc):
+    # explicit per-case bound: a hang fails in 120s, not at tier-1's 870s
+    out, err = proc.communicate(timeout=120)
+    expect, typed = MATRIX[(site, mode)]
+    label = f"{site} x {mode}"
+    if expect == "sigkill":
+        assert proc.returncode == -signal.SIGKILL, (
+            f"{label}: expected SIGKILL at the armed site, got "
+            f"rc={proc.returncode}\n{out}\n{err[-2000:]}")
+    elif expect == "clean":
+        assert proc.returncode == 0 and "CLEAN" in out, (
+            f"{label}: expected the fault absorbed, got rc={proc.returncode}"
+            f"\n{out}\n{err[-2000:]}")
+    else:
+        assert proc.returncode == 3 and f"TYPED {typed}" in out, (
+            f"{label}: expected typed {typed}, got rc={proc.returncode}"
+            f"\n{out}\n{err[-2000:]}")
+
+
+def test_crash_fault_kills_at_store_site(tmp_path):
+    """Quick tier-1 representative of the crash column: the child dies by
+    SIGKILL at the armed store site, exactly like a preempted peer."""
+    proc = _spawn_case("store.client.rpc", "crash", tmp_path)
+    _assert_case("store.client.rpc", "crash", proc)
+
+
+@pytest.mark.slow
+def test_full_fault_matrix_no_case_hangs(tmp_path):
+    """Every (site, mode) pair concurrently: the armed child must die by
+    SIGKILL, absorb the fault, or raise the expected typed error — and do
+    so within each case's explicit subprocess timeout. Zero hangs."""
+    procs = {}
+    for (site, mode) in sorted(MATRIX):
+        d = tmp_path / f"{site}_{mode}".replace(".", "_").replace(":", "_")
+        d.mkdir()
+        procs[(site, mode)] = _spawn_case(site, mode, d)
+    for (site, mode), proc in procs.items():
+        _assert_case(site, mode, proc)

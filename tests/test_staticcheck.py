@@ -44,7 +44,8 @@ def test_all_rules_fire_on_fixtures(fixture_findings):
     rules = {f.rule for f in fixture_findings}
     assert rules >= {"tracer-branch", "numpy-on-tracer", "host-sync",
                      "registry-consistency", "mutable-global",
-                     "dead-export", "key-reuse", "closure-capture"}, rules
+                     "dead-export", "key-reuse", "closure-capture",
+                     "unbounded-blocking"}, rules
     assert len(rules) >= 5  # the acceptance floor, trivially exceeded
 
 
@@ -74,7 +75,10 @@ def test_registry_cross_check_both_directions(fixture_findings):
 def test_static_metadata_and_static_numpy_not_flagged(fixture_findings):
     # metadata_branch_ok (v.ndim branch) and numpy_static_ok (np.arange on a
     # static shape) are hazard-free idioms the heuristics must not flag
+    # (line windows are hazards.py's — other fixture files have their own)
     for f in fixture_findings:
+        if not f.path.endswith("paddle_tpu/ops/hazards.py"):
+            continue
         assert not (24 <= f.line <= 29), f      # metadata_branch_ok body
         assert not (38 <= f.line <= 42), f      # numpy_static_ok body
 
@@ -118,6 +122,22 @@ def test_closure_capture_known_answers(fixture_findings):
     others = [f for f in fixture_findings
               if f.path.endswith("closure_hazards.py")
               and f.rule != "closure-capture"]
+    assert others == [], others
+
+
+def test_unbounded_blocking_known_answers(fixture_findings):
+    """blocking_hazards.py: the four positives fire (argless q.get(),
+    string-keyed store.wait, boundless cond.wait_for, raw sock.recv); every
+    bounded variant (timeout kwarg, numeric positional, interval-named
+    bound), dict-style get, and the pragma'd copy stay quiet."""
+    ub = [f for f in fixture_findings if f.rule == "unbounded-blocking"]
+    assert all(f.path == "paddle_tpu/ops/blocking_hazards.py" for f in ub), ub
+    assert {f.line for f in ub} == {11, 15, 20, 24}, ub
+    assert all(f.severity == "warning" for f in ub)
+    # and no OTHER rule trips over the blocking fixture
+    others = [f for f in fixture_findings
+              if f.path.endswith("blocking_hazards.py")
+              and f.rule != "unbounded-blocking"]
     assert others == [], others
 
 

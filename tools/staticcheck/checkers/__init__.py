@@ -8,4 +8,5 @@ from . import (  # noqa: F401
     numpy_on_tracer,
     registry_consistency,
     tracer_branch,
+    unbounded_blocking,
 )

@@ -1,0 +1,98 @@
+"""unbounded-blocking: blocking waits with no deadline in paddle_tpu/.
+
+The no-hang guarantee (ISSUE 5) says every blocking primitive must carry a
+bound: a partitioned store, a hung peer, or a SIGKILLed worker then raises
+a typed `DeadlineExceeded` into the elastic restart path instead of
+wedging the job silently. This rule flags the call shapes that wait
+forever by construction:
+
+  - `q.get()` with no arguments and no `timeout=` — a blocking queue pop
+    (`d.get(key)` always has a positional argument and is never flagged);
+  - `x.wait(...)` / `x.wait_for(...)` with neither a `timeout=` keyword
+    nor a positional argument that is plausibly a bound (a numeric
+    literal, or a name like `timeout`/`deadline`/`interval`/`budget` —
+    `Event.wait(0.5)` and `stop.wait(self.interval)` pass,
+    `store.wait("key")` and `cond.wait()` fail);
+  - `sock.recv(...)`-family reads — a socket deadline is invisible
+    statically, so every raw read must either run under a managed
+    `Deadline` or state why it may park forever, via the pragma.
+
+Deliberately unbounded sites (server-side handler threads released by
+stop(), device DMA waits) get `# staticcheck: ok[unbounded-blocking]`
+with the rationale; everything else fails the ratchet.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, register
+
+_WAIT_METHODS = {"wait", "wait_for"}
+_RECV_METHODS = {"recv", "recv_into", "recvfrom", "recvmsg"}
+# positional-argument names that plausibly carry a time bound
+_BOUND_HINTS = ("timeout", "deadline", "interval", "budget", "secs",
+                "seconds", "remaining")
+
+
+def _has_timeout_kwarg(node: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in node.keywords)
+
+
+def _plausible_bound(arg: ast.AST) -> bool:
+    """Is this positional argument plausibly a time bound?"""
+    if isinstance(arg, ast.Constant):
+        return isinstance(arg.value, (int, float)) \
+            and not isinstance(arg.value, bool)
+    name = None
+    if isinstance(arg, ast.Name):
+        name = arg.id
+    elif isinstance(arg, ast.Attribute):
+        name = arg.attr
+    elif isinstance(arg, ast.Call):
+        f = arg.func
+        name = f.attr if isinstance(f, ast.Attribute) \
+            else f.id if isinstance(f, ast.Name) else None
+    if name is None:
+        return False
+    low = name.lower()
+    return any(h in low for h in _BOUND_HINTS)
+
+
+@register
+class UnboundedBlockingChecker(Checker):
+    rule = "unbounded-blocking"
+    severity = "warning"
+
+    def check_module(self, mod: Module):
+        if not mod.path.startswith("paddle_tpu/"):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+            if attr == "get":
+                if not node.args and not node.keywords:
+                    yield mod.finding(
+                        self.rule, self.severity, node,
+                        "`.get()` with no timeout blocks forever if the "
+                        "producer dies — pass `timeout=` and handle Empty, "
+                        "or pragma with why this queue is always fed")
+            elif attr in _WAIT_METHODS:
+                if _has_timeout_kwarg(node):
+                    continue
+                if any(_plausible_bound(a) for a in node.args):
+                    continue
+                yield mod.finding(
+                    self.rule, self.severity, node,
+                    f"`.{attr}()` without a bound waits forever on a peer "
+                    f"that never delivers — pass `timeout=` (typed "
+                    f"DeadlineExceeded beats a silent hang), or pragma "
+                    f"with why this wait is released by construction")
+            elif attr in _RECV_METHODS:
+                yield mod.finding(
+                    self.rule, self.severity, node,
+                    f"raw `.{attr}()` — a socket deadline is invisible "
+                    f"statically; run the read under utils.deadline."
+                    f"Deadline (re-arming settimeout per chunk) or pragma "
+                    f"with why this read may park forever")
