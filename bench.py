@@ -223,6 +223,46 @@ def _emit(payload: dict, detail: dict | None = None):
             print(f"# artifact write failed: {e}", file=sys.stderr)
 
 
+def _cpu_proxy_fallback(probe_err: str):
+    """TPU unreachable after the patient probe phase: measure the tiny
+    llama config on CPU so the round still records a real number.
+
+    The metric name and an explicit "backend": "cpu-proxy" label keep it
+    from ever being read as chip throughput; vs_baseline stays 0.0 because
+    no TPU baseline applies to a CPU measurement."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    cfg_kwargs = dict(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_attention_heads=4)
+    try:
+        meas = _build_and_time(cfg_kwargs, layers=2, batch=2, seq=64,
+                               n_steps=10, warmup=2)
+    except Exception as e:  # noqa: BLE001 — proxy is best-effort
+        print(json.dumps({
+            "metric": "llama_cpu_proxy_train_tokens_per_sec",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+            "backend": "cpu-proxy", "error": "cpu-proxy-failed",
+            "detail": str(e)[:300]}), flush=True)
+        return
+    tokens_per_sec = meas["batch"] * meas["seq"] / meas["step_time_s"]
+    payload = {
+        "metric": "llama_cpu_proxy_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "backend": "cpu-proxy",
+        "tpu_probe_error": probe_err,
+        "n_params_measured": meas["n_params"],
+    }
+    _emit(payload, {"backend": "cpu-proxy", "measured": meas,
+                    "note": "TPU unreachable; tiny-config CPU measurement "
+                            "so the perf trajectory records a real number"})
+    print(f"# cpu-proxy: {tokens_per_sec:.1f} tokens/s "
+          f"(step={meas['step_time_s']*1000:.1f}ms, "
+          f"params={meas['n_params']/1e6:.2f}M)", file=sys.stderr)
+
+
 def main():
     config = os.environ.get("PT_BENCH_CONFIG", "7b_proxy")
     # Fail loud-but-parseable when the chip is unreachable: an explicit
@@ -249,6 +289,12 @@ def main():
             err = _probe_patient(history, budget)
             _write_probe_history(history)
             if err is not None:
+                # Degrade to a CPU mini-proxy instead of leaving only zeros:
+                # the final JSON line supersedes the error line above with a
+                # REAL measured number, clearly labeled "backend":
+                # "cpu-proxy" so the relay never mistakes it for chip perf
+                # but the perf trajectory stops flying blind.
+                _cpu_proxy_fallback(err)
                 return
 
     import jax

@@ -52,6 +52,19 @@ def amp_state() -> _AmpState:
     return _state
 
 
+def amp_cache_key():
+    """Hashable token of everything about the amp regime that a compiled
+    program bakes in — THE cache-key component for every compile tier
+    (to_static signatures, whole-step capture signatures), defined once so
+    the tiers cannot drift when a field is added."""
+    import numpy as np
+    if not _state.enabled:
+        return False
+    return (True, np.dtype(_state.dtype).name,
+            tuple(sorted(_state.custom_white)),
+            tuple(sorted(_state.custom_black)))
+
+
 def amp_dtype_for(op_name: str):
     """Called by ops.dispatch: returns the target dtype if this op should be
     autocast, else None.

@@ -1,7 +1,20 @@
-"""paddle_tpu.jit — trace/compile/save/load (analog of python/paddle/jit/)."""
+"""paddle_tpu.jit — trace/compile/save/load (analog of python/paddle/jit/).
+
+Two compile tiers live here:
+- `to_static` (api.py): per-function trace -> XLA, the reference's dy2static.
+- whole-step capture (capture.py + passes/): trace an ENTIRE train/decode
+  step once, run the graft-level pass pipeline, lower to a single XLA
+  executable — per-op cache as the fallback tier.
+"""
 from .api import (  # noqa: F401
     InputSpec, StaticFunction, enable_to_static, ignore_module, not_to_static,
     set_code_level, set_verbosity, to_static,
 )
 from . import api  # noqa: F401
+from .capture import (  # noqa: F401
+    CapturedStep, capture_clear, capture_info, capture_step, lower_step,
+    set_step_capture_enabled, step_capture_enabled,
+)
+from . import capture  # noqa: F401
+from . import passes  # noqa: F401
 from .save_load import save, load, TranslatedLayer  # noqa: F401

@@ -101,11 +101,8 @@ class StaticFunction:
         training = layer.training if layer is not None else True
 
         import numpy as np
-        from ..amp.auto_cast import amp_state
-        amp = amp_state()
-        amp_key = (amp.enabled, np.dtype(amp.dtype).name if amp.enabled else "",
-                   tuple(sorted(amp.custom_white)), tuple(sorted(amp.custom_black)))
-        key = (in_treedef, static_leaves, training, amp_key,
+        from ..amp.auto_cast import amp_cache_key
+        key = (in_treedef, static_leaves, training, amp_cache_key(),
                tuple((tuple(t.shape), np.dtype(t.dtype).name) for t in tensor_args))
         entry = self._cache.get(key)
         if entry is None:
@@ -171,7 +168,26 @@ class StaticFunction:
             return tuple(o._value if isinstance(o, Tensor) else jnp.asarray(o)
                          for o in out_leaves)
 
-        jitted = jax.jit(pure, static_argnames=())
+        # Route the compile through the whole-step capture pipeline
+        # (jit/capture.py): trace `pure` once over the current values, run
+        # the graft passes (fusion/cse/dve), and lower the transformed
+        # program. lower_step degrades to plain jax.jit(pure) on any
+        # capture failure (or PT_STEP_CAPTURE=0), so to_static behavior is
+        # a strict superset of the old path.
+        from . import capture as _capture
+        example = tuple(t._value for t in param_tensors) + tuple(
+            flat_in_template[i]._value for i in tensor_idx)
+        key0 = jax.random.key(0)  # aval-equal to gen.next_key()'s typed keys
+        lowered, prog = _capture.lower_step(
+            lambda *a: pure(*a[:-1], rng_key=a[-1]), (*example, key0))
+        if prog is not None:
+            def jitted(*vals, rng_key=None, _lowered=lowered):
+                if rng_key is None:
+                    rng_key = gen.next_key()
+                return _lowered(*vals, rng_key)
+            jitted.captured_program = prog
+        else:
+            jitted = jax.jit(pure, static_argnames=())
         return (jitted, out_cell, n_params)
 
 

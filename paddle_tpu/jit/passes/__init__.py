@@ -1,0 +1,107 @@
+"""Graft-level pass pipeline over captured whole-step programs.
+
+The analog of the reference's ProgramDesc/PIR pass managers
+(paddle/fluid/framework/ir/ graph fuse passes, paddle/ir/ PIR passes) and of
+CINN's graph-level optimizations — rebuilt on the jaxpr, the TPU-native
+program form a captured step canonicalizes into (jit/capture.py).  Each pass
+is jaxpr -> jaxpr, value-semantics preserving:
+
+- ``fusion``   — collapses nested compiled regions (`pjit` call equations:
+  to_static subprograms, jitted helpers, chains of per-op executables that
+  entered the trace as calls) into the parent program so XLA sees ONE
+  region to schedule and fuse across.
+- ``cse``      — common-subexpression elimination + duplicate-constant
+  folding (value-identical constvars collapse to one buffer).
+- ``dve``      — dead-value elimination: drops equations (and constants)
+  whose results never reach an output; effectful equations are kept.
+
+Donation inference (passes/donation.py) runs beside the pipeline: it maps
+(input avals, output avals) to the argument positions that can safely alias
+their output buffers (params/opt-state style updates).
+
+Every pass records what it did into a :class:`PassReport`; the capture layer
+surfaces the totals through ``profiler.step_capture_summary()``.
+
+Env: ``PT_STEP_CAPTURE_PASSES`` — comma-separated subset of
+``fusion,cse,dve`` (default ``all``; ``0``/``none`` disables the pipeline
+while keeping capture itself on).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+__all__ = ["PassReport", "run_pipeline", "default_passes"]
+
+_ALL = ("fusion", "cse", "dve")
+
+
+@dataclass
+class PassReport:
+    """What the pipeline did to one captured program."""
+    inlined_calls: int = 0      # pjit/call regions spliced into the parent
+    cse_folded: int = 0         # equations replaced by an earlier duplicate
+    consts_deduped: int = 0     # value-identical constants collapsed
+    dve_removed: int = 0        # dead equations dropped
+    dve_consts_dropped: int = 0  # constants orphaned by DVE
+    donated_args: Tuple[int, ...] = ()   # flat arg positions inferred donatable
+    eqns_before: int = 0
+    eqns_after: int = 0
+    passes_run: List[str] = field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return {
+            "inlined_calls": self.inlined_calls,
+            "cse_folded": self.cse_folded,
+            "consts_deduped": self.consts_deduped,
+            "dve_removed": self.dve_removed,
+            "dve_consts_dropped": self.dve_consts_dropped,
+            "donated_args": list(self.donated_args),
+            "eqns_before": self.eqns_before,
+            "eqns_after": self.eqns_after,
+            "passes_run": list(self.passes_run),
+        }
+
+
+def default_passes() -> Tuple[str, ...]:
+    """Pipeline selection from PT_STEP_CAPTURE_PASSES (default: all)."""
+    raw = os.environ.get("PT_STEP_CAPTURE_PASSES", "all").strip().lower()
+    if raw in ("0", "none", "off", ""):
+        return ()
+    if raw in ("all", "1"):
+        return _ALL
+    return tuple(p for p in (s.strip() for s in raw.split(",")) if p in _ALL)
+
+
+def run_pipeline(closed, passes=None, report: PassReport | None = None):
+    """Run the selected passes over a ClosedJaxpr.
+
+    Returns ``(closed_jaxpr, report)``. Passes are individually fallible by
+    design: a pass that raises is skipped (the program it received flows on
+    unchanged) — the capture layer still has the plain-jit fallback above
+    this, so the pipeline can only ever lose an optimization, not
+    correctness.
+    """
+    from . import cse as _cse
+    from . import dve as _dve
+    from . import fusion as _fusion
+
+    if report is None:
+        report = PassReport()
+    if passes is None:
+        passes = default_passes()
+    report.eqns_before = len(closed.jaxpr.eqns)
+    table = {"fusion": _fusion.inline_calls, "cse": _cse.fold,
+             "dve": _dve.eliminate}
+    for name in passes:
+        fn = table.get(name)
+        if fn is None:
+            continue
+        try:
+            closed = fn(closed, report)
+            report.passes_run.append(name)
+        except Exception:  # noqa: BLE001 — a pass may only lose optimization
+            report.passes_run.append(name + ":skipped")
+    report.eqns_after = len(closed.jaxpr.eqns)
+    return closed, report

@@ -1,0 +1,57 @@
+"""Buffer-donation inference for captured steps.
+
+A train step's params and optimizer state are update-in-place at the XLA
+level IF their input buffers are donated — without donation every step
+holds two copies of the model live. The eager tape can never know an input
+is dead after the step; whole-step capture can: an input whose buffer can
+alias some output (same shape/dtype) and whose old value the caller
+discards (params/opt-state threading) is donation-safe.
+
+Inference is aval-matching with guards, not a proof — so it is OPT-IN
+(`capture_step(donate="auto")`, `PT_STEP_CAPTURE_DONATE=auto`): a caller
+that re-reads a donated input afterwards gets jax's deleted-buffer error —
+never a wrong value. The capture layer poisons the signature, and because
+an eager rerun on already-deleted arrays cannot succeed either, it raises
+a RuntimeError naming the donation as the cause (fresh inputs run eagerly
+from then on).
+
+Rules, per flat input position:
+- only array leaves at least `min_bytes` big are considered (scalars like
+  lr/step gain nothing and are the likeliest to be reused by the caller);
+- each input needs a so-far-unmatched output with the same (shape, dtype)
+  — multiset matching, so three f32[4096,4096] inputs need three such
+  outputs;
+- positions listed in `reserved` (the capture layer passes batch-like args
+  there when it can tell) are never donated.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["infer_donation"]
+
+
+def _nbytes(aval) -> int:
+    try:
+        return int(np.dtype(aval.dtype).itemsize * int(np.prod(aval.shape)))
+    except Exception:  # noqa: BLE001 — opaque avals (keys): skip donation
+        return 0
+
+
+def infer_donation(in_avals, out_avals, min_bytes: int = 1024,
+                   reserved=()) -> tuple:
+    """-> flat input positions safe to donate (sorted tuple)."""
+    budget: dict = {}
+    for a in out_avals:
+        key = (tuple(a.shape), str(a.dtype))
+        budget[key] = budget.get(key, 0) + 1
+    donate = []
+    reserved = set(reserved)
+    for i, a in enumerate(in_avals):
+        if i in reserved or _nbytes(a) < min_bytes:
+            continue
+        key = (tuple(a.shape), str(a.dtype))
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            donate.append(i)
+    return tuple(donate)

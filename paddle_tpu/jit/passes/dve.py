@@ -1,0 +1,48 @@
+"""Dead-value elimination.
+
+Backward liveness walk from the program outputs: an equation whose results
+never reach an output (directly or through later equations) is dropped,
+along with any constants only it referenced. Equations carrying effects
+(io_callback, ordered side effects) are always kept — the captured-step
+contract forbids host effects anyway (they bail capture out), but the pass
+must stay sound on any jaxpr it is handed.
+
+The eager tape has no analog of this: every dispatched op executes. Whole-
+step capture is what makes "computed but never used" a statically decidable
+property — the reference gets the same from its ProgramDesc-level
+`eliminate_dead_code` style passes.
+"""
+from __future__ import annotations
+
+import jax.core as jcore
+
+
+def eliminate(closed, report):
+    jaxpr = closed.jaxpr
+    live = {v for v in jaxpr.outvars if isinstance(v, jcore.Var)}
+    kept = []
+    for eqn in reversed(jaxpr.eqns):
+        outs = [v for v in eqn.outvars if not isinstance(v, jcore.DropVar)]
+        # an equation is dead when nothing live reads it — including the
+        # all-outputs-dropped form jax leaves behind for unused bindings
+        if eqn.effects or any(v in live for v in outs):
+            kept.append(eqn)
+            for v in eqn.invars:
+                if isinstance(v, jcore.Var):
+                    live.add(v)
+        else:
+            report.dve_removed += 1
+    if not report.dve_removed:
+        return closed
+    kept.reverse()
+
+    constvars, consts = [], []
+    for cv, c in zip(jaxpr.constvars, closed.consts):
+        if cv in live:
+            constvars.append(cv)
+            consts.append(c)
+        else:
+            report.dve_consts_dropped += 1
+
+    from ._util import rebuild
+    return rebuild(jaxpr, constvars, consts, kept, jaxpr.outvars)

@@ -117,15 +117,20 @@ class LlamaAttention(Layer):
         q = manip.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = manip.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         v = manip.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
-        # cache note: `off` (KV decode offset) is closed over by the op
-        # lambdas below, which keeps the decode-step ops out of the compiled-op
-        # cache; it is a non-differentiable host scalar, so the only cost is
-        # uncached dispatch. Threading it through apply() as a traced arg
-        # would make decode steps cacheable — a follow-up, not a hazard.
+        # The KV decode offset is threaded through apply() as a TRACED i32
+        # scalar (not a closure capture), so every decode-step op is keyed
+        # only by avals — one compiled-op cache entry serves every token
+        # position, and whole-step capture sees the offset as a program
+        # input instead of a baked constant.
         off = position_offset._value if isinstance(position_offset, Tensor) \
             else position_offset
-        out = apply(lambda qq, kk: _rope(qq, kk, self.config.rope_theta, off),  # staticcheck: ok[closure-capture] — decode offset is a non-diff host scalar; see cache note above
-                    q, k, op_name="rope")
+        off = jnp.asarray(off, jnp.int32)
+        theta = self.config.rope_theta
+
+        def rope_fn(qq, kk, off_):
+            return _rope(qq, kk, theta, off_)
+
+        out = apply(rope_fn, q, k, off, op_name="rope")
         q, k = out[0], out[1]
         # heads sharded over mp
         q = shard_constraint_t(q, None, None, "mp", None)
@@ -139,15 +144,16 @@ class LlamaAttention(Layer):
             # program per (prefill, decode) shape, O(S) per new token.
             k_cache, v_cache = kv_cache
 
-            def upd(kc, vc, kn, vn):
+            def upd(kc, vc, kn, vn, off_):
                 z = jnp.asarray(0, jnp.int32)
-                start = (z, jnp.asarray(off, jnp.int32), z, z)  # staticcheck: ok[closure-capture] — decode offset, as above
+                start = (z, off_, z, z)
                 return (jax.lax.dynamic_update_slice(kc, kn.astype(kc.dtype),
                                                      start),
                         jax.lax.dynamic_update_slice(vc, vn.astype(vc.dtype),
                                                      start))
 
-            kv_out = apply(upd, k_cache, v_cache, k, v, op_name="kv_cache_upd")
+            kv_out = apply(upd, k_cache, v_cache, k, v, off,
+                           op_name="kv_cache_upd")
             k_cache, v_cache = kv_out[0], kv_out[1]
             s_max = k_cache.shape[1]
 
@@ -160,23 +166,22 @@ class LlamaAttention(Layer):
                 # single-token decode: ragged Pallas kernel walks only the
                 # live prefix of the cache (O(t) per token, no [B,H,S_max]
                 # probability tensor) — ops/pallas/decode_attention.py
-                def rag(qq, kc, vc):
+                def rag(qq, kc, vc, off_):
                     from ..ops.pallas.decode_attention import (
                         ragged_decode_attention)
-                    lengths = jnp.full((qq.shape[0],),
-                                       jnp.asarray(off, jnp.int32) + 1)  # staticcheck: ok[closure-capture] — decode offset, as above
+                    lengths = jnp.full((qq.shape[0],), off_ + 1)
                     return ragged_decode_attention(qq, kc, vc, lengths)
 
-                attn = apply(rag, q, k_cache, v_cache,
+                attn = apply(rag, q, k_cache, v_cache, off,
                              op_name="ragged_decode_attention")
             else:
-                def mk_mask(_shape_ref):
+                def mk_mask(_shape_ref, off_):
                     j = jnp.arange(s_max)[None, :]
-                    i = jnp.arange(s)[:, None] + jnp.asarray(off, jnp.int32)  # staticcheck: ok[closure-capture] — decode offset, as above
+                    i = jnp.arange(s)[:, None] + off_
                     allowed = j <= i
                     return jnp.where(allowed, 0.0, -1e30)[None, None]
 
-                mask = apply(mk_mask, q, op_name="decode_mask")
+                mask = apply(mk_mask, q, off, op_name="decode_mask")
                 attn = F.scaled_dot_product_attention(q, k_cache, v_cache,
                                                       attn_mask=mask)
             attn = manip.reshape(attn, [b, s, self.num_heads * self.head_dim])
@@ -292,9 +297,11 @@ class LlamaForCausalLM(Layer):
                 for _ in range(cfg.num_hidden_layers)]
 
     def _build_cached_step(self):
-        """One jitted fn serving both prefill ([B,P]) and decode ([B,1]) —
-        jax retraces per input shape; the KV caches are donated so decode
-        updates in place. Params are runtime args (small HLO)."""
+        """One compiled fn serving both prefill ([B,P]) and decode ([B,1]):
+        whole-step capture (jit/capture.py) memoizes one lowering per input
+        signature and donates the KV caches so decode updates in place.
+        Params are runtime args (small HLO). Falls back to plain jax.jit
+        when the capture tier is disabled."""
         model = self
         plist = list(model.parameters())
 
@@ -315,6 +322,10 @@ class LlamaForCausalLM(Layer):
                 for p, v in zip(plist, saved):
                     p._value = v
 
+        from ..jit import capture as _capture
+        if _capture.step_capture_enabled():
+            # donate arg 2 (the KV caches); the decode loop rebinds them
+            return _capture.capture_step(step, donate=(2,))
         return jax.jit(step, donate_argnums=(2,))
 
     @no_grad()
@@ -333,7 +344,13 @@ class LlamaForCausalLM(Layer):
             caches = [(kc._value, vc._value)
                       for kc, vc in self.init_kv_caches(b, s_max)]
             params = [p._value for p in self.parameters()]
-            step = self._build_cached_step()
+            # one step fn per model: the capture tier memoizes lowerings per
+            # input signature on the wrapper, so repeated generate() calls
+            # (and repeated shapes within one) reuse compiled programs
+            step = self.__dict__.get("_decode_step")
+            if step is None:
+                step = self._build_cached_step()
+                self.__dict__["_decode_step"] = step
             last, caches = step(params, ids._value, caches,
                                 jnp.asarray(0, jnp.int32))
             for t in range(max_new_tokens):

@@ -56,6 +56,7 @@ from ..utils.memo import LockedLRU
 __all__ = [
     "cached_forward", "cached_vjp", "cache_info", "cache_clear",
     "set_enabled", "set_maxsize", "set_compile_after", "enabled",
+    "set_capturing",
 ]
 
 _UNHASHABLE = object()
@@ -84,13 +85,25 @@ def set_compile_after(n: int):
     _compile_after = max(1, int(n))
 
 
+# Whole-step capture (jit/capture.py) flags its trace window so the cache
+# stands aside cleanly: tracer-driven calls during a capture are counted as
+# `captured` (they ARE being compiled — into the step program) rather than
+# polluting `bypasses`, and no first-sighting entries churn the LRU.
+_capturing = False
+
+
+def set_capturing(on: bool):
+    global _capturing
+    _capturing = bool(on)
+
+
 # ---------------------------------------------------------------------------
 # per-op observability counters
 # ---------------------------------------------------------------------------
 
 class _OpStats:
     __slots__ = ("hits", "misses", "retraces", "bwd_retraces", "bypasses",
-                 "bailouts", "deferred", "last_bailout")
+                 "bailouts", "deferred", "captured", "last_bailout")
 
     def __init__(self):
         self.hits = 0          # calls served by a compiled executable
@@ -100,13 +113,14 @@ class _OpStats:
         self.bypasses = 0      # uncacheable calls (tracer/unhashable/...)
         self.bailouts = 0      # executable failed -> entry poisoned
         self.deferred = 0      # warm calls below the compile_after threshold
+        self.captured = 0      # calls absorbed by a whole-step capture trace
         self.last_bailout = ""
 
     def snapshot(self) -> dict:
         return {"hits": self.hits, "misses": self.misses,
                 "retraces": self.retraces, "bwd_retraces": self.bwd_retraces,
                 "bypasses": self.bypasses, "bailouts": self.bailouts,
-                "deferred": self.deferred,
+                "deferred": self.deferred, "captured": self.captured,
                 **({"last_bailout": self.last_bailout}
                    if self.last_bailout else {})}
 
@@ -363,6 +377,11 @@ def _poison(entry: _Entry, stats: _OpStats, exc: Exception):
 def _lookup(kind, name, jax_fn, vals, static_kwargs, amp_dt, diff_idx,
             stats):
     """-> (entry | None, arr_pos). Entry None means bypass/uncacheable."""
+    if _capturing:
+        # a whole-step capture is tracing this op into one program — the
+        # per-op tier stands aside without key churn
+        stats.captured += 1
+        return None, None
     fnk = _fn_key(jax_fn)
     if fnk is None:
         stats.bypasses += 1
@@ -471,7 +490,7 @@ def cache_info() -> dict:
         per_op = {k: v.snapshot() for k, v in sorted(_STATS.items())}
     totals = {f: sum(s[f] for s in per_op.values())
               for f in ("hits", "misses", "retraces", "bwd_retraces",
-                        "bypasses", "bailouts", "deferred")}
+                        "bypasses", "bailouts", "deferred", "captured")}
     return {"enabled": _enabled, "size": len(_cache),
             "maxsize": _cache.maxsize, "compile_after": _compile_after,
             "evictions": _cache.evictions, **totals, "per_op": per_op}

@@ -19,6 +19,13 @@ vjp path, whose traced forward+pullback pair compiles once per key — see
 ops/_op_cache.py and `cache_info()`. Tracer inputs, static mode, and
 unhashable statics bypass the cache, so traced/to_static behavior is
 unchanged.
+
+The tier above both: whole-step capture (jit/capture.py) traces an ENTIRE
+train/decode step through this same apply() path once and lowers it to one
+XLA executable; while it records, a capture hook here logs each op site
+into the step's GraftProgram and the per-op cache stands aside (the
+`captured` counter). On any capture bailout the step falls back to eager
+dispatch, where the per-op cache serves as before.
 """
 from __future__ import annotations
 
@@ -35,8 +42,9 @@ from ..utils import memo
 from . import _op_cache
 
 __all__ = ["apply", "GradNode", "defprim", "set_static_recorder",
-           "cache_info", "cache_clear", "set_op_cache_enabled",
-           "set_op_cache_maxsize", "set_op_cache_compile_after"]
+           "set_capture_recorder", "cache_info", "cache_clear",
+           "set_op_cache_enabled", "set_op_cache_maxsize",
+           "set_op_cache_compile_after"]
 
 # Static-graph capture hook (installed by paddle_tpu.static.framework when
 # static mode is enabled). The analog of the reference's dual-world dispatch:
@@ -49,6 +57,18 @@ _static_recorder = None
 def set_static_recorder(fn):
     global _static_recorder
     _static_recorder = fn
+
+
+# Whole-step capture hook (installed by paddle_tpu.jit.capture while a step
+# is being traced): receives every dispatched op name, building the op-level
+# record of the captured program (the GraftProgram's ProgramDesc-shaped
+# view). Purely observational — execution still flows through jax tracing.
+_capture_cb = None
+
+
+def set_capture_recorder(cb):
+    global _capture_cb
+    _capture_cb = cb
 
 
 class GradNode:
@@ -196,6 +216,8 @@ def apply(jax_fn: Callable, *args, op_name: str | None = None, **static_kwargs):
     name = op_name or getattr(jax_fn, "__name__", "op")
     if _coverage_cb is not None:
         _coverage_cb(name)
+    if _capture_cb is not None:
+        _capture_cb(name)
     if _static_recorder is not None:
         rec = _static_recorder(jax_fn, args, static_kwargs, name)
         if rec is not NotImplemented:

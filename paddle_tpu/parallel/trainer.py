@@ -196,6 +196,9 @@ class TrainStep:
         self._accumulate_steps = max(int(accumulate_steps), 1)
 
         self._jitted = None
+        # GraftProgram of the captured step (None until built, or when the
+        # capture tier bailed out / is disabled and plain jax.jit is in use)
+        self.captured_program = None
         self._grad_clip = getattr(base_opt, "_grad_clip", None)
 
         # ---- self-healing state (device-side; never host-synced in-step) --
@@ -344,6 +347,19 @@ class TrainStep:
             return loss_val, new_vals, new_state, new_health
 
         donate = (0, 1, 2)
+        # Whole-step capture (jit/capture.py): trace pure_step once over the
+        # first batch's avals, run the graft pass pipeline (fusion/cse/dve),
+        # and lower the transformed program — semantics (grad-skip, loss
+        # scaling, donation, shardings) are unchanged because the body IS
+        # pure_step; any capture failure degrades to the plain jax.jit this
+        # always was (PT_STEP_CAPTURE=0 forces that).
+        from ..jit import capture as _capture
+        example = (
+            [p._value for p in self._params], self._opt_state, self._health,
+            example_inputs, jnp.asarray(0.0, jnp.float32),
+            jnp.asarray(1, jnp.int32),
+            jax.random.key(0),  # aval-equal to gen.next_key()'s typed keys
+        )
         if self.mesh is not None:
             # structures must match the argument containers (lists of
             # shardings / list of dicts), not tuples; the health scalars are
@@ -357,10 +373,12 @@ class TrainStep:
                     is_leaf=lambda x: hasattr(x, "ndim")),
                 None, None, None,
             )
-            self._jitted = jax.jit(pure_step, donate_argnums=donate,
-                                   in_shardings=in_shardings)
+            self._jitted, self.captured_program = _capture.lower_step(
+                pure_step, example, donate_argnums=donate,
+                in_shardings=in_shardings)
         else:
-            self._jitted = jax.jit(pure_step, donate_argnums=donate)
+            self._jitted, self.captured_program = _capture.lower_step(
+                pure_step, example, donate_argnums=donate)
 
     def __call__(self, batch):
         batch_vals = jax.tree_util.tree_map(

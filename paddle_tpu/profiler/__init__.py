@@ -12,5 +12,5 @@ from .profiler import (  # noqa: F401
     SummaryView, export_chrome_tracing, export_protobuf,
     load_profiler_result, make_scheduler,
 )
-from .statistic import op_cache_summary  # noqa: F401
+from .statistic import op_cache_summary, step_capture_summary  # noqa: F401
 from .timer import benchmark  # noqa: F401

@@ -55,6 +55,34 @@ def op_cache_summary(sorted_by: str = "hits") -> str:
     return "\n".join(lines)
 
 
+def step_capture_summary() -> str:
+    """Whole-step capture-tier counters (jit/capture.py) as text: how many
+    step programs were lowered, how many calls the lowered executables
+    served, how many captures bailed out (and why, last reason), plus the
+    pass-pipeline totals (inlined call regions, CSE folds, const dedupes,
+    dead values removed, donated buffers). A healthy steady-state training
+    loop pins `lowerings` at one per (step, aval-signature) with `hits`
+    climbing; climbing `bailouts` means the step keeps hitting an
+    uncapturable construct and is silently riding the per-op tier — see
+    README "Whole-step capture" for the bailout conditions."""
+    from ..jit import capture
+
+    info = capture.capture_info()
+    lines = [
+        f"step capture: enabled={info['enabled']} "
+        f"lowerings={info['lowerings']} hits={info['hits']} "
+        f"bailouts={info['bailouts']} fallback_calls={info['fallback_calls']}",
+        f"passes: inlined_calls={info['inlined_calls']} "
+        f"cse_folded={info['cse_folded']} "
+        f"consts_deduped={info['consts_deduped']} "
+        f"dve_removed={info['dve_removed']} "
+        f"donated_args={info['donated_args']}",
+    ]
+    if info["last_bailout"]:
+        lines.append(f"last bailout: {info['last_bailout']}")
+    return "\n".join(lines)
+
+
 def summary(events: List[dict], sorted_by: str = "total",
             time_unit: str = "ms") -> str:
     stats = aggregate(events)

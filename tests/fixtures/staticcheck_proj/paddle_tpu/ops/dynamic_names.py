@@ -1,0 +1,34 @@
+"""Instance-attribute op_name indirection (test fixture — never imported).
+
+The rnn.py dispatch shape: `op_name=self.mode.lower()` where `self.mode`
+is bound in __init__ from a parameter, and the concrete strings flow in
+from subclasses' `super().__init__(...)` calls (including a
+constant-armed conditional). registry-consistency must resolve
+"fixlstm" / "fixtanh" / "fixrelu" as dispatch sites — the fixture
+registry lists them, so a working resolver yields NO finding here while
+a regressed one reports them stale.
+"""
+from .dispatch import apply  # AST-only fixture: import never executes
+
+
+class _ModalBase:
+    def __init__(self, mode, width):
+        self.mode = mode
+        self.width = width
+
+    def forward(self, x):
+        def f(v):
+            return v
+
+        return apply(f, x, op_name=self.mode.lower())
+
+
+class FixLstm(_ModalBase):
+    def __init__(self, width):
+        super().__init__("FIXLSTM", width)
+
+
+class FixSimple(_ModalBase):
+    def __init__(self, width, activation="tanh"):
+        mode = "FIXTANH" if activation == "tanh" else "FIXRELU"
+        super().__init__(mode, width)

@@ -736,6 +736,10 @@ NOT_SWEPT = {
     "rope": "fused rotary embedding parity tested in "
             "tests/test_incubate_fused.py",
     "lstm": "composite recurrent layer; parity in tests/test_nn.py",
+    "rnn_tanh": "composite recurrent layer; parity in tests/test_nn.py",
+    "rnn_relu": "composite recurrent layer; parity in tests/test_nn.py",
+    "lstm_cell": "composite recurrent cell; parity in tests/test_nn.py",
+    "gru_cell": "composite recurrent cell; parity in tests/test_nn.py",
     "clone": "identity copy; covered by tensor-op suite",
     "getitem": "indexing dispatch; semantics covered by the tensor-op and "
                "manip suites (tests/test_tensor_ops.py)",

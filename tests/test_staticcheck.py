@@ -72,6 +72,17 @@ def test_registry_cross_check_both_directions(fixture_findings):
     assert stale.path == "tests/op_tolerances.py"      # at the registry
 
 
+def test_registry_dynamic_self_attr_op_names_resolved(fixture_findings):
+    """dynamic_names.py dispatches op_name=self.mode.lower(); the strings
+    flow from subclass super().__init__ constants (one a constant-armed
+    conditional). All three names are in the fixture registry, so a working
+    resolver reports NOTHING for them — neither stale (registry side) nor
+    ungoverned (dispatch side)."""
+    rc = {f.context for f in fixture_findings
+          if f.rule == "registry-consistency"}
+    assert not rc & {"fixlstm", "fixtanh", "fixrelu"}, rc
+
+
 def test_static_metadata_and_static_numpy_not_flagged(fixture_findings):
     # metadata_branch_ok (v.ndim branch) and numpy_static_ok (np.arange on a
     # static shape) are hazard-free idioms the heuristics must not flag

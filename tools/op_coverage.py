@@ -82,6 +82,15 @@ def drive():
         seq = P.to_tensor(rng.randn(2, 5, 16).astype(np.float32))
         o, _ = lstm(seq)
         o.sum().backward()
+        for act in ("tanh", "relu"):   # rnn_tanh / rnn_relu dispatch names
+            srnn = nn.SimpleRNN(16, 24, activation=act)
+            so, _ = srnn(seq)
+            so.sum().backward()
+        cell_x = P.to_tensor(rng.randn(3, 16).astype(np.float32))
+        lc_o, _ = nn.LSTMCell(16, 24)(cell_x)
+        lc_o.sum().backward()
+        gc_o, _ = nn.GRUCell(16, 24)(cell_x)
+        gc_o.sum().backward()
 
         # --- common tensor surface ---
         a = P.to_tensor(rng.randn(4, 4).astype(np.float32))

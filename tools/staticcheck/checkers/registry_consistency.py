@@ -13,9 +13,13 @@ pinned to. Cross-checked both directions:
 
 Op names are extracted statically: `op_name="..."` literals, defprim's
 positional name, the jax-callable's own name when op_name is omitted
-(apply(jnp.tril, ...) -> "tril"), and factory indirection — a function
-whose body calls apply(..., op_name=<param>) propagates string constants
-from its call sites (`abs = _unop("abs", jnp.abs)`).
+(apply(jnp.tril, ...) -> "tril"), factory indirection — a function whose
+body calls apply(..., op_name=<param>) propagates string constants from
+its call sites (`abs = _unop("abs", jnp.abs)`) — and instance-attribute
+indirection: `apply(..., op_name=self.mode.lower())` where `__init__` binds
+`self.mode = <param>` resolves through the string constants subclasses
+pass to `super().__init__(...)` (and direct instantiations), lowercased
+when the site calls `.lower()` — the rnn.py LSTM/GRU dispatch shape.
 """
 from __future__ import annotations
 
@@ -83,6 +87,76 @@ def _factory_arg_index(tree: ast.AST, fname: str, param: str) -> int | None:
     return None
 
 
+def _self_attr_op_name(node: ast.Call):
+    """-> (attr, lower?) for op_name=self.X / op_name=self.X.lower()."""
+    for kw in node.keywords:
+        if kw.arg != "op_name":
+            continue
+        v = kw.value
+        lower = False
+        if isinstance(v, ast.Call) and not v.args and not v.keywords \
+                and isinstance(v.func, ast.Attribute) \
+                and v.func.attr == "lower":
+            v = v.func.value
+            lower = True
+        if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+                and v.value.id == "self":
+            return v.attr, lower
+    return None
+
+
+def _class_init(cls: ast.ClassDef):
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "__init__":
+            return node
+    return None
+
+
+def _init_param_of_attr(init, attr: str):
+    """Index (0-based, after self) of the __init__ param bound to
+    `self.<attr>`, or None."""
+    params = [a.arg for a in init.args.posonlyargs + init.args.args][1:]
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Attribute) and t.attr == attr \
+                    and isinstance(t.value, ast.Name) and t.value.id == "self" \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in params:
+                return params.index(node.value.id), node.value.id
+    return None
+
+
+def _string_consts(expr, scope=None, depth=0) -> list[str]:
+    """String constants an expression can evaluate to: a literal, a
+    constant-armed conditional (`"A" if cond else "B"`), or a local name
+    bound to either within `scope` (the enclosing function)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, ast.IfExp):
+        return (_string_consts(expr.body, scope, depth)
+                + _string_consts(expr.orelse, scope, depth))
+    if isinstance(expr, ast.Name) and scope is not None and depth < 2:
+        out = []
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == expr.id:
+                out += _string_consts(node.value, scope, depth + 1)
+        return out
+    return []
+
+
+def _const_args(call: ast.Call, idx: int, pname: str, scope=None) -> list[str]:
+    for kw in call.keywords:
+        if kw.arg == pname:
+            return _string_consts(kw.value, scope)
+    if idx < len(call.args):
+        return _string_consts(call.args[idx], scope)
+    return []
+
+
 def _load_tolerance_names(root: str) -> set[str] | None:
     """Keys of FWD_OVERRIDES/GRAD_OVERRIDES/SKIPS, parsed without import."""
     path = os.path.join(root, TOLERANCES_PATH)
@@ -126,12 +200,27 @@ class RegistryConsistencyChecker(Checker):
         # pending factory indirection, resolved in finalize
         self._factories: dict[str, tuple[Module, str]] = {}
         self._calls: list[tuple[Module, ast.Call]] = []
+        # instance-attribute indirection (op_name=self.X[.lower()]):
+        # class name -> (module, ClassDef); pending sites to resolve
+        self._classes: dict[str, tuple[Module, ast.ClassDef]] = {}
+        self._attr_sites: list[tuple[Module, ast.Call, str, str, bool]] = []
 
     def check_module(self, mod: Module):
         if not mod.path.startswith("paddle_tpu"):
             return ()
         for fname, param in _factory_params(mod.tree).items():
             self._factories[fname] = (mod, param)
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            self._classes.setdefault(cls.name, (mod, cls))
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Call) \
+                        and call_name(node) in _ENTRY_NAMES:
+                    dyn = _self_attr_op_name(node)
+                    if dyn is not None:
+                        self._attr_sites.append(
+                            (mod, node, cls.name, dyn[0], dyn[1]))
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Call):
                 self._calls.append((mod, node))
@@ -140,6 +229,58 @@ class RegistryConsistencyChecker(Checker):
                     if name:
                         self._sites.setdefault(name, (mod, node))
         return ()
+
+    def _resolve_self_attr_sites(self):
+        """op_name=self.X[.lower()]: resolve through the string constants
+        flowing into the binding __init__ parameter — from subclasses'
+        `super().__init__(...)` / `Base.__init__(self, ...)` calls and from
+        direct instantiations."""
+        for mod, node, cls_name, attr, lower in self._attr_sites:
+            entry = self._classes.get(cls_name)
+            if entry is None:
+                continue
+            init = _class_init(entry[1])
+            if init is None:
+                continue
+            bound = _init_param_of_attr(init, attr)
+            if bound is None:
+                continue
+            idx, pname = bound
+            values: list[str] = []
+            # subclass super().__init__ / Base.__init__ forwarding
+            for _, sub_cls in self._classes.values():
+                bases = {b.id for b in sub_cls.bases
+                         if isinstance(b, ast.Name)}
+                if cls_name not in bases:
+                    continue
+                sub_init = _class_init(sub_cls)
+                if sub_init is None:
+                    continue
+                for call in ast.walk(sub_init):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    f = call.func
+                    is_super = (isinstance(f, ast.Attribute)
+                                and f.attr == "__init__"
+                                and isinstance(f.value, ast.Call)
+                                and isinstance(f.value.func, ast.Name)
+                                and f.value.func.id == "super")
+                    is_direct = (isinstance(f, ast.Attribute)
+                                 and f.attr == "__init__"
+                                 and isinstance(f.value, ast.Name)
+                                 and f.value.id == cls_name)
+                    if not (is_super or is_direct):
+                        continue
+                    off = 1 if is_direct else 0  # explicit self argument
+                    values += _const_args(call, idx + off, pname,
+                                          scope=sub_init)
+            # direct instantiations of the class itself
+            for call_mod, call in self._calls:
+                if isinstance(call.func, ast.Name) \
+                        and call.func.id == cls_name:
+                    values += _const_args(call, idx, pname)
+            for v in values:
+                self._sites.setdefault(v.lower() if lower else v, (mod, node))
 
     def _resolve_factory_sites(self):
         for fname, (fmod, param) in self._factories.items():
@@ -163,6 +304,7 @@ class RegistryConsistencyChecker(Checker):
         if tol is None and cov is None:
             return  # no registries in this tree — nothing to cross-check
         self._resolve_factory_sites()
+        self._resolve_self_attr_sites()
         registry = (tol or set()) | (cov or set())
         for name in sorted(set(self._sites) - registry):
             mod, node = self._sites[name]
