@@ -1,0 +1,193 @@
+"""Microbenchmark: continuous batching vs sequential per-request generate().
+
+Measures the serving engine (paddle_tpu/inference/serving) against the
+baseline it replaces — one `model.generate()` call per request, back to
+back — on the SAME mixed-length workload and the SAME tiny llama config.
+CPU-runnable ("backend": "cpu-proxy", same convention as bench.py) so the
+number stays measurable when the TPU probe reports tpu-unavailable:
+
+  sequential — for each request: prefill + per-token KV-cache decode at
+               batch 1 (each token is one whole-step-captured executable
+               call serving ONE sequence).
+  continuous — the ServingEngine: same executables, but every decode step
+               serves every active slot, with requests joining/leaving
+               between steps as they arrive/finish.
+
+Prints ONE JSON line:
+  {"metric": "serving_throughput_speedup_vs_sequential", "value": <x>,
+   "unit": "x", "vs_baseline": <value/1.5>, "backend": "cpu-proxy",
+   "p50_token_ms": ..., "p99_token_ms": ..., ...}
+(acceptance: value >= 1.5) and writes a BENCH_SELF_SERVE_<ts>.json
+artifact with the full workload, engine.info() counters (occupancy,
+pool, lowering counts), and the latency distribution.
+
+The workload keeps the queue deeper than the batch (requests >> slots)
+— the serving regime continuous batching exists for; a trickle workload
+(queue < batch) degenerates to sequential-with-padding and measures ~1x
+on a CPU where tiny-model decode is compute-bound, not dispatch-bound.
+
+Env: PT_SERVE_BENCH_REQUESTS (default 24), PT_SERVE_BENCH_BATCH (8),
+     PT_SERVE_BENCH_REPS (3).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# steady-state dispatch is the subject, not compile thrash: sequential
+# generate() lowers one (prefill, decode) pair PER DISTINCT request shape
+# (its cache is sized prompt+new), so the mixed workload needs more step-
+# capture signatures than the default 16-entry LRU or the sequential leg
+# measures retracing instead of serving
+os.environ.setdefault("PT_STEP_CAPTURE_SIZE", "128")
+
+import jax
+
+# serving-loop overhead is the subject — always measure on CPU (the env's
+# sitecustomize may register a TPU plugin; jax.config wins over env vars)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as P  # noqa: E402
+from paddle_tpu.inference.serving import ServingEngine  # noqa: E402
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM  # noqa: E402
+
+MAX_SEQ = 64  # sized to the workload: 28 prompt + 32 new <= 64
+
+
+def _build():
+    P.seed(0)
+    cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                           inter=128, seq=MAX_SEQ)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _workload(n, vocab, seed=0):
+    """Mixed-length: prompts 4..28 tokens, 16..32 new tokens per request."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        plen = int(rng.randint(4, 29))
+        new = int(rng.randint(16, 33))
+        out.append((rng.randint(0, vocab, (plen,)), new))
+    return out
+
+
+def _run_sequential(model, work):
+    outs = []
+    token_times = []
+    t0 = time.perf_counter()
+    for prompt, new in work:
+        tprev = time.perf_counter()
+        ids = P.to_tensor(prompt.reshape(1, -1))
+        out = model.generate(ids, max_new_tokens=new)
+        tend = time.perf_counter()
+        outs.append(np.asarray(out.numpy())[0])
+        # generate() is opaque per-token; spread the call time uniformly
+        # (an upper bound on its p50, fair since its tokens are serial)
+        token_times += [(tend - tprev) / new] * new
+    wall = time.perf_counter() - t0
+    n_tokens = sum(new for _, new in work)
+    return outs, n_tokens / wall, token_times
+
+
+def _run_continuous(model, work, batch):
+    eng = ServingEngine(model, max_batch=batch, max_seq_len=MAX_SEQ)
+    t0 = time.perf_counter()
+    reqs = [eng.submit(prompt, max_new_tokens=new) for prompt, new in work]
+    eng.run()
+    wall = time.perf_counter() - t0
+    outs = [r.result() for r in reqs]
+    # per-token inter-arrival latency per request (first token measured
+    # from submission — includes queueing, the honest serving number)
+    lat = []
+    for r in reqs:
+        prev = r.submit_time
+        for t in r.token_times:
+            lat.append(t - prev)
+            prev = t
+    n_tokens = sum(len(r.output_tokens) for r in reqs)
+    return outs, n_tokens / wall, lat, eng
+
+
+def main() -> dict:
+    n_requests = int(os.environ.get("PT_SERVE_BENCH_REQUESTS", "24"))
+    batch = int(os.environ.get("PT_SERVE_BENCH_BATCH", "8"))
+    reps = int(os.environ.get("PT_SERVE_BENCH_REPS", "3"))
+
+    model, cfg = _build()
+    work = _workload(n_requests, cfg.vocab_size)
+
+    # warmup: one FULL pass of each path so every lowering both sides use
+    # (sequential's per-shape pairs, the engine's prefill buckets and the
+    # batched decode) is compiled off the clock — steady-state throughput
+    # is the metric, compile latency is whole-step capture's own bench
+    _run_sequential(model, work)
+    _run_continuous(model, work, batch)
+
+    # best-of-reps: single shared core, the best rep is the noise floor
+    best_seq = (None, 0.0, None)
+    best_cont = (None, 0.0, None, None)
+    for _ in range(reps):
+        s = _run_sequential(model, work)
+        if s[1] > best_seq[1]:
+            best_seq = s
+        c = _run_continuous(model, work, batch)
+        if c[1] > best_cont[1]:
+            best_cont = c
+    seq_outs, seq_tps, _ = best_seq
+    cont_outs, cont_tps, lat, eng = best_cont
+
+    # correctness gate: the engine must emit EXACTLY the oracle's tokens
+    mismatches = sum(1 for a, b in zip(seq_outs, cont_outs)
+                     if a.shape != b.shape or not (a == b).all())
+
+    lat_ms = np.asarray(sorted(lat)) * 1e3
+    p50 = float(np.percentile(lat_ms, 50))
+    p99 = float(np.percentile(lat_ms, 99))
+    speedup = cont_tps / seq_tps if seq_tps else 0.0
+    info = eng.info()
+
+    payload = {
+        "metric": "serving_throughput_speedup_vs_sequential",
+        "value": round(speedup, 2),
+        "unit": "x",
+        # acceptance floor: continuous >= 1.5x sequential tokens/s
+        "vs_baseline": round(speedup / 1.5, 4),
+        "backend": "cpu-proxy",
+        "sequential_tokens_per_sec": round(seq_tps, 1),
+        "continuous_tokens_per_sec": round(cont_tps, 1),
+        "p50_token_ms": round(p50, 2),
+        "p99_token_ms": round(p99, 2),
+        "requests": n_requests,
+        "max_batch": batch,
+        "avg_occupancy": round(info["avg_occupancy"], 3),
+        "token_mismatches": mismatches,
+    }
+    print(json.dumps(payload), flush=True)
+
+    detail = {
+        "workload": [{"prompt_len": int(p.size), "max_new": n}
+                     for p, n in work],
+        "engine_info": info,
+        "latency_ms": {"p50": p50, "p99": p99,
+                       "mean": float(lat_ms.mean()),
+                       "max": float(lat_ms.max())},
+    }
+    ts = time.strftime("%Y%m%d_%H%M%S")
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_SELF_SERVE_{ts}.json")
+    try:
+        with open(path, "w") as f:
+            json.dump({**payload, "detail": detail}, f, indent=1)
+        print(f"# artifact -> {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"# artifact write failed: {e}", file=sys.stderr)
+    return payload
+
+
+if __name__ == "__main__":
+    main()

@@ -191,7 +191,9 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+from . import serving  # noqa: E402  (continuous-batching engine subpackage)
+
+__all__ = ["Config", "Predictor", "create_predictor", "serving"]
 
 
 # ---- enums + version/introspection surface (capi parity:

@@ -1,0 +1,17 @@
+"""paddle_tpu.inference.serving — continuous-batching inference engine.
+
+The serving loop over the captured ragged decode path: a paged KV-cache
+pool with capacity-based admission (`kv_pool`), a scheduler that joins and
+evicts requests strictly between decode steps (`scheduler`), the request
+lifecycle with typed per-request TTLs (`request`), and the engine that
+drives prefill/decode through one whole-step-captured executable per aval
+signature (`engine`). See README "Serving engine".
+"""
+from .engine import ServingEngine, serving_info  # noqa: F401
+from .kv_pool import KVPagePool, Page, PoolExhausted  # noqa: F401
+from .request import Request, RequestState  # noqa: F401
+from .scheduler import ContinuousBatchingScheduler  # noqa: F401
+
+__all__ = ["ServingEngine", "serving_info", "KVPagePool", "Page",
+           "PoolExhausted", "Request", "RequestState",
+           "ContinuousBatchingScheduler"]

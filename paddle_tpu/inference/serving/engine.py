@@ -1,0 +1,309 @@
+"""ServingEngine: continuous batching over the captured ragged decode path.
+
+The inference loop the ROADMAP's "millions of users" direction asked for,
+assembled from parts that already exist:
+
+- the batch-slot decode step (`models/llama.py _build_slot_step`): per-slot
+  position offsets feed the per-slot sequence-length vector of the ragged
+  Pallas decode attention (`ops/pallas/decode_attention.py`), so every slot
+  decodes at its own position inside ONE fixed-signature executable;
+- whole-step capture (`jit/capture.py`): the decode step lowers once for
+  the [max_batch, 1] signature and prefill lowers once per BUCKETED prompt
+  length — steady-state serving never retraces (a capture bailout falls
+  back to the per-op cache tier, slower but value-correct);
+- the paged KV pool (`kv_pool.py`) + scheduler (`scheduler.py`): capacity-
+  based admission, join/evict strictly between decode steps;
+- typed deadlines (`utils/deadline.py`): per-request TTL -> RequestTimeout.
+
+Prefill/decode separation: a joining request's prompt is padded right to
+the smallest configured bucket and prefilled alone at batch 1 (its last
+REAL token's logits selected by a traced gather index); the resulting KV
+rows are written into the request's batch slot by a donating jitted copy.
+Decode then serves every active slot per step. Slot rows are independent
+across the batch in every op (rope, cache write, ragged attention, the
+projections), so a join changes neither the tokens nor the lowering count
+of in-flight requests — tests/test_serving.py asserts both, bitwise.
+
+Env knobs (all read at engine construction):
+- ``PT_SERVE_MAX_BATCH``   (default 8)   decode slots
+- ``PT_SERVE_PAGE_SIZE``   (default 16)  tokens per KV page
+- ``PT_SERVE_MAX_SEQ``     (default: model max_position_embeddings)
+- ``PT_SERVE_PREFILL_BUCKETS`` comma list (default: powers of two)
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.deadline import env_int
+from .kv_pool import KVPagePool
+from .request import Request, RequestState
+from .scheduler import ContinuousBatchingScheduler
+
+_ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
+
+
+def _normalize_buckets(vals, max_seq_len: int) -> List[int]:
+    """One bucket policy for both knob paths: clamp every bucket to the
+    static cache extent (a bucket past S_max would trace a KV write larger
+    than the cache), dedupe/sort, and terminate the ladder at max_seq_len
+    so every admissible prompt has a bucket."""
+    out = sorted({min(int(b), max_seq_len) for b in vals if int(b) > 0})
+    if not out or out[-1] < max_seq_len:
+        out.append(max_seq_len)
+    return out
+
+
+def _default_buckets(max_seq_len: int) -> List[int]:
+    # unparseable env tokens degrade to the default ladder (same contract
+    # as env_timeout/env_int: a typo'd knob must not kill serving)
+    vals = []
+    for tok in os.environ.get("PT_SERVE_PREFILL_BUCKETS", "").split(","):
+        try:
+            vals.append(int(tok))
+        except ValueError:
+            continue
+    if not any(b > 0 for b in vals):
+        vals, b = [], 8
+        while b < max_seq_len:
+            vals.append(b)
+            b *= 2
+    return _normalize_buckets(vals, max_seq_len)
+
+
+class ServingEngine:
+    """Continuous-batching generation over one model's weights.
+
+    Greedy decoding (the deterministic contract the join/evict bitwise
+    tests rely on); temperature sampling is a recorded follow-on. Thread
+    safety: `submit()` may be called from any thread; `step()`/`run()`
+    must be driven by one thread (the engine serializes them with a lock,
+    matching the Predictor.clone() multi-thread serving contract where
+    compute stays single-driver per engine).
+    """
+
+    def __init__(self, model, max_batch: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 eos_token_id: Optional[int] = None,
+                 default_ttl: Optional[float] = None):
+        self.model = model
+        cfg = model.config
+        self.max_batch = max_batch or env_int("PT_SERVE_MAX_BATCH", 8)
+        self.max_seq_len = max_seq_len or env_int(
+            "PT_SERVE_MAX_SEQ", cfg.max_position_embeddings)
+        self.eos_token_id = eos_token_id
+        self.default_ttl = default_ttl
+        page = page_size or env_int("PT_SERVE_PAGE_SIZE", 16)
+        pages_per_slot = -(-self.max_seq_len // page)
+        self.pool = KVPagePool(self.max_batch * pages_per_slot, page)
+        self.scheduler = ContinuousBatchingScheduler(self.pool,
+                                                     self.max_batch)
+        if prefill_buckets:
+            if not any(int(b) > 0 for b in prefill_buckets):
+                raise ValueError(
+                    f"prefill_buckets {list(prefill_buckets)!r} has no "
+                    f"positive entry")
+            self.buckets = _normalize_buckets(prefill_buckets,
+                                              self.max_seq_len)
+        else:
+            self.buckets = _default_buckets(self.max_seq_len)
+
+        self._params = [p._value for p in model.parameters()]
+        self._caches = [(kc._value, vc._value) for kc, vc in
+                        model.init_kv_caches(self.max_batch,
+                                             self.max_seq_len)]
+        self._cache_shape = self._caches[0][0].shape[1:]   # (S_max, Hkv, D)
+        self._cache_dtype = self._caches[0][0].dtype
+        # one slot-step wrapper per MODEL (same stash idiom as generate's
+        # _decode_step): engines over the same weights share lowerings
+        step = model.__dict__.get("_slot_step")
+        if step is None:
+            step = model._build_slot_step()
+            model.__dict__["_slot_step"] = step
+        self._step_fn = step
+
+        # donating slot write: prefilled [1, S_max] KV rows -> batch row
+        def write_slot(batch_caches, pref_caches, slot):
+            z = jnp.asarray(0, jnp.int32)
+            return [
+                (jax.lax.dynamic_update_slice(bk, pk.astype(bk.dtype),
+                                              (slot, z, z, z)),
+                 jax.lax.dynamic_update_slice(bv, pv.astype(bv.dtype),
+                                              (slot, z, z, z)))
+                for (bk, bv), (pk, pv) in zip(batch_caches, pref_caches)]
+        self._write_slot = jax.jit(write_slot, donate_argnums=(0,))
+
+        self._lock = threading.Lock()   # serializes step()/run()
+        self._counters = {"prefills": 0, "decode_steps": 0,
+                          "tokens_generated": 0, "rejected": 0}
+        self._occupancy_sum = 0.0
+        self._decode_time = 0.0
+        self._prefill_time = 0.0
+        _ENGINES.add(self)
+
+    # ------------------------------------------------------------------
+    # intake
+    # ------------------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 16,
+               ttl: Optional[float] = None,
+               eos_token_id: Optional[int] = None) -> Request:
+        """Enqueue one request; returns the live Request handle. Raises a
+        typed ValueError immediately when the request can NEVER fit the
+        engine's static cache layout (that is a sizing bug, not load)."""
+        req = Request(prompt_ids, max_new_tokens=max_new_tokens,
+                      ttl=self.default_ttl if ttl is None else ttl,
+                      eos_token_id=self.eos_token_id
+                      if eos_token_id is None else eos_token_id)
+        total = req.prompt.size + req.max_new_tokens
+        if total > self.max_seq_len:
+            with self._lock:  # submit() is the documented any-thread path
+                self._counters["rejected"] += 1
+            raise ValueError(
+                f"request needs {total} KV positions but the engine's "
+                f"static layout holds max_seq_len={self.max_seq_len} — "
+                f"shorten the prompt/max_new_tokens or size the engine up")
+        self.scheduler.submit(req)
+        return req
+
+    # ------------------------------------------------------------------
+    # the serving loop
+    # ------------------------------------------------------------------
+    def step(self) -> int:
+        """One engine iteration: scheduler pass (evict/expire/join) ->
+        prefill the joiners -> ONE batched decode step for every active
+        slot. Returns the number of tokens produced."""
+        with self._lock:
+            joined, _ = self.scheduler.schedule()
+            produced = 0
+            for req in joined:
+                produced += self._prefill(req)
+            produced += self._decode()
+            return produced
+
+    def run(self, poll: float = 0.0) -> None:
+        """Drive step() until no request is queued or running. `poll`
+        sleeps between empty iterations (submissions from other threads)."""
+        while not self.scheduler.idle:
+            made = self.step()
+            if made == 0 and poll:
+                time.sleep(poll)
+
+    def generate(self, prompts: Sequence, max_new_tokens: int = 16,
+                 ttl: Optional[float] = None) -> List[np.ndarray]:
+        """Batch convenience: submit every prompt, drain, return
+        prompt+generated arrays in submission order (typed errors
+        propagate from the failing request)."""
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens, ttl=ttl)
+                for p in prompts]
+        self.run()
+        return [r.result() for r in reqs]
+
+    # ------------------------------------------------------------------
+    def _bucket_for(self, plen: int) -> int:
+        for b in self.buckets:
+            if b >= plen:
+                return b
+        return self.max_seq_len
+
+    def _prefill(self, req: Request) -> int:
+        """Run the joiner's prompt through the captured step at its bucket
+        length (batch 1, fresh zero caches), write the KV rows into its
+        slot, and sample its first token."""
+        t0 = time.perf_counter()
+        plen = req.prompt.size
+        bucket = self._bucket_for(plen)
+        tok = np.zeros((1, bucket), np.int64)
+        tok[0, :plen] = req.prompt
+        pref_caches = [(jnp.zeros((1,) + self._cache_shape,
+                                  self._cache_dtype),
+                        jnp.zeros((1,) + self._cache_shape,
+                                  self._cache_dtype))
+                       for _ in self._caches]
+        nxt, pref_out = self._step_fn(
+            self._params, jnp.asarray(tok), pref_caches,
+            jnp.zeros((1,), jnp.int32),
+            jnp.asarray([plen - 1], jnp.int32))
+        self._caches = self._write_slot(self._caches, pref_out,
+                                        jnp.asarray(req.slot, jnp.int32))
+        req.cache_len = plen
+        req.state = RequestState.DECODING
+        first = int(np.asarray(nxt)[0])
+        if not req.append_token(first):
+            req.next_token = first
+        self._counters["prefills"] += 1
+        self._counters["tokens_generated"] += 1
+        self._prefill_time += time.perf_counter() - t0
+        return 1
+
+    def _decode(self) -> int:
+        """One [max_batch, 1] decode step over every active slot. Inactive
+        slots feed token 0 at offset 0 — their rows are garbage the ragged
+        length vector keeps out of everyone else's attention, and the next
+        prefill overwrites them wholesale."""
+        active = [(s, r) for s, r in sorted(self.scheduler.running().items())
+                  if r.state is RequestState.DECODING
+                  and r.finish_reason is None]
+        if not active:
+            return 0
+        t0 = time.perf_counter()
+        b = self.max_batch
+        tok = np.zeros((b, 1), np.int64)
+        off = np.zeros((b,), np.int32)
+        for s, r in active:
+            tok[s, 0] = r.next_token
+            off[s] = r.cache_len
+        nxt, self._caches = self._step_fn(
+            self._params, jnp.asarray(tok), self._caches,
+            jnp.asarray(off), jnp.zeros((b,), jnp.int32))
+        sampled = np.asarray(nxt)   # [B] i32, not [B, vocab] logits
+        for s, r in active:
+            r.cache_len += 1
+            t = int(sampled[s])
+            if not r.append_token(t):
+                r.next_token = t
+        self._counters["decode_steps"] += 1
+        self._counters["tokens_generated"] += len(active)
+        self._occupancy_sum += len(active) / float(b)
+        self._decode_time += time.perf_counter() - t0
+        return len(active)
+
+    # ------------------------------------------------------------------
+    # introspection (profiler.serving_summary reads this)
+    # ------------------------------------------------------------------
+    def info(self) -> dict:
+        c = dict(self._counters)
+        steps = c["decode_steps"]
+        gen_time = self._decode_time + self._prefill_time
+        sched = self.scheduler.info()
+        step_info = getattr(self._step_fn, "cache_info", dict)()
+        return {
+            "max_batch": self.max_batch,
+            "max_seq_len": self.max_seq_len,
+            "prefill_buckets": list(self.buckets),
+            **{k: sched[k] for k in ("submitted", "admitted", "finished",
+                                     "timed_out", "evicted", "active",
+                                     "queued")},
+            "rejected": c["rejected"] + sched["rejected"],
+            "prefills": c["prefills"],
+            "decode_steps": steps,
+            "tokens_generated": c["tokens_generated"],
+            "avg_occupancy": self._occupancy_sum / steps if steps else 0.0,
+            "tokens_per_sec": c["tokens_generated"] / gen_time
+            if gen_time else 0.0,
+            "pool": self.pool.info(),
+            "step": step_info,
+        }
+
+
+def serving_info() -> List[dict]:
+    """info() of every live engine (profiler.serving_summary's source)."""
+    return [e.info() for e in list(_ENGINES)]

@@ -1,0 +1,127 @@
+"""Paged KV-cache pool: capacity accounting for the serving engine.
+
+The physical layout stays the static-length dense cache `models/llama.py`
+already decodes against — per layer `[B, S_max, H_kv, D]`, one row per
+batch slot. What this pool manages is the CAPACITY of that layout: each
+slot's S_max positions are divided into fixed-size pages, and a request
+must hold enough pages for its whole lifetime (prompt + max_new_tokens)
+before it may occupy a slot. That gives vLLM-style capacity-based
+admission without a gather kernel: admission is all-or-nothing, so an
+admitted request can never stall mid-decode waiting for memory, and the
+no-preemption invariant keeps the decode path retrace-free.
+
+Pages are ref-counted (retain/release): the substrate for prefix sharing
+(two requests pinning one prompt's pages) even though the v1 engine holds
+every page at refcount 1. A page returns to the free list only when its
+last holder releases it; `info()` exposes the counters the deadline tests
+assert on (an expired request's pages must land back in `free_pages`).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List
+
+
+class PoolExhausted(RuntimeError):
+    """Admission failed: not enough free KV pages for the reservation.
+
+    `permanent=True` means the reservation exceeds the pool's TOTAL
+    capacity — no amount of waiting admits it (a sizing error, not
+    backpressure), and the caller must not retry."""
+
+    def __init__(self, need: int, free: int, total: int,
+                 permanent: bool = False):
+        self.need, self.free, self.total = need, free, total
+        self.permanent = permanent
+        tail = ("exceeds total capacity — the request can NEVER be "
+                "admitted; resize the pool/engine"
+                if permanent else
+                "request stays queued until capacity returns")
+        super().__init__(
+            f"KV page pool exhausted: need {need} page(s), {free} free of "
+            f"{total} total — {tail}")
+
+
+class Page:
+    """One fixed-size span of KV positions. Identity is the unit of
+    accounting; the engine maps (slot, position) to pages implicitly
+    through the dense layout."""
+
+    __slots__ = ("pid", "refs")
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.refs = 0
+
+    def __repr__(self):
+        return f"Page({self.pid}, refs={self.refs})"
+
+
+class KVPagePool:
+    """Free-list of `total_pages` pages of `page_size` tokens each."""
+
+    def __init__(self, total_pages: int, page_size: int):
+        if total_pages < 1 or page_size < 1:
+            raise ValueError("KVPagePool: total_pages/page_size must be >= 1")
+        self.total_pages = int(total_pages)
+        self.page_size = int(page_size)
+        self._free: List[Page] = [Page(i) for i in range(total_pages)]
+        self._lock = threading.Lock()
+        self._allocs = 0
+        self._releases = 0
+        self._peak_active = 0
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold `n_tokens` KV positions."""
+        return -(-max(int(n_tokens), 1) // self.page_size)
+
+    def alloc(self, n: int) -> List[Page]:
+        """Take `n` pages off the free list at refcount 1, or raise the
+        typed PoolExhausted without taking any (all-or-nothing)."""
+        with self._lock:
+            if n > len(self._free):
+                raise PoolExhausted(n, len(self._free), self.total_pages)
+            pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                p.refs = 1
+            self._allocs += n
+            active = self.total_pages - len(self._free)
+            self._peak_active = max(self._peak_active, active)
+            return pages
+
+    def retain(self, pages: List[Page]):
+        """Add a holder to already-allocated pages (prefix sharing)."""
+        with self._lock:
+            for p in pages:
+                if p.refs < 1:
+                    raise ValueError(f"retain of a free page: {p!r}")
+                p.refs += 1
+
+    def release(self, pages: List[Page]):
+        """Drop one holder; pages return to the free list at refcount 0."""
+        with self._lock:
+            for p in pages:
+                if p.refs < 1:
+                    raise ValueError(f"double release: {p!r}")
+                p.refs -= 1
+                if p.refs == 0:
+                    self._free.append(p)
+                    self._releases += 1
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def info(self) -> dict:
+        """cache_info()-style introspection (asserted by the deadline and
+        occupancy tests; surfaced in profiler.serving_summary())."""
+        with self._lock:
+            free = len(self._free)
+            return {"total_pages": self.total_pages,
+                    "page_size": self.page_size,
+                    "free_pages": free,
+                    "active_pages": self.total_pages - free,
+                    "allocs": self._allocs,
+                    "releases": self._releases,
+                    "peak_active": self._peak_active}

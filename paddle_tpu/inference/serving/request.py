@@ -1,0 +1,118 @@
+"""Request lifecycle for the continuous-batching serving engine.
+
+A request moves QUEUED -> PREFILL -> DECODING -> {FINISHED, TIMED_OUT,
+CANCELLED}. The scheduler owns every transition and performs them only
+BETWEEN decode steps (the continuous-batching contract: a join or eviction
+never retraces or perturbs in-flight slots). The per-request TTL rides
+`utils.deadline.Deadline`; running out of it raises the typed
+`RequestTimeout` from `result()` instead of wedging the caller.
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ...utils.deadline import Deadline, RequestTimeout
+
+_rid_counter = itertools.count()
+
+
+class RequestState(enum.Enum):
+    QUEUED = 0      # waiting for a batch slot (pages may be reserved)
+    PREFILL = 1     # admitted this step; prompt being prefilled
+    DECODING = 2    # occupying a slot in the decode batch
+    FINISHED = 3    # hit EOS or max_new_tokens; output complete
+    TIMED_OUT = 4   # TTL expired (queued or mid-decode); output partial
+    CANCELLED = 5   # user cancel; output partial
+
+
+TERMINAL_STATES = (RequestState.FINISHED, RequestState.TIMED_OUT,
+                   RequestState.CANCELLED)
+
+
+class Request:
+    """One generation request: prompt in, tokens out, typed error on TTL.
+
+    Host-side bookkeeping only — all device state (KV cache slot contents)
+    belongs to the engine. `token_times` records a perf_counter stamp per
+    emitted token so the bench can report p50/p99 per-token latency.
+    """
+
+    def __init__(self, prompt_ids, max_new_tokens: int = 16,
+                 ttl: Optional[float] = None,
+                 eos_token_id: Optional[int] = None):
+        self.rid = next(_rid_counter)
+        self.prompt = np.asarray(prompt_ids, dtype=np.int64).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("Request: empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("Request: max_new_tokens must be >= 1")
+        self.eos_token_id = eos_token_id
+        self.deadline = Deadline(ttl, what=f"serving request {self.rid}")
+        self.state = RequestState.QUEUED
+        self.output_tokens: List[int] = []
+        self.finish_reason: Optional[str] = None  # "eos"|"length"|"ttl"|...
+        self.error: Optional[BaseException] = None
+        # engine-owned placement (None until admitted)
+        self.slot: Optional[int] = None
+        self.pages: list = []           # KVPagePool pages reserved for us
+        self.cache_len = 0              # valid KV positions in our slot
+        self.next_token: Optional[int] = None   # sampled, not yet fed back
+        self.submit_time = time.perf_counter()
+        self.token_times: List[float] = []
+        self._done = threading.Event()
+
+    # ---- scheduler-side transitions ----
+    def append_token(self, tok: int) -> bool:
+        """Record one emitted token; returns True when the request is
+        complete (EOS emitted or max_new_tokens reached)."""
+        self.output_tokens.append(int(tok))
+        self.token_times.append(time.perf_counter())
+        if self.eos_token_id is not None and int(tok) == self.eos_token_id:
+            self.finish_reason = "eos"
+            return True
+        if len(self.output_tokens) >= self.max_new_tokens:
+            self.finish_reason = "length"
+            return True
+        return False
+
+    def finish(self, state: RequestState, error: BaseException = None):
+        self.state = state
+        if error is not None:
+            self.error = error
+        if state is RequestState.TIMED_OUT and self.error is None:
+            self.error = RequestTimeout(
+                f"serving request {self.rid}", self.deadline.timeout,
+                detail=f"{len(self.output_tokens)} token(s) generated")
+        self._done.set()
+
+    # ---- caller-side API ----
+    @property
+    def done(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self) -> np.ndarray:
+        """prompt + generated tokens as one int64 array. Raises the typed
+        error (RequestTimeout, ...) if the request did not finish cleanly."""
+        if not self.done:
+            raise RuntimeError(
+                f"request {self.rid} still {self.state.name}; drive "
+                f"engine.step() (or engine.run()) to completion first")
+        if self.error is not None:
+            raise self.error
+        return np.concatenate(
+            [self.prompt, np.asarray(self.output_tokens, np.int64)])
+
+    def __repr__(self):
+        return (f"Request(rid={self.rid}, state={self.state.name}, "
+                f"prompt={self.prompt.size}, out={len(self.output_tokens)}/"
+                f"{self.max_new_tokens})")

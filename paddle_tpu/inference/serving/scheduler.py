@@ -1,0 +1,147 @@
+"""Continuous-batching scheduler: join / evict BETWEEN decode steps.
+
+The decode batch has `max_batch` slots. Between any two decode steps the
+scheduler (1) evicts slots whose request completed (EOS / length) or ran
+out of TTL, (2) expires queued requests past their deadline (typed
+RequestTimeout, reserved pages returned to the pool), and (3) admits
+queued requests into free slots — strict FIFO, gated on an all-or-nothing
+KV-page reservation covering the request's whole lifetime, so an admitted
+request never stalls mid-decode and nothing is ever preempted.
+
+Joining is invisible to in-flight slots: every per-slot quantity (position
+offset, ragged attention length, cache row) is independent across the
+batch dimension, and the decode executable's signature is fixed at
+[max_batch, 1] — a join changes the CONTENTS of an inactive slot, never
+the avals, so no new lowering and bitwise-identical tokens for everyone
+already decoding (tests/test_serving.py proves both).
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import List, Tuple
+
+from .kv_pool import KVPagePool, PoolExhausted
+from .request import Request, RequestState
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, pool: KVPagePool, max_batch: int):
+        self.pool = pool
+        self.max_batch = int(max_batch)
+        self._queue: deque[Request] = deque()
+        self._running: dict[int, Request] = {}   # slot -> request
+        self._free_slots = list(range(self.max_batch - 1, -1, -1))
+        self._lock = threading.Lock()
+        self.counters = {"submitted": 0, "admitted": 0, "finished": 0,
+                         "timed_out": 0, "evicted": 0, "rejected": 0}
+
+    # ---- intake ----
+    def submit(self, req: Request):
+        """Enqueue; reserve KV pages eagerly when capacity allows (the
+        capacity-based admission control — a reservation made while queued
+        is what an expiring queued request gives back).
+
+        Reservations stay FIFO-prefix-ordered: a request reserves only if
+        everything AHEAD of it in the queue is already reserved. Otherwise
+        a small request behind a blocked head could pin the very pages the
+        head is waiting for — with no TTL that wedges the queue forever
+        (head can't alloc, reserver behind it can't join past strict FIFO)."""
+        need = self.pool.pages_for(req.prompt.size + req.max_new_tokens)
+        if need > self.pool.total_pages:
+            with self._lock:
+                self.counters["rejected"] += 1
+            # never-fits: NOT queued — permanent sizing error, don't retry
+            raise PoolExhausted(need, self.pool.free_pages,
+                                self.pool.total_pages, permanent=True)
+        with self._lock:
+            self.counters["submitted"] += 1
+            if all(r.pages for r in self._queue):
+                try:
+                    req.pages = self.pool.alloc(need)
+                except PoolExhausted:
+                    pass  # stays queued unreserved; retried at join passes
+            self._queue.append(req)
+
+    # ---- the between-steps pass ----
+    def schedule(self) -> Tuple[List[Request], List[Request]]:
+        """-> (joined, evicted). Called by the engine before every decode
+        step; all state transitions happen here, on the host, while the
+        device batch is quiescent."""
+        joined, evicted = [], []
+        with self._lock:
+            # 1. evict completed / expired running slots
+            for slot in sorted(self._running):
+                req = self._running[slot]
+                if req.finish_reason in ("eos", "length"):
+                    req.finish(RequestState.FINISHED)
+                    self.counters["finished"] += 1
+                elif req.deadline.expired:
+                    req.finish_reason = "ttl"
+                    req.finish(RequestState.TIMED_OUT)
+                    self.counters["timed_out"] += 1
+                else:
+                    continue
+                del self._running[slot]
+                self._free_slots.append(slot)
+                self.pool.release(req.pages)
+                req.pages = []
+                self.counters["evicted"] += 1
+                evicted.append(req)
+            # 2. expire queued requests (typed rejection, pages returned)
+            still = deque()
+            for req in self._queue:
+                if req.deadline.expired:
+                    if req.pages:
+                        self.pool.release(req.pages)
+                        req.pages = []
+                    req.finish_reason = "ttl"
+                    req.finish(RequestState.TIMED_OUT)
+                    self.counters["timed_out"] += 1
+                    evicted.append(req)
+                else:
+                    still.append(req)
+            self._queue = still
+            # 3. join — strict FIFO so a big head request cannot starve
+            while self._free_slots and self._queue:
+                head = self._queue[0]
+                if not head.pages:
+                    need = self.pool.pages_for(
+                        head.prompt.size + head.max_new_tokens)
+                    try:
+                        head.pages = self.pool.alloc(need)
+                    except PoolExhausted:
+                        break
+                self._queue.popleft()
+                head.slot = self._free_slots.pop()
+                head.state = RequestState.PREFILL
+                self._running[head.slot] = head
+                self.counters["admitted"] += 1
+                joined.append(head)
+        return joined, evicted
+
+    # ---- views ----
+    def running(self) -> dict:
+        with self._lock:
+            return dict(self._running)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def active(self) -> int:
+        with self._lock:
+            return len(self._running)
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._running and not self._queue
+
+    def info(self) -> dict:
+        with self._lock:
+            return {**self.counters, "active": len(self._running),
+                    "queued": len(self._queue),
+                    "free_slots": len(self._free_slots)}

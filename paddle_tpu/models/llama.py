@@ -77,14 +77,15 @@ class LlamaConfig:
 def _rope(q, k, theta, position_offset=0):
     """Rotary embeddings on [B, S, H, D] (fp32 trig, matches reference
     fused_rotary_position_embedding semantics). position_offset may be a
-    traced scalar (the KV-cache decode path)."""
+    traced scalar (the KV-cache decode path) or a [B] vector — the serving
+    engine's batch-slot decode, where every slot sits at its own position."""
     b, s, h, d = q.shape
     inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    pos = jnp.arange(s, dtype=jnp.float32) + jnp.asarray(
-        position_offset, jnp.float32)
-    freqs = jnp.outer(pos, inv)  # [S, D/2]
-    cos = jnp.cos(freqs)[None, :, None, :]
-    sin = jnp.sin(freqs)[None, :, None, :]
+    off = jnp.asarray(position_offset, jnp.float32)
+    pos = jnp.arange(s, dtype=jnp.float32)[None, :] + off.reshape(-1, 1)
+    freqs = pos[:, :, None] * inv[None, None, :]   # [1|B, S, D/2]
+    cos = jnp.cos(freqs)[:, :, None, :]
+    sin = jnp.sin(freqs)[:, :, None, :]
 
     def rot(x):
         x1 = x[..., 0::2].astype(jnp.float32)
@@ -145,6 +146,13 @@ class LlamaAttention(Layer):
             k_cache, v_cache = kv_cache
 
             def upd(kc, vc, kn, vn, off_):
+                if off_.ndim:  # per-slot offsets: one write position per row
+                    def one(c, n, o):
+                        z = jnp.asarray(0, jnp.int32)
+                        return jax.lax.dynamic_update_slice(
+                            c, n.astype(c.dtype), (o, z, z))
+                    return (jax.vmap(one)(kc, kn, off_),
+                            jax.vmap(one)(vc, vn, off_))
                 z = jnp.asarray(0, jnp.int32)
                 start = (z, off_, z, z)
                 return (jax.lax.dynamic_update_slice(kc, kn.astype(kc.dtype),
@@ -169,17 +177,20 @@ class LlamaAttention(Layer):
                 def rag(qq, kc, vc, off_):
                     from ..ops.pallas.decode_attention import (
                         ragged_decode_attention)
-                    lengths = jnp.full((qq.shape[0],), off_ + 1)
+                    # scalar offset -> uniform lengths; [B] offsets -> each
+                    # slot attends exactly its own live prefix
+                    lengths = jnp.broadcast_to(
+                        jnp.asarray(off_ + 1, jnp.int32), (qq.shape[0],))
                     return ragged_decode_attention(qq, kc, vc, lengths)
 
                 attn = apply(rag, q, k_cache, v_cache, off,
                              op_name="ragged_decode_attention")
             else:
                 def mk_mask(_shape_ref, off_):
-                    j = jnp.arange(s_max)[None, :]
-                    i = jnp.arange(s)[:, None] + off_
-                    allowed = j <= i
-                    return jnp.where(allowed, 0.0, -1e30)[None, None]
+                    j = jnp.arange(s_max)[None, None, :]
+                    i = jnp.arange(s)[None, :, None] + off_.reshape(-1, 1, 1)
+                    allowed = j <= i                   # [1|B, S, S_max]
+                    return jnp.where(allowed, 0.0, -1e30)[:, None]
 
                 mask = apply(mk_mask, q, off, op_name="decode_mask")
                 attn = F.scaled_dot_product_attention(q, k_cache, v_cache,
@@ -328,16 +339,75 @@ class LlamaForCausalLM(Layer):
             return _capture.capture_step(step, donate=(2,))
         return jax.jit(step, donate_argnums=(2,))
 
+    def _build_slot_step(self):
+        """Batch-slot serving step (inference/serving): like the cached
+        generate step but with per-slot state — ``off`` is a [B] i32 vector
+        (each slot decodes at its own position) and ``last_pos`` gathers the
+        logits of each slot's last REAL token (bucketed prefill pads prompts
+        on the right, so the interesting row is not always -1). Returns the
+        GREEDY next token per slot ([B] i32 — argmax on device: shipping
+        [B, vocab] logits to the host every step would serialize the decode
+        loop on transfer; first-max tie-break matches np.argmax, so tokens
+        are bitwise the generate() oracle's). One captured lowering per
+        (batch, seq-bucket) aval signature; KV caches donated."""
+        model = self
+        plist = list(model.parameters())
+
+        def step(param_vals, tok, caches, off, last_pos):
+            saved = [p._value for p in plist]
+            try:
+                for p, v in zip(plist, param_vals):
+                    p._value = v
+                with no_grad():
+                    logits, new_caches = model.forward(
+                        Tensor(tok),
+                        caches=[(Tensor(kc), Tensor(vc)) for kc, vc in caches],
+                        position_offset=off)
+                lv = logits._value
+                last = lv[jnp.arange(lv.shape[0]), last_pos, :]
+                nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                return nxt, [(kc._value, vc._value) for kc, vc in new_caches]
+            finally:
+                # never leak tracers into the eager Parameters
+                for p, v in zip(plist, saved):
+                    p._value = v
+
+        from ..jit import capture as _capture
+        if _capture.step_capture_enabled():
+            return _capture.capture_step(step, donate=(2,))
+        return jax.jit(step, donate_argnums=(2,))
+
     @no_grad()
     def generate(self, input_ids, max_new_tokens=16, temperature=0.0,
-                 use_cache=True):
+                 use_cache=True, eos_token_id=None, pad_token_id=None):
         """Greedy / temperature sampling.
 
         use_cache=True (default) runs the compiled KV-cache decode: prefill
         once, then one O(S_max)-attention step per token (the reference's
         FusedMultiTransformer decode path). use_cache=False keeps the naive
-        full-recompute loop (useful as a parity oracle)."""
+        full-recompute loop (useful as a parity oracle).
+
+        With ``eos_token_id``, each sequence stops at its first EOS (the EOS
+        itself is kept): finished rows emit ``pad_token_id`` (default: the
+        EOS id) deterministically from then on, and the loop halts early once
+        EVERY row is finished — so the output length is
+        ``prompt + min(max_new_tokens, tokens until all rows hit EOS)``."""
         ids = input_ids
+        finished = None
+        if eos_token_id is not None:
+            finished = np.zeros(int(ids.shape[0]), dtype=bool)
+            pad_id = eos_token_id if pad_token_id is None else pad_token_id
+
+        def mask_eos(nxt):
+            """Per-sequence finished mask: freeze rows that already emitted
+            EOS to the pad token; returns (tokens_to_append, all_done)."""
+            if finished is None:
+                return nxt, False
+            row = np.asarray(nxt.numpy()).reshape(-1)
+            emitted = np.where(finished, pad_id, row)
+            finished[:] = finished | (emitted == eos_token_id)
+            return Tensor(jnp.asarray(emitted.reshape(-1, 1))), \
+                bool(finished.all())
         if use_cache:
             b, p_len = ids.shape[0], ids.shape[1]
             s_max = p_len + max_new_tokens
@@ -354,17 +424,19 @@ class LlamaForCausalLM(Layer):
             last, caches = step(params, ids._value, caches,
                                 jnp.asarray(0, jnp.int32))
             for t in range(max_new_tokens):
-                nxt = self._sample(Tensor(last), temperature)
+                nxt, done = mask_eos(self._sample(Tensor(last), temperature))
                 ids = manip.concat([ids, nxt.astype(ids.dtype)], axis=1)
-                if t == max_new_tokens - 1:
+                if done or t == max_new_tokens - 1:
                     break
                 last, caches = step(params, nxt._value, caches,
                                     jnp.asarray(p_len + t, jnp.int32))
             return ids
         for _ in range(max_new_tokens):
             logits = self.forward(ids)
-            nxt = self._sample(logits[:, -1, :], temperature)
+            nxt, done = mask_eos(self._sample(logits[:, -1, :], temperature))
             ids = manip.concat([ids, nxt.astype(ids.dtype)], axis=1)
+            if done:
+                break
         return ids
 
     def _sample(self, last, temperature):

@@ -12,5 +12,7 @@ from .profiler import (  # noqa: F401
     SummaryView, export_chrome_tracing, export_protobuf,
     load_profiler_result, make_scheduler,
 )
-from .statistic import op_cache_summary, step_capture_summary  # noqa: F401
+from .statistic import (  # noqa: F401
+    op_cache_summary, serving_summary, step_capture_summary,
+)
 from .timer import benchmark  # noqa: F401

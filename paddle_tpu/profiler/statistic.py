@@ -83,6 +83,48 @@ def step_capture_summary() -> str:
     return "\n".join(lines)
 
 
+def serving_summary() -> str:
+    """Live serving-engine counters (inference/serving) as text: admission
+    funnel (submitted -> admitted -> finished / timed_out / rejected),
+    batch occupancy, decode-step and token throughput, and the KV-page
+    pool (active/free/peak) — so an occupancy or eviction regression is
+    readable next to the op timings instead of needing print statements.
+    A healthy loaded engine pins `avg_occupancy` near 1.0 with
+    `step.lowerings` frozen at (buckets + 1) and `step.hits` climbing;
+    climbing `timed_out` means admission is outrunning capacity (grow the
+    pool / batch, or shed load by shortening TTLs)."""
+    from ..inference.serving import serving_info
+
+    infos = serving_info()
+    if not infos:
+        return "serving: no live engines"
+    lines = []
+    for i, e in enumerate(infos):
+        pool, step = e["pool"], e["step"]
+        lines += [
+            f"engine[{i}]: batch={e['max_batch']} seq<={e['max_seq_len']} "
+            f"buckets={e['prefill_buckets']}",
+            f"  requests: submitted={e['submitted']} admitted={e['admitted']}"
+            f" finished={e['finished']} timed_out={e['timed_out']} "
+            f"evicted={e['evicted']} rejected={e['rejected']} "
+            f"active={e['active']} queued={e['queued']}",
+            f"  decode: steps={e['decode_steps']} prefills={e['prefills']} "
+            f"tokens={e['tokens_generated']} "
+            f"occupancy={e['avg_occupancy']:.2f} "
+            f"tokens/s={e['tokens_per_sec']:.1f}",
+            f"  kv pool: pages={pool['active_pages']}/{pool['total_pages']} "
+            f"active (peak {pool['peak_active']}, page_size "
+            f"{pool['page_size']}, allocs={pool['allocs']} "
+            f"releases={pool['releases']})",
+        ]
+        if step:
+            lines.append(
+                f"  step capture: lowerings={step.get('lowerings')} "
+                f"hits={step.get('hits')} bailouts={step.get('bailouts')} "
+                f"fallback_calls={step.get('fallback_calls')}")
+    return "\n".join(lines)
+
+
 def summary(events: List[dict], sorted_by: str = "total",
             time_unit: str = "ms") -> str:
     stats = aggregate(events)

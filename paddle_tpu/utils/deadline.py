@@ -49,6 +49,13 @@ class DataLoaderTimeout(DeadlineExceeded):
     """No batch arrived from the DataLoader workers within `timeout`."""
 
 
+class RequestTimeout(DeadlineExceeded):
+    """A serving request ran out of its TTL budget: expired while queued
+    for admission (rejected before ever occupying a batch slot) or evicted
+    mid-decode (partial output kept on the request). Either way its KV
+    pages go back to the pool — see inference/serving/."""
+
+
 class StoreConnectionError(ConnectionError):
     """Terminal store-client failure: the connection died (or desynced
     mid-message) and reconnect-plus-retry did not recover it."""
@@ -127,6 +134,18 @@ def env_timeout(name: str, default: float) -> float:
     raw = os.environ.get(name, "")
     try:
         val = float(raw)
+    except ValueError:
+        return default
+    return val if val > 0 else default
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer sibling of env_timeout, same contract: unset, unparseable,
+    or <=0 degrades to the default (a typo'd knob must not change
+    behavior or kill the process)."""
+    import os
+    try:
+        val = int(os.environ.get(name, ""))
     except ValueError:
         return default
     return val if val > 0 else default

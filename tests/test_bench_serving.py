@@ -1,0 +1,69 @@
+"""Smoke-level guard for the continuous-batching serving microbenchmark.
+
+bench_serving must stay CPU-runnable and keep its one-JSON-line contract
+(it is the serving-perf trajectory when the TPU probe reports
+tpu-unavailable). A tiny-workload run lives in tier-1; the acceptance
+ratio itself (continuous >= 1.5x sequential tokens/s) is asserted only in
+the slow battery — tiny workloads on a loaded single-core CI box make
+ratios noisy, and a trickle workload (queue < batch) legitimately
+measures ~1x.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(requests: int, batch: int, reps: int):
+    env = dict(os.environ, PT_SERVE_BENCH_REQUESTS=str(requests),
+               PT_SERVE_BENCH_BATCH=str(batch),
+               PT_SERVE_BENCH_REPS=str(reps))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_serving.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout  # exactly ONE JSON line on stdout
+    return json.loads(lines[0]), r.stderr
+
+
+@pytest.mark.skipif(os.environ.get("PT_TIGHT_BUDGET") == "1",
+                    reason="wall-clock budget is tight; perf smoke skipped")
+def test_bench_serving_smoke_json_contract():
+    payload, stderr = _run_bench(requests=6, batch=4, reps=1)
+    assert payload["metric"] == "serving_throughput_speedup_vs_sequential"
+    assert payload["unit"] == "x"
+    assert payload["backend"] == "cpu-proxy"  # never mistaken for chip perf
+    assert payload["value"] > 0
+    for k in ("sequential_tokens_per_sec", "continuous_tokens_per_sec",
+              "p50_token_ms", "p99_token_ms"):
+        assert payload[k] > 0, (k, payload)
+    assert payload["p99_token_ms"] >= payload["p50_token_ms"]
+    # the engine must emit EXACTLY the sequential oracle's tokens
+    assert payload["token_mismatches"] == 0, payload
+    assert "artifact ->" in stderr
+    art = stderr.split("artifact ->", 1)[1].strip().splitlines()[0]
+    with open(art) as f:
+        self_json = json.load(f)
+    detail = self_json["detail"]
+    assert len(detail["workload"]) == 6
+    info = detail["engine_info"]
+    # really continuous batching: every request admitted+finished, batched
+    # decode steps served multiple slots, pool drained back to empty
+    assert info["finished"] == 6 and info["timed_out"] == 0
+    assert 0 < info["avg_occupancy"] <= 1.0
+    assert info["pool"]["active_pages"] == 0
+    assert info["step"]["lowerings"] >= 2  # prefill bucket(s) + decode
+    assert detail["latency_ms"]["p99"] >= detail["latency_ms"]["p50"]
+    os.unlink(art)  # tiny-workload artifacts are not trajectory evidence
+
+
+@pytest.mark.slow
+def test_bench_serving_meets_acceptance_floor():
+    payload, _ = _run_bench(requests=24, batch=8, reps=3)
+    assert payload["value"] >= 1.5, payload
+    assert payload["token_mismatches"] == 0, payload

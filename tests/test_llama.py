@@ -233,6 +233,46 @@ def test_hybrid_step_1f1b_and_vpp_parity():
     mesh_mod.set_mesh(None)
 
 
+def test_generate_eos_early_stop_and_deterministic_padding():
+    """VERDICT: generation halts at eos_token_id per sequence (the EOS is
+    kept), finished rows pad deterministically with pad_token_id, and the
+    loop stops early once every row is finished — on BOTH decode paths."""
+    P.seed(5)
+    cfg = LlamaConfig.tiny(vocab=32, hidden=16, layers=1, heads=2, inter=32,
+                           seq=64)
+    m = LlamaForCausalLM(cfg)
+    ids_np = np.random.RandomState(1).randint(0, 32, (2, 4))
+    ids = P.to_tensor(ids_np)
+    base = np.asarray(m.generate(ids, max_new_tokens=8).numpy())
+    # "EOS" = a row-0 token whose FIRST occurrence is mid-stream (so row 0
+    # halts exactly there) and that row 1 never generates (so only row 0
+    # finishes early)
+    gen0, gen1 = base[0, 4:], base[1, 4:]
+    k = next((i for i in range(1, len(gen0) - 1)
+              if gen0[i] not in gen0[:i] and gen0[i] not in gen1), None)
+    if k is None:  # extremely unlikely at vocab 32 with this seed
+        pytest.skip("no unambiguous eos candidate for this seed")
+    eos = int(gen0[k])
+    for use_cache in (True, False):
+        out = np.asarray(m.generate(ids, max_new_tokens=8,
+                                    eos_token_id=eos, pad_token_id=31,
+                                    use_cache=use_cache).numpy())
+        # row 0: tokens up to and including EOS, then deterministic pad
+        np.testing.assert_array_equal(out[0, :4 + k + 1], base[0, :4 + k + 1],
+                                      err_msg=f"use_cache={use_cache}")
+        assert out[0, 4 + k] == eos
+        assert (out[0, 4 + k + 1:] == 31).all(), out[0]
+        # row 1 never finishes: bitwise the no-EOS run (row independence)
+        np.testing.assert_array_equal(out[1], base[1],
+                                      err_msg=f"use_cache={use_cache}")
+    # all-rows-finished: the loop halts early (output shorter than max)
+    single = P.to_tensor(ids_np[0:1])
+    out1 = np.asarray(m.generate(single, max_new_tokens=8,
+                                 eos_token_id=eos).numpy())
+    assert out1.shape == (1, 4 + k + 1), out1.shape
+    assert out1[0, -1] == eos
+
+
 def test_generate_kv_cache_matches_recompute():
     """VERDICT r1 item 5: the compiled KV-cache decode must emit exactly the
     tokens of the full-recompute oracle (incl. grouped-query attention)."""

@@ -1,0 +1,367 @@
+"""Serving engine: continuous batching over the captured ragged decode path.
+
+The contract under test (ISSUE 7 acceptance):
+- engine output token-identical to the sequential generate() oracle on
+  mixed prompt lengths (bucketed prefill + batch-slot decode correctness);
+- a late-joining request changes NEITHER the tokens NOR the number of
+  step-capture lowerings of an in-flight request (join/evict strictly
+  between decode steps, fixed decode signature);
+- per-request deadlines: an expired queued request is rejected with the
+  typed RequestTimeout and its reserved KV pages return to the pool
+  (asserted via the pool introspection counters);
+- concurrent entry points: Predictor.clone()/PredictorPool from multiple
+  threads sharing one loaded program; engine.submit() from many threads.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.inference.serving import (
+    KVPagePool, PoolExhausted, RequestState, ServingEngine)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.utils.deadline import DeadlineExceeded, RequestTimeout
+
+
+def _model(seed=7, vocab=64, hidden=32, layers=2, heads=4, seq=64):
+    P.seed(seed)
+    cfg = LlamaConfig.tiny(vocab=vocab, hidden=hidden, layers=layers,
+                           heads=heads, inter=hidden * 2, seq=seq)
+    return LlamaForCausalLM(cfg)
+
+
+def _prompt(n, seed=0, vocab=64):
+    return np.random.RandomState(seed).randint(0, vocab, (n,))
+
+
+# ---------------------------------------------------------------------------
+# KV page pool
+# ---------------------------------------------------------------------------
+
+def test_kv_pool_alloc_release_freelist():
+    pool = KVPagePool(total_pages=4, page_size=16)
+    assert pool.pages_for(1) == 1 and pool.pages_for(16) == 1 \
+        and pool.pages_for(17) == 2
+    a = pool.alloc(3)
+    assert pool.free_pages == 1
+    info = pool.info()
+    assert info["active_pages"] == 3 and info["peak_active"] == 3
+    # all-or-nothing: failed alloc takes nothing
+    with pytest.raises(PoolExhausted):
+        pool.alloc(2)
+    assert pool.free_pages == 1
+    pool.release(a)
+    assert pool.free_pages == 4
+    assert pool.info()["releases"] == 3
+
+
+def test_kv_pool_refcount():
+    pool = KVPagePool(total_pages=2, page_size=8)
+    pages = pool.alloc(2)
+    pool.retain(pages)           # second holder (prefix-sharing substrate)
+    pool.release(pages)
+    assert pool.free_pages == 0  # still held once
+    pool.release(pages)
+    assert pool.free_pages == 2
+    with pytest.raises(ValueError):
+        pool.release(pages)      # double release is a bug, not a no-op
+    with pytest.raises(ValueError):
+        pool.retain(pages)       # retaining a free page likewise
+
+
+# ---------------------------------------------------------------------------
+# engine vs the sequential generate() oracle
+# ---------------------------------------------------------------------------
+
+def test_engine_matches_sequential_generate():
+    """Mixed prompt lengths — bucket-exact (8) and padded (5, 11) — must
+    emit exactly the oracle's tokens (greedy, same weights, same math)."""
+    m = _model()
+    prompts = [_prompt(5, seed=1), _prompt(8, seed=2), _prompt(11, seed=3)]
+    oracle = [np.asarray(
+        m.generate(P.to_tensor(p.reshape(1, -1)), max_new_tokens=7).numpy())[0]
+        for p in prompts]
+    eng = ServingEngine(m, max_batch=4, max_seq_len=64, page_size=8)
+    outs = eng.generate(prompts, max_new_tokens=7)
+    for o, e in zip(oracle, outs):
+        np.testing.assert_array_equal(o, e)
+    info = eng.info()
+    assert info["finished"] == 3 and info["timed_out"] == 0
+    assert info["pool"]["active_pages"] == 0  # everything returned
+
+
+def test_engine_eos_stops_request():
+    """EOS emitted mid-stream finishes the request and frees its slot."""
+    m = _model(seed=11)
+    p = _prompt(6, seed=4)
+    base = np.asarray(
+        m.generate(P.to_tensor(p.reshape(1, -1)), max_new_tokens=8).numpy())[0]
+    eos = int(base[6 + 2])  # the 3rd generated token, forced to be "EOS"
+    eng = ServingEngine(m, max_batch=2, max_seq_len=64, eos_token_id=eos)
+    req = eng.submit(p, max_new_tokens=8)
+    eng.run()
+    out = req.result()
+    assert req.finish_reason == "eos"
+    assert out.size == 6 + 3 and out[-1] == eos
+    np.testing.assert_array_equal(out, base[:9])
+
+
+# ---------------------------------------------------------------------------
+# the continuous-batching contract itself
+# ---------------------------------------------------------------------------
+
+def test_join_mid_stream_is_invisible_to_inflight_request():
+    """Request B joins while A is mid-decode: A's tokens are bitwise those
+    of a solo run, and the join adds ZERO step-capture lowerings (B's
+    prompt shares A's prefill bucket; the decode signature is fixed)."""
+    m = _model(seed=13)
+    pa, pb = _prompt(5, seed=5), _prompt(7, seed=6)  # same bucket (8)
+
+    solo = ServingEngine(m, max_batch=4, max_seq_len=64)
+    ra_solo = solo.submit(pa, max_new_tokens=12)
+    solo.run()
+    solo_tokens = list(ra_solo.output_tokens)
+
+    eng = ServingEngine(m, max_batch=4, max_seq_len=64)
+    ra = eng.submit(pa, max_new_tokens=12)
+    eng.step()
+    eng.step()
+    assert 1 < len(ra.output_tokens) < 12  # genuinely mid-stream
+    lowerings_before = eng.info()["step"]["lowerings"]
+    rb = eng.submit(pb, max_new_tokens=6)
+    eng.run()
+    assert eng.info()["step"]["lowerings"] == lowerings_before, \
+        "a join must reuse bucketed signatures only — no new lowering"
+    assert list(ra.output_tokens) == solo_tokens, \
+        "a late joiner perturbed an in-flight request's tokens"
+    assert rb.state is RequestState.FINISHED and len(rb.output_tokens) == 6
+
+
+def test_capacity_queueing_drains_fifo():
+    """More requests than slots/pages: the tail waits, joins as capacity
+    frees, and everyone finishes with correct outputs (continuous
+    batching, not rejection)."""
+    m = _model(seed=17)
+    prompts = [_prompt(4 + i, seed=20 + i) for i in range(5)]
+    oracle = [np.asarray(
+        m.generate(P.to_tensor(p.reshape(1, -1)), max_new_tokens=6).numpy())[0]
+        for p in prompts]
+    eng = ServingEngine(m, max_batch=2, max_seq_len=32, page_size=16)
+    outs = eng.generate(prompts, max_new_tokens=6)
+    for o, e in zip(oracle, outs):
+        np.testing.assert_array_equal(o, e)
+    info = eng.info()
+    assert info["admitted"] == 5 and info["finished"] == 5
+    assert info["avg_occupancy"] > 0.5
+
+
+def test_oversized_request_rejected_typed():
+    m = _model(seed=19)
+    eng = ServingEngine(m, max_batch=2, max_seq_len=32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.submit(_prompt(30), max_new_tokens=16)
+    assert eng.info()["rejected"] == 1
+
+
+def test_behind_head_reservation_cannot_wedge_fifo():
+    """Review regression: a small request behind a BLOCKED head must not
+    pin the pages the head is waiting for — reservations stay FIFO-prefix-
+    ordered, so the queue always drains once running requests finish."""
+    from paddle_tpu.inference.serving import (
+        ContinuousBatchingScheduler, Request)
+    pool = KVPagePool(total_pages=10, page_size=1)
+    sched = ContinuousBatchingScheduler(pool, max_batch=2)
+    c = Request(np.arange(3), max_new_tokens=3)   # 6 pages, runs first
+    sched.submit(c)
+    assert sched.schedule()[0] == [c]
+    a = Request(np.arange(4), max_new_tokens=4)   # 8 pages: blocked head
+    sched.submit(a)
+    assert not a.pages                            # 4 free < 8
+    b = Request(np.arange(2), max_new_tokens=2)   # 4 pages: fits the gap
+    sched.submit(b)
+    assert not b.pages, "behind a blocked head B must NOT reserve"
+    sched.schedule()
+    assert sched.active == 1 and sched.queue_depth == 2
+    c.finish_reason = "length"                    # C completes
+    joined, _ = sched.schedule()
+    assert joined == [a], "head joins the moment capacity returns"
+    a.finish_reason = "length"
+    joined, _ = sched.schedule()
+    assert joined == [b]
+    b.finish_reason = "length"
+    sched.schedule()
+    assert sched.idle and pool.free_pages == 10
+
+
+def test_explicit_prefill_buckets_clamped_to_cache():
+    """Review regression: an explicit bucket past max_seq_len must not
+    trace a KV write larger than the cache — it is clamped up front."""
+    m = _model(seed=43)
+    eng = ServingEngine(m, max_batch=2, max_seq_len=32, prefill_buckets=[64])
+    assert eng.buckets == [32]
+    req = eng.submit(_prompt(5, seed=60), max_new_tokens=4)
+    eng.run()
+    assert req.state is RequestState.FINISHED
+    with pytest.raises(ValueError, match="prefill_buckets"):
+        ServingEngine(m, max_batch=2, max_seq_len=32, prefill_buckets=[0])
+
+
+# ---------------------------------------------------------------------------
+# deadlines: typed rejection/eviction with pages returned
+# ---------------------------------------------------------------------------
+
+def test_expired_queued_request_rejected_and_pages_returned():
+    m = _model(seed=23)
+    # pool: 1 slot x 4 pages of 16. A (4+20 tokens) holds 2 pages, leaving
+    # spare capacity for B (4+10 -> 1 page) to RESERVE while queued on the
+    # busy slot — the reservation an expiring queued request must give back
+    eng = ServingEngine(m, max_batch=1, max_seq_len=64, page_size=16)
+    ra = eng.submit(_prompt(4, seed=7), max_new_tokens=20)   # occupies slot
+    eng.step()
+    assert eng.info()["active"] == 1
+    pages_a = eng.pool.info()["active_pages"]
+    rb = eng.submit(_prompt(4, seed=8), max_new_tokens=10, ttl=0.02)
+    assert eng.pool.info()["active_pages"] > pages_a  # B reserved while queued
+    time.sleep(0.05)
+    eng.step()  # the between-steps scheduler pass expires B
+    assert rb.state is RequestState.TIMED_OUT
+    assert isinstance(rb.error, RequestTimeout)
+    assert isinstance(rb.error, DeadlineExceeded)  # typed hierarchy intact
+    with pytest.raises(RequestTimeout):
+        rb.result()
+    assert eng.pool.info()["active_pages"] == pages_a, \
+        "expired queued request must return its reserved KV pages"
+    assert eng.info()["timed_out"] == 1
+    eng.run()
+    assert ra.state is RequestState.FINISHED  # A undisturbed
+
+
+def test_expired_running_request_evicted_and_slot_reused():
+    m = _model(seed=29)
+    eng = ServingEngine(m, max_batch=1, max_seq_len=64)
+    ra = eng.submit(_prompt(4, seed=9), max_new_tokens=50, ttl=0.05)
+    eng.step()
+    assert ra.state is RequestState.DECODING
+    time.sleep(0.08)
+    eng.step()
+    assert ra.state is RequestState.TIMED_OUT
+    assert ra.finish_reason == "ttl"
+    assert len(ra.output_tokens) > 0          # partial output preserved
+    with pytest.raises(RequestTimeout):
+        ra.result()
+    assert eng.pool.info()["active_pages"] == 0
+    # the freed slot serves the next request normally
+    rc = eng.submit(_prompt(5, seed=10), max_new_tokens=4)
+    eng.run()
+    assert rc.state is RequestState.FINISHED and len(rc.output_tokens) == 4
+
+
+# ---------------------------------------------------------------------------
+# concurrent entry points
+# ---------------------------------------------------------------------------
+
+def test_engine_submit_from_many_threads():
+    m = _model(seed=31)
+    prompts = [_prompt(4 + (i % 5), seed=40 + i) for i in range(6)]
+    oracle = [np.asarray(
+        m.generate(P.to_tensor(p.reshape(1, -1)), max_new_tokens=5).numpy())[0]
+        for p in prompts]
+    eng = ServingEngine(m, max_batch=3, max_seq_len=64)
+    reqs = [None] * len(prompts)
+
+    def worker(i):
+        reqs[i] = eng.submit(prompts[i], max_new_tokens=5)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    eng.run()
+    for i, r in enumerate(reqs):
+        np.testing.assert_array_equal(r.result(), oracle[i])
+
+
+def test_predictor_clone_and_pool_multithreaded(tmp_path):
+    """Predictor.clone()/PredictorPool: many threads share ONE loaded
+    program (weights shared), outputs stay isolated per thread."""
+    import jax
+
+    from paddle_tpu import inference
+    from paddle_tpu.static import InputSpec
+
+    P.seed(0)
+    mlp = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    prefix = None
+    if hasattr(jax, "export"):  # jit.save needs jax.export (absent on the
+        prefix = str(tmp_path / "served")   # CI jax — run the shared path)
+        P.jit.save(mlp, prefix,
+                   input_spec=[InputSpec([None, 16], "float32",
+                                         name="feats")])
+        base = inference.create_predictor(inference.Config(prefix))
+    else:
+        base = inference.Predictor(inference.Config(), _shared=mlp)
+    preds = [base] + [base.clone() for _ in range(3)]
+    assert all(p._layer is base._layer for p in preds)  # one shared program
+
+    feeds = [np.random.RandomState(i).rand(2, 16).astype(np.float32)
+             for i in range(4)]
+    expect = [np.asarray(mlp(P.to_tensor(f)).numpy()) for f in feeds]
+    got = [None] * 4
+    errs = []
+
+    def worker(i):
+        try:
+            for _ in range(5):  # hammer to surface cross-thread bleed
+                h = preds[i].get_input_handle(preds[i].get_input_names()[0])
+                h.copy_from_cpu(feeds[i])
+                preds[i].run()
+                out = preds[i].get_output_handle(
+                    preds[i].get_output_names()[0]).copy_to_cpu()
+                got[i] = out
+        except BaseException as e:  # noqa: BLE001 — surfaced in main thread
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    for g, e in zip(got, expect):
+        np.testing.assert_allclose(g, e, rtol=1e-5, atol=1e-6)
+
+    if prefix is not None:  # PredictorPool loads from disk: needs jit.save
+        pool = inference.PredictorPool(inference.Config(prefix), size=3)
+        p2 = pool.retrieve(2)
+        p2.get_input_handle(p2.get_input_names()[0]).copy_from_cpu(feeds[0])
+        p2.run()
+        out = p2.get_output_handle(p2.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, expect[0], rtol=1e-5, atol=1e-6)
+    else:  # same contract via clone-shared predictors
+        pool_preds = [base.clone() for _ in range(3)]
+        assert all(p._layer is base._layer for p in pool_preds)
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_serving_summary_renders_counters():
+    from paddle_tpu import profiler
+    m = _model(seed=37)
+    eng = ServingEngine(m, max_batch=2, max_seq_len=32)
+    eng.generate([_prompt(4, seed=50), _prompt(6, seed=51)],
+                 max_new_tokens=4)
+    text = profiler.serving_summary()
+    assert "submitted=2" in text and "finished=2" in text
+    assert "kv pool" in text and "occupancy=" in text
+    info = eng.info()
+    assert info["tokens_generated"] == 8
+    assert info["step"]["lowerings"] >= 2  # prefill bucket(s) + decode
+    del eng  # engines are weakly registered; drop for other tests

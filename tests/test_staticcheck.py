@@ -45,7 +45,7 @@ def test_all_rules_fire_on_fixtures(fixture_findings):
     assert rules >= {"tracer-branch", "numpy-on-tracer", "host-sync",
                      "registry-consistency", "mutable-global",
                      "dead-export", "key-reuse", "closure-capture",
-                     "unbounded-blocking"}, rules
+                     "unbounded-blocking", "dtype-rule-coverage"}, rules
     assert len(rules) >= 5  # the acceptance floor, trivially exceeded
 
 
@@ -81,6 +81,21 @@ def test_registry_dynamic_self_attr_op_names_resolved(fixture_findings):
     rc = {f.context for f in fixture_findings
           if f.rule == "registry-consistency"}
     assert not rc & {"fixlstm", "fixtanh", "fixrelu"}, rc
+
+
+def test_dtype_rule_coverage_known_answers(fixture_findings):
+    """op_tolerances.py fixture: the partial override entries fire, one
+    finding per (op, leg, missing-dtype) hole; complete entries and holes
+    covered by a recorded SKIP (exact or wildcard) stay quiet."""
+    dc = [f for f in fixture_findings if f.rule == "dtype-rule-coverage"]
+    assert all(f.path == "tests/op_tolerances.py" for f in dc), dc
+    assert {f.context for f in dc} == {
+        "toleranced_op:fwd:float16",   # partial fwd entry
+        "fixrelu:fwd:bfloat16",        # partial, no skip
+        "fixrelu:grad:float16",        # partial grad entry
+    }, dc
+    # stale_op/fixlstm entries are complete; fixtanh's hole has a SKIP
+    assert all(f.severity == "warning" and f.line > 1 for f in dc)
 
 
 def test_static_metadata_and_static_numpy_not_flagged(fixture_findings):

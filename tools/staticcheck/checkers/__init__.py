@@ -2,6 +2,7 @@
 from . import (  # noqa: F401
     closure_capture,
     dead_export,
+    dtype_rule_coverage,
     host_sync,
     key_reuse,
     mutable_global,

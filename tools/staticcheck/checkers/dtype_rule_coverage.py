@@ -1,0 +1,121 @@
+"""dtype-rule-coverage: tolerance overrides must cover the swept dtypes.
+
+The dtype-sweep battery (tests/test_op_dtype_sweep.py) exercises every op
+at the low-precision dtypes (bfloat16/float16) with tolerances from
+tests/op_tolerances.py. An op gets an FWD_OVERRIDES / GRAD_OVERRIDES entry
+when the author decided the DEFAULT tolerance is wrong for it — but an
+entry that names only ONE of the swept dtypes leaves the other silently
+riding the default, which is exactly the judgement the entry said not to
+trust. This rule flags every (op, leg, dtype) hole: an override entry that
+has no tolerance pair for a dtype the sweep exercises and no recorded SKIP
+for that (op, leg, dtype) in the SKIPS table.
+
+Pre-existing holes are baselined (the ratchet stops the set growing); a
+NEW op must record every swept dtype — a pair or a reasoned skip.
+
+Limits: only the literal SKIPS dict is read (the family-level loop-added
+skips are invisible to static parsing, same as the registry-consistency
+pass) — if a loop-skipped family ever gains an override entry, record a
+literal skip or pragma the entry.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import Checker, Finding, Project, register
+
+TOLERANCES_PATH = os.path.join("tests", "op_tolerances.py")
+SWEEP_PATH = os.path.join("tests", "test_op_dtype_sweep.py")
+
+# the swept low-precision dtypes when no sweep module is present to parse
+DEFAULT_LOWP = ("bfloat16", "float16")
+_TABLES = {"FWD_OVERRIDES": "fwd", "GRAD_OVERRIDES": "grad"}
+
+
+def _parse_assignments(path: str) -> dict[str, ast.AST] | None:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    out: dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+def _sweep_dtypes(root: str) -> dict[str, tuple[str, ...]]:
+    """Low-precision dtypes each leg exercises, parsed from the sweep
+    module's DTYPES_FWD / DTYPES_LOWP_GRAD lists (defaults when absent)."""
+    assigns = _parse_assignments(os.path.join(root, SWEEP_PATH))
+    out = {"fwd": DEFAULT_LOWP, "grad": DEFAULT_LOWP}
+    if assigns is None:
+        return out
+    for var, leg in (("DTYPES_FWD", "fwd"), ("DTYPES_LOWP_GRAD", "grad")):
+        node = assigns.get(var)
+        if node is None:
+            continue
+        try:
+            vals = ast.literal_eval(node)
+        except ValueError:
+            continue
+        lowp = tuple(d for d in vals if d in DEFAULT_LOWP)
+        if lowp:
+            out[leg] = lowp
+    return out
+
+
+def _dict_entries(node: ast.AST):
+    """-> [(op, lineno, {dtype, ...})] for a {op: {dtype: (...)}} literal."""
+    if not isinstance(node, ast.Dict):
+        return []
+    out = []
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            continue
+        dtypes = set()
+        if isinstance(v, ast.Dict):
+            for dk in v.keys:
+                if isinstance(dk, ast.Constant) and isinstance(dk.value, str):
+                    dtypes.add(dk.value)
+        out.append((k.value, k.lineno, dtypes))
+    return out
+
+
+def _literal_skips(node: ast.AST) -> set[tuple[str, str, str]]:
+    try:
+        value = ast.literal_eval(node)
+    except (ValueError, TypeError):
+        return set()
+    return {k for k in value if isinstance(k, tuple) and len(k) == 3}
+
+
+@register
+class DtypeRuleCoverageChecker(Checker):
+    rule = "dtype-rule-coverage"
+    severity = "warning"
+
+    def finalize(self, project: Project):
+        assigns = _parse_assignments(
+            os.path.join(project.root, TOLERANCES_PATH))
+        if assigns is None:
+            return  # no tolerance registry in this tree
+        swept = _sweep_dtypes(project.root)
+        skips = _literal_skips(assigns.get("SKIPS", ast.Dict([], [])))
+        path = TOLERANCES_PATH.replace(os.sep, "/")
+        for table, leg in _TABLES.items():
+            for op, line, dtypes in _dict_entries(assigns.get(table)):
+                for dt in swept[leg]:
+                    if dt in dtypes or (op, leg, dt) in skips \
+                            or (op, leg, "*") in skips:
+                        continue
+                    yield Finding(
+                        rule=self.rule, severity=self.severity, path=path,
+                        line=line, col=0, context=f"{op}:{leg}:{dt}",
+                        message=f"{table} entry for {op!r} covers "
+                                f"{sorted(dtypes)} but not {dt!r}, which "
+                                f"the dtype sweep exercises — that leg "
+                                f"silently rides the default tolerance; "
+                                f"add a ({dt}) pair or a recorded SKIP")
