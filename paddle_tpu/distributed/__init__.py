@@ -15,7 +15,7 @@ from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: 
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
-    reshard, shard_layer, shard_op, Strategy, to_static,
+    shard_layer, shard_op, Strategy, to_static,
 )
 from .utils import global_scatter, global_gather  # noqa: F401
 from . import checkpoint  # noqa: F401
@@ -23,6 +23,12 @@ from .checkpoint import (  # noqa: F401
     CheckpointCorruptionError, save_state_dict, load_state_dict,
 )
 from . import chaos  # noqa: F401
+# `reshard` is deliberately NOT in the auto_parallel import list above:
+# the live-resharding SUBMODULE owns the name and is itself callable
+# (delegating to auto_parallel.api.reshard), so `dist.reshard(x, mesh,
+# placements)` and `dist.reshard.plan_reshard` both work no matter which
+# import runs last
+from . import reshard  # noqa: F401
 from .ckpt_manager import CheckpointManager  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from . import rpc  # noqa: F401

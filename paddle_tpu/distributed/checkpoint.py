@@ -219,12 +219,15 @@ def _spec_of(val) -> list:
 
 def wait():
     """Join any in-flight async save (orbax wait_until_finished analog).
-    Re-raises an exception the background writer hit."""
+    Re-raises an exception the background writer hit. Bounded
+    (PT_CKPT_WAIT_TIMEOUT, default 600s): a writer wedged on dead storage
+    becomes a typed DeadlineExceeded, not a forever-blocked trainer."""
+    from ..utils.deadline import join_bounded
     global _pending, _pending_error
     with _pending_lock:
         t = _pending
     if t is not None:
-        t.join()
+        join_bounded(t, "async checkpoint writer")
     with _pending_lock:
         if _pending is t:
             _pending = None

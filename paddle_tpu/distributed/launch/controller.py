@@ -68,8 +68,12 @@ class CollectiveController:
             # member's heartbeat key
             self.elastic = ElasticManager(
                 self.store, np_range=(self.ctx.nnodes, self.ctx.np_max))
-            # hold until the minimum membership is present, then pin ranks
-            self.elastic.wait_for_np(self.ctx.nnodes)
+            # hold until the minimum membership is present, then pin ranks;
+            # typed failure — wait_for_np's False must not be swallowed
+            # into building an under-strength pod
+            self.elastic.require_np(
+                self.ctx.nnodes,
+                timeout=env_timeout("PT_LAUNCH_RENDEZVOUS_TIMEOUT", 300.0))
             self.elastic.commit_roster()
         # the jax.distributed coordination service needs its OWN port (the
         # rendezvous store keeps serving on ctx.master's port); node 0 picks

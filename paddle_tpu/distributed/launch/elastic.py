@@ -22,7 +22,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ...utils.deadline import Deadline, StoreConnectionError
+from ...utils.deadline import Deadline, MembershipTimeout, \
+    StoreConnectionError
 
 ELASTIC_TIMEOUT = float(os.environ.get("PADDLE_ELASTIC_TIMEOUT", 5.0))
 
@@ -170,6 +171,21 @@ class ElasticManager:
                 return True
             dl.sleep(self.interval)
         return len(self.alive_members()) >= n
+
+    def require_np(self, n: int, timeout: float = 60.0) -> List[str]:
+        """wait_for_np whose expiry CANNOT be silently swallowed: raises
+        the typed MembershipTimeout naming the shortfall (a pod built
+        under-strength trains a wrong-world job). Returns the alive set —
+        the RETURNED snapshot is re-validated, so a member lapsing between
+        the wait and the read also raises instead of handing the caller a
+        short roster."""
+        ok = self.wait_for_np(n, timeout)
+        alive = self.alive_members()
+        if not ok or len(alive) < n:
+            raise MembershipTimeout(
+                f"elastic membership >= {n}", timeout,
+                detail=f"only {len(alive)} alive: {alive}")
+        return alive
 
     def watch_once(self) -> str:
         """One membership poll against the roster this pod launched with."""

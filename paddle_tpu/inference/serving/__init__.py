@@ -7,11 +7,11 @@ lifecycle with typed per-request TTLs (`request`), and the engine that
 drives prefill/decode through one whole-step-captured executable per aval
 signature (`engine`). See README "Serving engine".
 """
-from .engine import ServingEngine, serving_info  # noqa: F401
+from .engine import SamplingUnsupported, ServingEngine, serving_info  # noqa: F401
 from .kv_pool import KVPagePool, Page, PoolExhausted  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
 from .scheduler import ContinuousBatchingScheduler  # noqa: F401
 
-__all__ = ["ServingEngine", "serving_info", "KVPagePool", "Page",
-           "PoolExhausted", "Request", "RequestState",
+__all__ = ["SamplingUnsupported", "ServingEngine", "serving_info",
+           "KVPagePool", "Page", "PoolExhausted", "Request", "RequestState",
            "ContinuousBatchingScheduler"]

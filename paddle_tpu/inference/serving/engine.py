@@ -50,6 +50,24 @@ from .scheduler import ContinuousBatchingScheduler
 _ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
 
 
+class SamplingUnsupported(NotImplementedError):
+    """The engine is greedy-only: a submit() asking for real temperature /
+    nucleus sampling is REJECTED up front with this typed error instead of
+    silently decoding greedy (the old "rejects nothing on temperature"
+    debt). `temperature=0` / `top_p=1` are exactly greedy and accepted.
+    Per-slot sampling is the recorded follow-on (ROADMAP serving-depth)."""
+
+    def __init__(self, param: str, value):
+        self.param = param
+        self.value = value
+        super().__init__(
+            f"{param}={value!r} requires per-slot sampling, which this "
+            f"engine does not implement yet — it decodes greedily "
+            f"(deterministic argmax per slot). Pass {param}="
+            f"{'0' if param == 'temperature' else '1'} (or omit it) for "
+            f"greedy, or run sampling host-side on the returned logits.")
+
+
 def _normalize_buckets(vals, max_seq_len: int) -> List[int]:
     """One bucket policy for both knob paths: clamp every bucket to the
     static cache extent (a bucket past S_max would trace a KV write larger
@@ -155,10 +173,22 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                ttl: Optional[float] = None,
-               eos_token_id: Optional[int] = None) -> Request:
+               eos_token_id: Optional[int] = None,
+               temperature: Optional[float] = None,
+               top_p: Optional[float] = None) -> Request:
         """Enqueue one request; returns the live Request handle. Raises a
         typed ValueError immediately when the request can NEVER fit the
-        engine's static cache layout (that is a sizing bug, not load)."""
+        engine's static cache layout (that is a sizing bug, not load), and
+        the typed SamplingUnsupported when asked for sampling params the
+        greedy engine cannot honor (never silently greedy)."""
+        if temperature is not None and float(temperature) != 0.0:
+            with self._lock:
+                self._counters["rejected"] += 1
+            raise SamplingUnsupported("temperature", temperature)
+        if top_p is not None and float(top_p) != 1.0:
+            with self._lock:
+                self._counters["rejected"] += 1
+            raise SamplingUnsupported("top_p", top_p)
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       ttl=self.default_ttl if ttl is None else ttl,
                       eos_token_id=self.eos_token_id

@@ -46,6 +46,28 @@ def init_mesh(shape: dict | Sequence[int], axis_names: Optional[Sequence[str]] =
     return mesh
 
 
+def elastic_mesh_shape(template: dict, n_devices: int,
+                       elastic_axis: str = "dp") -> dict:
+    """Re-derive a mesh shape for a new device/node count after an elastic
+    shrink or grow: every non-elastic axis keeps its extent, the elastic
+    axis absorbs the change (n_devices / prod(others)). Raises when the
+    new count cannot host the fixed axes — the caller then HOLDs or falls
+    back to a full restart instead of building a wrong-world mesh."""
+    import math
+    fixed = math.prod(int(v) for k, v in template.items()
+                      if k != elastic_axis)
+    if elastic_axis not in template:
+        raise ValueError(f"elastic axis {elastic_axis!r} not in mesh "
+                         f"template {template}")
+    if n_devices <= 0 or n_devices % fixed != 0:
+        raise ValueError(
+            f"{n_devices} devices cannot host mesh template {template}: "
+            f"non-elastic axes need a multiple of {fixed}")
+    out = dict(template)
+    out[elastic_axis] = n_devices // fixed
+    return out
+
+
 def set_mesh(mesh: Optional[Mesh]):
     _state.mesh = mesh
 

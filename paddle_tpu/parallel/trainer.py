@@ -140,19 +140,13 @@ class TrainStep:
         self._buffers = [b for _, b in inner.named_buffers()]
 
         mesh = self.mesh
+        self._batch_spec = batch_spec
         if mesh is not None:
-            self._param_shardings = [
-                NamedSharding(mesh, _param_sharding_spec(p, mesh))
-                for p in self._params]
+            self._param_shardings = self._derive_param_shardings(mesh)
             # place params onto the mesh
             for p, s in zip(self._params, self._param_shardings):
                 if not isinstance(p._value, jax.core.Tracer):
                     p._value = jax.device_put(p._value, s)
-            self._batch_sharding = lambda ndim, dim=0: NamedSharding(
-                mesh, PartitionSpec(*[
-                    (batch_spec if isinstance(batch_spec, str) else
-                     tuple(a for a in batch_spec if a in mesh.axis_names))
-                    if i == dim else None for i in range(ndim)]))
         else:
             self._param_shardings = [None] * len(self._params)
 
@@ -168,26 +162,16 @@ class TrainStep:
         from ..core.tensor import Parameter
         self._opt_state = [base_opt._init_state(p) for p in self._params]
 
-        zero_axis = getattr(base_opt, "_shard_axis", None) or \
-            getattr(optimizer, "_shard_axis", None)
-        zero_stage = getattr(base_opt, "_shard_stage", 0) or \
-            getattr(optimizer, "_shard_stage", 0)
-        zero_axis = _resolve_zero_axis(zero_axis, mesh)
-        if mesh is not None and zero_axis and zero_stage >= 1:
-            self._state_shardings = []
-            for p, ps, st in zip(self._params, self._param_shardings, self._opt_state):
-                spec = {k: _zero_state_spec(ps.spec, v.shape, zero_axis, mesh)
-                        for k, v in st.items()}
-                self._state_shardings.append(
-                    {k: NamedSharding(mesh, s) for k, s in spec.items()})
-            self._opt_state = [
-                {k: jax.device_put(v, sh[k]) for k, v in st.items()}
-                for st, sh in zip(self._opt_state, self._state_shardings)]
+        if mesh is not None:
+            self._state_shardings, zero_sharded = \
+                self._derive_state_shardings(mesh)
+            if zero_sharded:
+                self._opt_state = [
+                    {k: jax.device_put(v, sh[k]) for k, v in st.items()}
+                    for st, sh in zip(self._opt_state,
+                                      self._state_shardings)]
         else:
-            self._state_shardings = [
-                {k: ps for k in st} for ps, st in
-                zip(self._param_shardings, self._opt_state)] \
-                if mesh is not None else None
+            self._state_shardings = None
 
         if accumulate_steps is None:
             accumulate_steps = int(getattr(base_opt, "_accumulate_steps", 1)
@@ -224,6 +208,41 @@ class TrainStep:
             "bad_steps": jnp.asarray(0, jnp.int32),
             "skipped": jnp.asarray(0, jnp.int32),
         }
+
+    # ---- sharding derivation (shared by __init__ and reshard()) ----
+    def _derive_param_shardings(self, mesh):
+        return [NamedSharding(mesh, _param_sharding_spec(p, mesh))
+                for p in self._params]
+
+    def _derive_state_shardings(self, mesh):
+        """Optimizer-state shardings under `mesh`, ZeRO axis re-resolved
+        against it. ONE implementation for construction and live reshard —
+        two copies would let the placement rules silently diverge after
+        the first elastic event. Returns (shardings, zero_sharded)."""
+        zero_axis = getattr(self._base_opt, "_shard_axis", None) or \
+            getattr(self.optimizer, "_shard_axis", None)
+        zero_stage = getattr(self._base_opt, "_shard_stage", 0) or \
+            getattr(self.optimizer, "_shard_stage", 0)
+        zero_axis = _resolve_zero_axis(zero_axis, mesh)
+        if zero_axis and zero_stage >= 1:
+            return [
+                {k: NamedSharding(mesh, _zero_state_spec(ps.spec, v.shape,
+                                                         zero_axis, mesh))
+                 for k, v in st.items()}
+                for ps, st in zip(self._param_shardings, self._opt_state)
+            ], True
+        return [{k: ps for k in st}
+                for ps, st in zip(self._param_shardings,
+                                  self._opt_state)], False
+
+    def _batch_sharding(self, ndim, dim=0):
+        """Batch-dim sharding against the CURRENT mesh (reshard() swaps
+        meshes, so this can't be a closure over the construction-time one)."""
+        mesh, batch_spec = self.mesh, self._batch_spec
+        return NamedSharding(mesh, PartitionSpec(*[
+            (batch_spec if isinstance(batch_spec, str) else
+             tuple(a for a in batch_spec if a in mesh.axis_names))
+            if i == dim else None for i in range(ndim)]))
 
     # ---- pure step ----
     def _build(self, example_inputs):
@@ -397,6 +416,40 @@ class TrainStep:
         for p, v in zip(self._params, new_vals):
             p._value = v
         return Tensor(loss)
+
+    # ---- live resharding (single-controller leg) ----
+    def reshard(self, new_mesh) -> None:
+        """Re-derive every param/opt-state sharding under `new_mesh` and
+        move the LIVE state onto it — the single-controller leg of elastic
+        shrink/grow (distributed/reshard.py plans the cross-process leg).
+        Values are preserved bitwise (placement only); the compiled step is
+        dropped and re-lowered lazily for the new mesh, and the self-healing
+        health pytree (loss scale, skip counters) rides along untouched."""
+        if new_mesh is None:
+            raise ValueError("reshard needs a mesh (got None)")
+        self.mesh = new_mesh
+        mesh_mod.set_mesh(new_mesh)
+        self._param_shardings = self._derive_param_shardings(new_mesh)
+        for p, s in zip(self._params, self._param_shardings):
+            if not isinstance(p._value, jax.core.Tracer):
+                p._value = jax.device_put(p._value, s)
+        self._state_shardings, _ = self._derive_state_shardings(new_mesh)
+        self._opt_state = [
+            {k: jax.device_put(v, sh[k]) for k, v in st.items()}
+            for st, sh in zip(self._opt_state, self._state_shardings)]
+        # the health scalars and model buffers are replicated, but they are
+        # still committed to the OLD mesh's device set — move them or the
+        # re-lowered step sees mixed device assignments
+        replicated = NamedSharding(new_mesh, PartitionSpec())
+        self._health = {k: jax.device_put(v, replicated)
+                        for k, v in self._health.items()}
+        for b in self._buffers:
+            if not isinstance(b._value, jax.core.Tracer):
+                b._value = jax.device_put(b._value, replicated)
+        # drop the lowered executable: its input shardings named the old
+        # mesh. The next __call__ re-lowers against the new placements.
+        self._jitted = None
+        self.captured_program = None
 
     # ---- self-healing telemetry (explicit host syncs, OUTSIDE the step) ----
     @property

@@ -125,6 +125,33 @@ def serving_summary() -> str:
     return "\n".join(lines)
 
 
+def reshard_summary() -> str:
+    """Live-reshard reports (distributed/reshard.py) as text: per executed
+    plan the ladder rung that ran (reshard / partial-restore /
+    full-restore), bytes moved on the wire vs. reused locally vs. read
+    back from the checkpoint, the naive full-gather volume the plan
+    avoided, and the downtime. A healthy elastic fleet shows `reshard`
+    rows whose moved bytes sit well under `naive`; recurring
+    `full-restore` rows mean peers keep dying mid-transfer (check the
+    reshard budget and the victim's logs)."""
+    from ..distributed.reshard import reshard_reports
+
+    reports = reshard_reports()
+    if not reports:
+        return "reshard: no executed plans"
+    head = (f"{'Owner':<14} {'How':<16} {'Moved':>12} {'Local':>12} "
+            f"{'FromCkpt':>12} {'Naive':>12} {'Downtime':>10}")
+    lines = [f"reshard: {len(reports)} executed plan(s)", head,
+             "-" * len(head)]
+    for r in reports:
+        lines.append(
+            f"{str(r['owner'])[:14]:<14} {r['how']:<16} "
+            f"{r['bytes_moved']:>12} {r['bytes_local']:>12} "
+            f"{r['bytes_from_ckpt']:>12} {r['naive_bytes']:>12} "
+            f"{r['downtime_s']:>9.3f}s")
+    return "\n".join(lines)
+
+
 def summary(events: List[dict], sorted_by: str = "total",
             time_unit: str = "ms") -> str:
     stats = aggregate(events)

@@ -56,6 +56,20 @@ class RequestTimeout(DeadlineExceeded):
     pages go back to the pool — see inference/serving/."""
 
 
+class ReshardTimeout(DeadlineExceeded):
+    """A live-resharding step (plan exchange, shard transfer, or commit
+    barrier) ran out of budget — a peer died or partitioned mid-reshard.
+    Callers fall down the ladder: reshard -> partial-restore ->
+    full-restore from the last committed checkpoint generation
+    (distributed/reshard.py)."""
+
+
+class MembershipTimeout(DeadlineExceeded):
+    """The elastic membership never reached the required size within the
+    budget (ElasticManager.require_np) — the typed form of wait_for_np's
+    False, for callers that must not proceed under-strength."""
+
+
 class StoreConnectionError(ConnectionError):
     """Terminal store-client failure: the connection died (or desynced
     mid-message) and reconnect-plus-retry did not recover it."""
@@ -125,6 +139,20 @@ def recv_exact(sock, n: int, dl: "Deadline | None" = None,
             raise closed_exc(what)
         buf += chunk
     return buf
+
+
+def join_bounded(thread, what: str, env: str = "PT_CKPT_WAIT_TIMEOUT",
+                 default: float = 600.0) -> None:
+    """Join a worker thread under an env-tunable budget; a thread still
+    alive at expiry raises the typed DeadlineExceeded (a writer wedged on
+    dead storage must not block its caller forever). Shared by the two
+    async-checkpoint wait() paths."""
+    budget = env_timeout(env, default)
+    thread.join(timeout=budget)
+    if thread.is_alive():
+        raise DeadlineExceeded(
+            what, budget,
+            detail="worker thread still running — wedged storage?")
 
 
 def env_timeout(name: str, default: float) -> float:

@@ -41,6 +41,18 @@ def main(site: str) -> None:
                                 timeout=BUDGET) == 7
         finally:
             rpc.shutdown()
+    elif site.startswith("reshard."):
+        import numpy as np
+        from paddle_tpu.distributed import reshard as rs
+
+        full = np.arange(32, dtype=np.float32).reshape(8, 4)
+        src = rs.MeshSpec.from_members(["a", "b"])
+        dst = rs.MeshSpec.from_members(["a"])
+        params = {"w": rs.ParamSpec((8, 4), np.float32, ("dp", None),
+                                    ("dp", None))}
+        states = {"a": {"w": full[:4].copy()}, "b": {"w": full[4:].copy()}}
+        out, _ = rs.redistribute(src, dst, params, states, budget=BUDGET)
+        assert np.array_equal(out["a"]["w"], full)
     elif site == "io.worker_batch":
         import numpy as np
         import paddle_tpu.io as io

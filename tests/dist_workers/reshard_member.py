@@ -1,0 +1,56 @@
+"""Chaos peer for the live-reshard kill matrix (tests/test_reshard.py).
+
+argv: store_port owner. Joins the fixed shrink plan ({a, b} dp2 -> {a})
+over the parent's master TCPStore and runs `execute` as `owner` — with a
+`reshard.*` faultpoint armed via PT_FAULTPOINT* env by the parent, this
+process SIGKILLs itself at the armed site (crash mode), mid-reshard. The
+parent's survivor must then either complete on survivors or recover from
+the last committed checkpoint generation, within a bounded deadline.
+
+Prints DONE only if it ran past every armed site (the parent asserts it
+did NOT for crash modes). State arrays are derived deterministically so
+both processes plan the identical byte-for-byte transfer schedule.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed import reshard as rs  # noqa: E402
+from paddle_tpu.distributed.store import TCPStore  # noqa: E402
+
+# keep in sync with tests/test_reshard.py::_chaos_case
+FULL_W = np.arange(12 * 4, dtype=np.float32).reshape(12, 4)
+FULL_B = np.arange(4, dtype=np.float32) * 0.5
+
+
+def build_case():
+    src = rs.MeshSpec.from_members(["a", "b"])
+    dst = rs.MeshSpec.from_members(["a"])
+    params = {
+        "w": rs.ParamSpec((12, 4), np.float32, ("dp", None), ("dp", None)),
+        "b": rs.ParamSpec((4,), np.float32, (None,), (None,)),
+    }
+    states = {
+        "a": {"w": FULL_W[:6].copy(), "b": FULL_B.copy()},
+        "b": {"w": FULL_W[6:].copy(), "b": FULL_B.copy()},
+    }
+    return src, dst, params, states
+
+
+def main() -> None:
+    port, owner = int(sys.argv[1]), sys.argv[2]
+    budget = float(os.environ.get("PT_TEST_BUDGET", "10.0"))
+    store = TCPStore("127.0.0.1", port, is_master=False)
+    src, dst, params, states = build_case()
+    plan = rs.plan_reshard(src, dst, params)
+    rs.execute(plan, owner, states[owner], rs.StoreTransport(store),
+               budget=budget, session="chaos")
+    store.stop()
+
+
+if __name__ == "__main__":
+    main()
+    print("DONE", flush=True)

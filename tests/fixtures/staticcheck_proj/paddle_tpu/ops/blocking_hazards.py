@@ -42,3 +42,21 @@ def interval_bound_ok(stop, cfg):
 
 def suppressed_get(q):
     return q.get()  # staticcheck: ok[unbounded-blocking] — fixture: pragma must silence the rule
+
+
+def thread_join_forever(t):
+    t.join()
+
+
+def thread_join_bounded_ok(t):
+    t.join(timeout=5.0)
+    t.join(2.0)
+
+
+def path_join_ok(parts):
+    import os
+    return os.path.join("a", "b"), ",".join(parts)
+
+
+def suppressed_join(t):
+    t.join()  # staticcheck: ok[unbounded-blocking] — fixture: pragma must silence the join leg

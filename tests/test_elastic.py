@@ -101,6 +101,57 @@ def test_hold_below_np_min(store):
         b.stop()
 
 
+def test_require_np_timeout_is_typed_and_bounded(store):
+    """wait_for_np returns False on expiry — a policy decision callers kept
+    silently swallowing (the controller built under-strength pods).
+    require_np is the can't-ignore form: typed MembershipTimeout naming
+    the shortfall, within the budget."""
+    from paddle_tpu.utils.deadline import MembershipTimeout
+
+    a = _mk(store, "nodeA")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(MembershipTimeout, match="only 1 alive"):
+            a.require_np(3, timeout=0.6)
+        assert time.monotonic() - t0 < 5.0
+        # and the satisfied path returns the alive set
+        assert a.require_np(1, timeout=5.0) == ["nodeA"]
+    finally:
+        a.stop()
+
+
+def test_lease_lapse_eviction_then_rejoin_gets_gap_free_rank(store):
+    """A worker whose lease lapsed (suspended process, burst partition) is
+    evicted by every observer; when it comes back (fresh manager, same
+    node id — the relaunch path) it must rejoin and land a fresh,
+    GAP-FREE rank: sorted-position ranks over the alive set, no hole
+    where the evicted incarnation used to be."""
+    a = _mk(store, "nodeA", timeout=0.5)
+    b = _mk(store, "nodeB", timeout=0.5)
+    rejoined = None
+    try:
+        assert a.wait_for_np(2, timeout=5)
+        # lapse: stop B's heartbeats WITHOUT revoking (no graceful leave)
+        b._stop.set()
+        b._hb_thread.join(timeout=5)
+        time.sleep(2 * b.interval + b.timeout + 0.5)   # > lease ttl
+        assert a.alive_members() == ["nodeA"]
+        assert a.rank_of() == 0
+        # rejoin under the SAME identity (what a relaunched worker does)
+        rejoined = _mk(store, "nodeB", timeout=0.5)
+        assert a.wait_for_np(2, timeout=5)
+        members = a.alive_members()
+        assert members == ["nodeA", "nodeB"], members
+        # gap-free: ranks are exactly 0..n-1 over the sorted alive set
+        ranks = sorted(m.rank_of(members) for m in (a, rejoined))
+        assert ranks == [0, 1], ranks
+    finally:
+        a.stop()
+        b.stop()
+        if rejoined is not None:
+            rejoined.stop()
+
+
 def test_nnodes_range_parses():
     from paddle_tpu.distributed.launch.context import Context
     ctx = Context.from_args(["--nnodes", "2:4", "--master", "127.0.0.1:45001",

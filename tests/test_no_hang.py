@@ -28,6 +28,7 @@ import pytest
 
 import paddle_tpu.io as io
 from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed import reshard as reshard_mod  # noqa: F401 — registers reshard.* sites
 from paddle_tpu.distributed import rpc as rpc_mod
 from paddle_tpu.distributed import store as store_mod
 from paddle_tpu.distributed.store import _GET, _PyStoreServer
@@ -60,6 +61,21 @@ MATRIX = {
     ("io.worker_batch", "delay:30"):  ("typed", "DataLoaderTimeout"),
     ("io.worker_batch", "error"):     ("typed", "RuntimeError"),
     ("io.worker_batch", "drop"):      ("typed", "RuntimeError"),
+    # live resharding: all three blocking edges (plan exchange, shard
+    # transfer, commit barrier) are deadline-bounded; a dropped wire is
+    # absorbed by the executor's idempotent retry-once
+    ("reshard.plan", "crash"):        ("sigkill", None),
+    ("reshard.plan", "delay:2.0"):    ("typed", "ReshardTimeout"),
+    ("reshard.plan", "error"):        ("typed", "FaultInjected"),
+    ("reshard.plan", "drop"):         ("clean", None),
+    ("reshard.transfer", "crash"):    ("sigkill", None),
+    ("reshard.transfer", "delay:2.0"): ("typed", "ReshardTimeout"),
+    ("reshard.transfer", "error"):    ("typed", "FaultInjected"),
+    ("reshard.transfer", "drop"):     ("clean", None),
+    ("reshard.commit", "crash"):      ("sigkill", None),
+    ("reshard.commit", "delay:2.0"):  ("typed", "ReshardTimeout"),
+    ("reshard.commit", "error"):      ("typed", "FaultInjected"),
+    ("reshard.commit", "drop"):       ("clean", None),
 }
 
 
@@ -366,6 +382,42 @@ def test_rpc_delay_fault_raises_rpc_timeout(solo_rpc, arm):
     # the agent is still healthy afterwards
     chaos.reset_hits()
     assert rpc_mod.rpc_sync("solo", int, args=("8",)) == 8
+
+
+class _WedgedNativeLib:
+    """A native transport whose pt_rpc_call ignores its C-side timeout and
+    parks — the exact standing debt: the Python-level Deadline must be the
+    authority and abandon the call with the typed RpcTimeout."""
+
+    @staticmethod
+    def pt_rpc_call(*_a):
+        time.sleep(5.0)
+        return -3
+
+    @staticmethod
+    def pt_free(_p):
+        pass
+
+
+def test_native_rpc_overrun_bounded_by_python_deadline(solo_rpc, monkeypatch):
+    from paddle_tpu.utils import native as native_mod
+
+    monkeypatch.setattr(native_mod, "get_lib", lambda: _WedgedNativeLib)
+    t0 = time.monotonic()
+    with pytest.raises(RpcTimeout, match="abandoned"):
+        run_bounded(
+            lambda: rpc_mod.rpc_sync("solo", int, args=("7",), timeout=0.4),
+            10.0, "rpc_sync over a wedged native transport")
+    # typed at ~the Python budget (+grace), NOT the 5s the C call wanted
+    assert time.monotonic() - t0 < 3.0
+
+
+def test_rpc_timeout_none_is_explicitly_unbounded_and_works(solo_rpc):
+    """Review regression: the documented `timeout=None` contract must not
+    TypeError on the native path (float(None) into pt_rpc_call)."""
+    assert run_bounded(
+        lambda: rpc_mod.rpc_sync("solo", int, args=("9",), timeout=None),
+        15.0, "rpc_sync with timeout=None") == 9
 
 
 def test_rpc_drop_fault_raises_connection_error(solo_rpc, arm):

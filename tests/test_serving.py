@@ -167,6 +167,28 @@ def test_oversized_request_rejected_typed():
     assert eng.info()["rejected"] == 1
 
 
+def test_unsupported_sampling_params_rejected_typed():
+    """The greedy-only engine must REJECT real sampling asks up front with
+    the typed SamplingUnsupported (NotImplementedError family) instead of
+    silently decoding greedy — closing the 'rejects nothing on
+    temperature' debt. Greedy-equivalent spellings stay accepted."""
+    from paddle_tpu.inference.serving import SamplingUnsupported
+
+    m = _model(seed=23)
+    eng = ServingEngine(m, max_batch=2, max_seq_len=32)
+    with pytest.raises(SamplingUnsupported, match="temperature"):
+        eng.submit(_prompt(4), max_new_tokens=2, temperature=0.8)
+    with pytest.raises(NotImplementedError, match="top_p"):
+        eng.submit(_prompt(4), max_new_tokens=2, top_p=0.9)
+    assert eng.info()["rejected"] == 2
+    # temperature=0 / top_p=1 ARE greedy: accepted and served
+    r = eng.submit(_prompt(4), max_new_tokens=2, temperature=0.0, top_p=1.0)
+    eng.run()
+    assert r.result().size == 6
+    # a rejected request never touched the pool
+    assert eng.pool.info()["active_pages"] == 0
+
+
 def test_behind_head_reservation_cannot_wedge_fifo():
     """Review regression: a small request behind a BLOCKED head must not
     pin the pages the head is waiting for — reservations stay FIFO-prefix-

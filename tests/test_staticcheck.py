@@ -152,13 +152,14 @@ def test_closure_capture_known_answers(fixture_findings):
 
 
 def test_unbounded_blocking_known_answers(fixture_findings):
-    """blocking_hazards.py: the four positives fire (argless q.get(),
-    string-keyed store.wait, boundless cond.wait_for, raw sock.recv); every
-    bounded variant (timeout kwarg, numeric positional, interval-named
-    bound), dict-style get, and the pragma'd copy stay quiet."""
+    """blocking_hazards.py: the five positives fire (argless q.get(),
+    string-keyed store.wait, boundless cond.wait_for, raw sock.recv,
+    argless t.join()); every bounded variant (timeout kwarg, numeric
+    positional, interval-named bound), dict-style get, str/os.path join
+    (always carry arguments), and the pragma'd copies stay quiet."""
     ub = [f for f in fixture_findings if f.rule == "unbounded-blocking"]
     assert all(f.path == "paddle_tpu/ops/blocking_hazards.py" for f in ub), ub
-    assert {f.line for f in ub} == {11, 15, 20, 24}, ub
+    assert {f.line for f in ub} == {11, 15, 20, 24, 48}, ub
     assert all(f.severity == "warning" for f in ub)
     # and no OTHER rule trips over the blocking fixture
     others = [f for f in fixture_findings

@@ -15,7 +15,12 @@ forever by construction:
     `store.wait("key")` and `cond.wait()` fail);
   - `sock.recv(...)`-family reads — a socket deadline is invisible
     statically, so every raw read must either run under a managed
-    `Deadline` or state why it may park forever, via the pragma.
+    `Deadline` or state why it may park forever, via the pragma;
+  - argless `t.join()` — joining a thread/process with no timeout parks
+    forever on a worker that never exits (a writer wedged on dead
+    storage, a heartbeat thread spinning reconnects). `t.join(5.0)` /
+    `t.join(timeout=...)` pass; `os.path.join(a, b)` and `sep.join(xs)`
+    always carry arguments and are never flagged.
 
 Deliberately unbounded sites (server-side handler threads released by
 stop(), device DMA waits) get `# staticcheck: ok[unbounded-blocking]`
@@ -89,6 +94,20 @@ class UnboundedBlockingChecker(Checker):
                     f"that never delivers — pass `timeout=` (typed "
                     f"DeadlineExceeded beats a silent hang), or pragma "
                     f"with why this wait is released by construction")
+            elif attr == "join":
+                # only the ARGLESS form is a blocking join hazard:
+                # str.join/os.path.join always take the iterable/components
+                if not node.args and not _has_timeout_kwarg(node):
+                    yield mod.finding(
+                        self.rule, self.severity, node,
+                        "`.join()` with no bound waits forever on a "
+                        "worker that never exits — pass `timeout=` and "
+                        "handle the still-alive case with a typed "
+                        "DeadlineExceeded (utils.deadline.join_bounded); "
+                        "for timeout-less join APIs (queue.Queue.join, "
+                        "multiprocessing.Pool.join) restructure to a "
+                        "bounded wait, or pragma with why the worker "
+                        "always terminates")
             elif attr in _RECV_METHODS:
                 yield mod.finding(
                     self.rule, self.severity, node,
