@@ -3,15 +3,20 @@
 The serving loop over the captured ragged decode path: a paged KV-cache
 pool with capacity-based admission (`kv_pool`), a scheduler that joins and
 evicts requests strictly between decode steps (`scheduler`), the request
-lifecycle with typed per-request TTLs (`request`), and the engine that
-drives prefill/decode through one whole-step-captured executable per aval
-signature (`engine`). See README "Serving engine".
+lifecycle with typed per-request TTLs (`request`), the engine that drives
+prefill/decode through one whole-step-captured executable per aval
+signature (`engine`), and speculative decoding drafters (`speculative`:
+n-gram prompt-lookup default, shrunk-model alternative) feeding the
+fixed-signature [max_batch, k+1] verify step. See README "Serving engine".
 """
 from .engine import SamplingUnsupported, ServingEngine, serving_info  # noqa: F401
 from .kv_pool import KVPagePool, Page, PoolExhausted  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
 from .scheduler import ContinuousBatchingScheduler  # noqa: F401
+from .speculative import (  # noqa: F401
+    Drafter, DraftModelDrafter, NGramDrafter, build_drafter)
 
 __all__ = ["SamplingUnsupported", "ServingEngine", "serving_info",
            "KVPagePool", "Page", "PoolExhausted", "Request", "RequestState",
-           "ContinuousBatchingScheduler"]
+           "ContinuousBatchingScheduler", "Drafter", "NGramDrafter",
+           "DraftModelDrafter", "build_drafter"]

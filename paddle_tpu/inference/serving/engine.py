@@ -24,14 +24,27 @@ across the batch in every op (rope, cache write, ragged attention, the
 projections), so a join changes neither the tokens nor the lowering count
 of in-flight requests — tests/test_serving.py asserts both, bitwise.
 
+Speculative decoding (PT_SERVE_SPEC_K > 0): a drafter (speculative.py —
+n-gram prompt-lookup by default, zero extra weights) proposes k tokens
+per active slot and ONE captured [max_batch, k+1] verify call scores
+every window position; the engine accepts the longest draft prefix
+matching the target argmax plus the bonus token, so each verify emits
+1..k+1 tokens per slot while the stream stays bitwise the greedy
+non-speculative one. Rejection is cursor arithmetic — pages are reserved
+for the whole lifetime (incl. the k-token verify scratch), so nothing
+churns in the pool.
+
 Env knobs (all read at engine construction):
 - ``PT_SERVE_MAX_BATCH``   (default 8)   decode slots
 - ``PT_SERVE_PAGE_SIZE``   (default 16)  tokens per KV page
 - ``PT_SERVE_MAX_SEQ``     (default: model max_position_embeddings)
 - ``PT_SERVE_PREFILL_BUCKETS`` comma list (default: powers of two)
+- ``PT_SERVE_SPEC_K``      (default 0)   draft tokens per verify (0 = off)
+- ``PT_SERVE_DRAFTER``     (default "ngram") ngram | model
 """
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -46,26 +59,51 @@ from ...utils.deadline import env_int
 from .kv_pool import KVPagePool
 from .request import Request, RequestState
 from .scheduler import ContinuousBatchingScheduler
+from .speculative import build_drafter
 
 _ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
 
 
-class SamplingUnsupported(NotImplementedError):
-    """The engine is greedy-only: a submit() asking for real temperature /
-    nucleus sampling is REJECTED up front with this typed error instead of
-    silently decoding greedy (the old "rejects nothing on temperature"
-    debt). `temperature=0` / `top_p=1` are exactly greedy and accepted.
-    Per-slot sampling is the recorded follow-on (ROADMAP serving-depth)."""
+def _write_slot_impl(batch_caches, pref_caches, slot):
+    """Donating slot write: prefilled [1, S_max] KV rows -> batch row."""
+    z = jnp.asarray(0, jnp.int32)
+    return [
+        (jax.lax.dynamic_update_slice(bk, pk.astype(bk.dtype),
+                                      (slot, z, z, z)),
+         jax.lax.dynamic_update_slice(bv, pv.astype(bv.dtype),
+                                      (slot, z, z, z)))
+        for (bk, bv), (pk, pv) in zip(batch_caches, pref_caches)]
 
-    def __init__(self, param: str, value):
+
+# ONE jitted writer process-wide (it closes over nothing): jax.jit memoizes
+# per cache-shape signature, so every engine over a given layout shares one
+# compile instead of paying a fresh ~50ms lowering per ServingEngine — the
+# difference between a TTFT and a compile benchmark for short-lived engines
+_write_slot = jax.jit(_write_slot_impl, donate_argnums=(0,))
+
+
+class SamplingUnsupported(NotImplementedError):
+    """A submit() asked for sampling this engine cannot honor; rejected up
+    front with this typed error instead of silently decoding greedy.
+
+    Non-speculative engines DO serve per-slot temperature sampling now
+    (host-side off the returned logits row; optional top_p nucleus on
+    top), so this fires only for (a) any non-greedy ask on a SPECULATIVE
+    engine — greedy acceptance is what makes the speculative stream exact,
+    so spec engines stay greedy-only — and (b) top_p < 1 without a
+    positive temperature, which has no sampling distribution to draw
+    from. `temperature=0` / `top_p=1` are exactly greedy and always
+    accepted."""
+
+    def __init__(self, param: str, value, why: str = ""):
         self.param = param
         self.value = value
+        why = why or ("this engine decodes greedily (deterministic argmax "
+                      "per slot) for this parameter combination")
         super().__init__(
-            f"{param}={value!r} requires per-slot sampling, which this "
-            f"engine does not implement yet — it decodes greedily "
-            f"(deterministic argmax per slot). Pass {param}="
+            f"{param}={value!r} cannot be honored: {why}. Pass {param}="
             f"{'0' if param == 'temperature' else '1'} (or omit it) for "
-            f"greedy, or run sampling host-side on the returned logits.")
+            f"greedy decoding.")
 
 
 def _normalize_buckets(vals, max_seq_len: int) -> List[int]:
@@ -112,7 +150,9 @@ class ServingEngine:
                  page_size: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  eos_token_id: Optional[int] = None,
-                 default_ttl: Optional[float] = None):
+                 default_ttl: Optional[float] = None,
+                 spec_k: Optional[int] = None,
+                 drafter=None, draft_model=None):
         self.model = model
         cfg = model.config
         self.max_batch = max_batch or env_int("PT_SERVE_MAX_BATCH", 8)
@@ -120,11 +160,22 @@ class ServingEngine:
             "PT_SERVE_MAX_SEQ", cfg.max_position_embeddings)
         self.eos_token_id = eos_token_id
         self.default_ttl = default_ttl
+        self.spec_k = env_int("PT_SERVE_SPEC_K", 0) if spec_k is None \
+            else int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
+        if self.spec_k and self.spec_k + 1 >= self.max_seq_len:
+            raise ValueError(
+                f"spec_k={self.spec_k} leaves no room for prompts in "
+                f"max_seq_len={self.max_seq_len}")
         page = page_size or env_int("PT_SERVE_PAGE_SIZE", 16)
         pages_per_slot = -(-self.max_seq_len // page)
         self.pool = KVPagePool(self.max_batch * pages_per_slot, page)
-        self.scheduler = ContinuousBatchingScheduler(self.pool,
-                                                     self.max_batch)
+        # speculative slots reserve k extra positions of verify scratch:
+        # a verify window may write k tokens past the accepted cursor, and
+        # those positions must be capacity the request already owns
+        self.scheduler = ContinuousBatchingScheduler(
+            self.pool, self.max_batch, reserve_extra_tokens=self.spec_k)
         if prefill_buckets:
             if not any(int(b) > 0 for b in prefill_buckets):
                 raise ValueError(
@@ -148,21 +199,30 @@ class ServingEngine:
             step = model._build_slot_step()
             model.__dict__["_slot_step"] = step
         self._step_fn = step
-
-        # donating slot write: prefilled [1, S_max] KV rows -> batch row
-        def write_slot(batch_caches, pref_caches, slot):
-            z = jnp.asarray(0, jnp.int32)
-            return [
-                (jax.lax.dynamic_update_slice(bk, pk.astype(bk.dtype),
-                                              (slot, z, z, z)),
-                 jax.lax.dynamic_update_slice(bv, pv.astype(bv.dtype),
-                                              (slot, z, z, z)))
-                for (bk, bv), (pk, pv) in zip(batch_caches, pref_caches)]
-        self._write_slot = jax.jit(write_slot, donate_argnums=(0,))
+        # the sampling variant (returns the last-token logits row) is
+        # built lazily on the first step that has a sampling slot active,
+        # so greedy-only engines never add its lowering
+        self._logits_step = None
+        self._verify_fn = None
+        self.drafter = None
+        if self.spec_k:
+            vstep = model.__dict__.get("_verify_step")
+            if vstep is None:
+                vstep = model._build_verify_step()
+                model.__dict__["_verify_step"] = vstep
+            self._verify_fn = vstep
+            self.drafter = build_drafter(
+                drafter or os.environ.get("PT_SERVE_DRAFTER", "ngram"),
+                self.max_batch, self.max_seq_len, draft_model=draft_model)
 
         self._lock = threading.Lock()   # serializes step()/run()
         self._counters = {"prefills": 0, "decode_steps": 0,
-                          "tokens_generated": 0, "rejected": 0}
+                          "tokens_generated": 0, "rejected": 0,
+                          "verify_steps": 0, "draft_tokens_proposed": 0,
+                          "draft_tokens_accepted": 0, "sampled_tokens": 0}
+        # tokens-per-verify histogram: index i = verifies that emitted i
+        # tokens for a slot (1..k+1)
+        self._accept_hist = [0] * (self.spec_k + 2)
         self._occupancy_sum = 0.0
         self._decode_time = 0.0
         self._prefill_time = 0.0
@@ -175,32 +235,75 @@ class ServingEngine:
                ttl: Optional[float] = None,
                eos_token_id: Optional[int] = None,
                temperature: Optional[float] = None,
-               top_p: Optional[float] = None) -> Request:
+               top_p: Optional[float] = None,
+               seed: Optional[int] = None) -> Request:
         """Enqueue one request; returns the live Request handle. Raises a
         typed ValueError immediately when the request can NEVER fit the
         engine's static cache layout (that is a sizing bug, not load), and
-        the typed SamplingUnsupported when asked for sampling params the
-        greedy engine cannot honor (never silently greedy)."""
-        if temperature is not None and float(temperature) != 0.0:
+        the typed SamplingUnsupported for sampling asks the engine cannot
+        honor (never silently greedy): non-speculative engines serve
+        temperature (+ optional top_p nucleus) per slot, host-side;
+        speculative engines are greedy-only by construction. ``seed``
+        makes a sampled request's stream reproducible (default: its rid)."""
+        if temperature is not None and not (
+                math.isfinite(float(temperature)) and float(temperature) >= 0.0):
             with self._lock:
                 self._counters["rejected"] += 1
-            raise SamplingUnsupported("temperature", temperature)
-        if top_p is not None and float(top_p) != 1.0:
+            raise SamplingUnsupported(
+                "temperature", temperature, why="temperature must be a "
+                "finite value >= 0 (a negative temperature would invert "
+                "the distribution, which no engine serves)")
+        if top_p is not None and not (
+                math.isfinite(float(top_p)) and 0.0 < float(top_p) <= 1.0):
             with self._lock:
                 self._counters["rejected"] += 1
-            raise SamplingUnsupported("top_p", top_p)
+            raise SamplingUnsupported(
+                "top_p", top_p, why="top_p must lie in (0, 1] — the "
+                "nucleus is the smallest prefix of the sorted distribution "
+                "reaching top_p, which is empty at <= 0 and over-full "
+                "past 1")
+        greedy_t = temperature is None or float(temperature) == 0.0
+        greedy_p = top_p is None or float(top_p) == 1.0
+        if greedy_t and not greedy_p:
+            # checked BEFORE the speculative branch: top_p-sans-temperature
+            # is rejected by EVERY engine, so "submit to a non-speculative
+            # engine" would be wrong guidance for this ask
+            with self._lock:
+                self._counters["rejected"] += 1
+            raise SamplingUnsupported(
+                "top_p", top_p, why="top_p nucleus filtering needs a "
+                "positive temperature to define the sampling distribution "
+                "(temperature-only or temperature+top_p are served)")
+        if self.spec_k and not (greedy_t and greedy_p):
+            # greedy acceptance is the exactness argument; a sampled slot
+            # inside a speculative batch would need lossy acceptance rules
+            param, val = (("temperature", temperature) if not greedy_t
+                          else ("top_p", top_p))
+            with self._lock:
+                self._counters["rejected"] += 1
+            raise SamplingUnsupported(
+                param, val, why="this engine decodes SPECULATIVELY "
+                "(spec_k={}) and greedy verification is what keeps the "
+                "speculative stream exact — submit to a non-speculative "
+                "engine for per-slot sampling".format(self.spec_k))
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       ttl=self.default_ttl if ttl is None else ttl,
                       eos_token_id=self.eos_token_id
-                      if eos_token_id is None else eos_token_id)
-        total = req.prompt.size + req.max_new_tokens
+                      if eos_token_id is None else eos_token_id,
+                      temperature=None if greedy_t else float(temperature),
+                      top_p=None if greedy_p else float(top_p),
+                      seed=seed)
+        total = req.prompt.size + req.max_new_tokens + self.spec_k
         if total > self.max_seq_len:
             with self._lock:  # submit() is the documented any-thread path
                 self._counters["rejected"] += 1
+            spec = (f" (incl. {self.spec_k} positions of speculative "
+                    f"verify scratch)" if self.spec_k else "")
             raise ValueError(
-                f"request needs {total} KV positions but the engine's "
-                f"static layout holds max_seq_len={self.max_seq_len} — "
-                f"shorten the prompt/max_new_tokens or size the engine up")
+                f"request needs {total} KV positions{spec} but the "
+                f"engine's static layout holds max_seq_len="
+                f"{self.max_seq_len} — shorten the prompt/max_new_tokens "
+                f"or size the engine up")
         self.scheduler.submit(req)
         return req
 
@@ -212,11 +315,18 @@ class ServingEngine:
         prefill the joiners -> ONE batched decode step for every active
         slot. Returns the number of tokens produced."""
         with self._lock:
-            joined, _ = self.scheduler.schedule()
+            joined, evicted = self.scheduler.schedule()
+            if self.drafter is not None:
+                for req in evicted:
+                    # a slot holding in-flight draft state gives it back
+                    # here, strictly between steps — the verify signature
+                    # and everyone else's tokens never notice
+                    self.drafter.on_evict(req)
             produced = 0
             for req in joined:
                 produced += self._prefill(req)
-            produced += self._decode()
+            produced += self._decode_speculative() if self.spec_k \
+                else self._decode()
             return produced
 
     def run(self, poll: float = 0.0) -> None:
@@ -244,10 +354,24 @@ class ServingEngine:
                 return b
         return self.max_seq_len
 
+    def _ensure_logits_step(self):
+        """The sampling slot-step variant (argmax AND last-token logits
+        row), built/stashed per model on first need: greedy-only traffic
+        never lowers it, so the frozen-lowering join contract for greedy
+        engines is untouched."""
+        if self._logits_step is None:
+            step = self.model.__dict__.get("_slot_step_logits")
+            if step is None:
+                step = self.model._build_slot_step(return_logits=True)
+                self.model.__dict__["_slot_step_logits"] = step
+            self._logits_step = step
+        return self._logits_step
+
     def _prefill(self, req: Request) -> int:
         """Run the joiner's prompt through the captured step at its bucket
         length (batch 1, fresh zero caches), write the KV rows into its
-        slot, and sample its first token."""
+        slot, and sample its first token (argmax on device for greedy
+        requests; host-side off the logits row for sampled ones)."""
         t0 = time.perf_counter()
         plen = req.prompt.size
         bucket = self._bucket_for(plen)
@@ -258,30 +382,46 @@ class ServingEngine:
                         jnp.zeros((1,) + self._cache_shape,
                                   self._cache_dtype))
                        for _ in self._caches]
-        nxt, pref_out = self._step_fn(
-            self._params, jnp.asarray(tok), pref_caches,
-            jnp.zeros((1,), jnp.int32),
-            jnp.asarray([plen - 1], jnp.int32))
-        self._caches = self._write_slot(self._caches, pref_out,
-                                        jnp.asarray(req.slot, jnp.int32))
+        args = (self._params, jnp.asarray(tok), pref_caches,
+                jnp.zeros((1,), jnp.int32),
+                jnp.asarray([plen - 1], jnp.int32))
+        if req.is_sampling:
+            nxt, logits, pref_out = self._ensure_logits_step()(*args)
+            first = self._sample_row(req, np.asarray(logits)[0])
+            self._counters["sampled_tokens"] += 1
+        else:
+            nxt, pref_out = self._step_fn(*args)
+            first = int(np.asarray(nxt)[0])
+        self._caches = _write_slot(self._caches, pref_out,
+                                   jnp.asarray(req.slot, jnp.int32))
         req.cache_len = plen
         req.state = RequestState.DECODING
-        first = int(np.asarray(nxt)[0])
         if not req.append_token(first):
             req.next_token = first
+        if self.drafter is not None:
+            self.drafter.on_join(req)
         self._counters["prefills"] += 1
         self._counters["tokens_generated"] += 1
         self._prefill_time += time.perf_counter() - t0
         return 1
 
+    def _active_slots(self):
+        return [(s, r) for s, r in sorted(self.scheduler.running().items())
+                if r.state is RequestState.DECODING
+                and r.finish_reason is None]
+
     def _decode(self) -> int:
         """One [max_batch, 1] decode step over every active slot. Inactive
         slots feed token 0 at offset 0 — their rows are garbage the ragged
         length vector keeps out of everyone else's attention, and the next
-        prefill overwrites them wholesale."""
-        active = [(s, r) for s, r in sorted(self.scheduler.running().items())
-                  if r.state is RequestState.DECODING
-                  and r.finish_reason is None]
+        prefill overwrites them wholesale.
+
+        Greedy slots take the on-device argmax ([B] i32 to host); sampled
+        slots re-draw host-side from their logits row — the logits-
+        returning step variant only runs on steps where a sampled slot is
+        active, and its greedy rows ride the SAME on-device argmax, so
+        greedy streams are bitwise identical either way."""
+        active = self._active_slots()
         if not active:
             return 0
         t0 = time.perf_counter()
@@ -291,13 +431,23 @@ class ServingEngine:
         for s, r in active:
             tok[s, 0] = r.next_token
             off[s] = r.cache_len
-        nxt, self._caches = self._step_fn(
-            self._params, jnp.asarray(tok), self._caches,
-            jnp.asarray(off), jnp.zeros((b,), jnp.int32))
+        sampling = [(s, r) for s, r in active if r.is_sampling]
+        args = (self._params, jnp.asarray(tok), self._caches,
+                jnp.asarray(off), jnp.zeros((b,), jnp.int32))
+        if sampling:
+            nxt, logits, self._caches = self._ensure_logits_step()(*args)
+            rows = np.asarray(logits)
+        else:
+            nxt, self._caches = self._step_fn(*args)
+            rows = None
         sampled = np.asarray(nxt)   # [B] i32, not [B, vocab] logits
         for s, r in active:
             r.cache_len += 1
-            t = int(sampled[s])
+            if r.is_sampling:
+                t = self._sample_row(r, rows[s])
+                self._counters["sampled_tokens"] += 1
+            else:
+                t = int(sampled[s])
             if not r.append_token(t):
                 r.next_token = t
         self._counters["decode_steps"] += 1
@@ -305,6 +455,77 @@ class ServingEngine:
         self._occupancy_sum += len(active) / float(b)
         self._decode_time += time.perf_counter() - t0
         return len(active)
+
+    def _decode_speculative(self) -> int:
+        """One drafter pass + ONE [max_batch, k+1] verify call serving
+        every active slot: row b carries the slot's pending token followed
+        by its k draft proposals at offsets cache_len..cache_len+k. The
+        verify returns the greedy argmax at every window position; each
+        slot emits the longest draft prefix matching those targets plus
+        the bonus target token — 1..k+1 tokens per step, bitwise the
+        non-speculative stream. Rejected positions cost nothing to undo:
+        the cursor (cache_len) simply doesn't advance past them, their
+        cache rows sit beyond every ragged length until overwritten, and
+        the pages were reserved for the whole lifetime up front."""
+        active = self._active_slots()
+        if not active:
+            return 0
+        t0 = time.perf_counter()
+        b, k = self.max_batch, self.spec_k
+        drafts = self.drafter.propose(dict(active), k)
+        tok = np.zeros((b, k + 1), np.int64)
+        off = np.zeros((b,), np.int32)
+        for s, r in active:
+            tok[s, 0] = r.next_token
+            tok[s, 1:] = drafts[s]
+            off[s] = r.cache_len
+        nxt, self._caches = self._verify_fn(
+            self._params, jnp.asarray(tok), self._caches, jnp.asarray(off))
+        targets = np.asarray(nxt)           # [B, k+1] i32, one sync per step
+        produced = 0
+        for s, r in active:
+            d = drafts[s]
+            m = 0
+            while m < k and int(d[m]) == int(targets[s, m]):
+                m += 1
+            emitted = 0
+            for i in range(m + 1):
+                t = int(targets[s, i])
+                emitted += 1
+                if r.append_token(t):
+                    break
+                r.next_token = t
+            r.cache_len += emitted
+            self.drafter.observe(r, emitted)
+            self._accept_hist[emitted] += 1
+            self._counters["draft_tokens_proposed"] += k
+            self._counters["draft_tokens_accepted"] += m
+            produced += emitted
+        self._counters["decode_steps"] += 1
+        self._counters["verify_steps"] += 1
+        self._counters["tokens_generated"] += produced
+        self._occupancy_sum += len(active) / float(b)
+        self._decode_time += time.perf_counter() - t0
+        return produced
+
+    def _sample_row(self, req: Request, row) -> int:
+        """Host-side per-slot sampling from one logits row: temperature
+        scaling, optional top_p nucleus truncation (smallest prefix of the
+        sorted distribution reaching top_p), then one draw from the
+        request's own deterministic Generator — rows are independent, so a
+        sampled slot never perturbs its greedy neighbors."""
+        logits = np.asarray(row, np.float64) / float(req.temperature)
+        logits -= logits.max()
+        probs = np.exp(logits)
+        probs /= probs.sum()
+        if req.top_p is not None:
+            order = np.argsort(-probs, kind="stable")
+            cum = np.cumsum(probs[order])
+            cut = int(np.searchsorted(cum, float(req.top_p))) + 1
+            keep = order[:cut]
+            p = probs[keep] / probs[keep].sum()
+            return int(keep[req.rng.choice(len(keep), p=p)])
+        return int(req.rng.choice(len(probs), p=probs))
 
     # ------------------------------------------------------------------
     # introspection (profiler.serving_summary reads this)
@@ -315,7 +536,7 @@ class ServingEngine:
         gen_time = self._decode_time + self._prefill_time
         sched = self.scheduler.info()
         step_info = getattr(self._step_fn, "cache_info", dict)()
-        return {
+        out = {
             "max_batch": self.max_batch,
             "max_seq_len": self.max_seq_len,
             "prefill_buckets": list(self.buckets),
@@ -326,12 +547,33 @@ class ServingEngine:
             "prefills": c["prefills"],
             "decode_steps": steps,
             "tokens_generated": c["tokens_generated"],
+            "sampled_tokens": c["sampled_tokens"],
             "avg_occupancy": self._occupancy_sum / steps if steps else 0.0,
             "tokens_per_sec": c["tokens_generated"] / gen_time
             if gen_time else 0.0,
             "pool": self.pool.info(),
             "step": step_info,
         }
+        if self.spec_k:
+            proposed = c["draft_tokens_proposed"]
+            verifies = c["verify_steps"]
+            emitted = sum(i * n for i, n in enumerate(self._accept_hist))
+            slots_verified = sum(self._accept_hist)
+            out["spec"] = {
+                "k": self.spec_k,
+                "drafter": self.drafter.info(),
+                "verify_steps": verifies,
+                "draft_steps": getattr(self.drafter, "draft_calls", 0),
+                "draft_tokens_proposed": proposed,
+                "draft_tokens_accepted": c["draft_tokens_accepted"],
+                "acceptance_rate": c["draft_tokens_accepted"] / proposed
+                if proposed else 0.0,
+                "tokens_per_verify": emitted / slots_verified
+                if slots_verified else 0.0,
+                "tokens_per_verify_hist": list(self._accept_hist),
+                "verify": getattr(self._verify_fn, "cache_info", dict)(),
+            }
+        return out
 
 
 def serving_info() -> List[dict]:

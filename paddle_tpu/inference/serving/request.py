@@ -45,7 +45,10 @@ class Request:
 
     def __init__(self, prompt_ids, max_new_tokens: int = 16,
                  ttl: Optional[float] = None,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 temperature: Optional[float] = None,
+                 top_p: Optional[float] = None,
+                 seed: Optional[int] = None):
         self.rid = next(_rid_counter)
         self.prompt = np.asarray(prompt_ids, dtype=np.int64).reshape(-1)
         if self.prompt.size == 0:
@@ -54,6 +57,13 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError("Request: max_new_tokens must be >= 1")
         self.eos_token_id = eos_token_id
+        # per-slot sampling (engine-validated: None means greedy); the
+        # Generator is the request's own, seeded deterministically, so a
+        # sampled stream is reproducible and independent of its neighbors
+        self.temperature = temperature
+        self.top_p = top_p
+        self.seed = self.rid if seed is None else int(seed)
+        self._rng = None
         self.deadline = Deadline(ttl, what=f"serving request {self.rid}")
         self.state = RequestState.QUEUED
         self.output_tokens: List[int] = []
@@ -91,6 +101,16 @@ class Request:
                 f"serving request {self.rid}", self.deadline.timeout,
                 detail=f"{len(self.output_tokens)} token(s) generated")
         self._done.set()
+
+    @property
+    def is_sampling(self) -> bool:
+        return self.temperature is not None
+
+    @property
+    def rng(self) -> np.random.Generator:
+        if self._rng is None:
+            self._rng = np.random.default_rng(self.seed)
+        return self._rng
 
     # ---- caller-side API ----
     @property

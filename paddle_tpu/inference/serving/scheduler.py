@@ -26,15 +26,25 @@ from .request import Request, RequestState
 
 
 class ContinuousBatchingScheduler:
-    def __init__(self, pool: KVPagePool, max_batch: int):
+    def __init__(self, pool: KVPagePool, max_batch: int,
+                 reserve_extra_tokens: int = 0):
         self.pool = pool
         self.max_batch = int(max_batch)
+        # per-request reservation padding: a speculative engine's verify
+        # window may write up to spec_k positions past the accepted cursor,
+        # so those scratch positions are reserved with the lifetime — the
+        # all-or-nothing / no-preemption contract covers them too
+        self.reserve_extra = int(reserve_extra_tokens)
         self._queue: deque[Request] = deque()
         self._running: dict[int, Request] = {}   # slot -> request
         self._free_slots = list(range(self.max_batch - 1, -1, -1))
         self._lock = threading.Lock()
         self.counters = {"submitted": 0, "admitted": 0, "finished": 0,
                          "timed_out": 0, "evicted": 0, "rejected": 0}
+
+    def _pages_needed(self, req: Request) -> int:
+        return self.pool.pages_for(
+            req.prompt.size + req.max_new_tokens + self.reserve_extra)
 
     # ---- intake ----
     def submit(self, req: Request):
@@ -47,7 +57,7 @@ class ContinuousBatchingScheduler:
         a small request behind a blocked head could pin the very pages the
         head is waiting for — with no TTL that wedges the queue forever
         (head can't alloc, reserver behind it can't join past strict FIFO)."""
-        need = self.pool.pages_for(req.prompt.size + req.max_new_tokens)
+        need = self._pages_needed(req)
         if need > self.pool.total_pages:
             with self._lock:
                 self.counters["rejected"] += 1
@@ -106,8 +116,7 @@ class ContinuousBatchingScheduler:
             while self._free_slots and self._queue:
                 head = self._queue[0]
                 if not head.pages:
-                    need = self.pool.pages_for(
-                        head.prompt.size + head.max_new_tokens)
+                    need = self._pages_needed(head)
                     try:
                         head.pages = self.pool.alloc(need)
                     except PoolExhausted:

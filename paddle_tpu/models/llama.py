@@ -339,7 +339,7 @@ class LlamaForCausalLM(Layer):
             return _capture.capture_step(step, donate=(2,))
         return jax.jit(step, donate_argnums=(2,))
 
-    def _build_slot_step(self):
+    def _build_slot_step(self, return_logits: bool = False):
         """Batch-slot serving step (inference/serving): like the cached
         generate step but with per-slot state — ``off`` is a [B] i32 vector
         (each slot decodes at its own position) and ``last_pos`` gathers the
@@ -349,7 +349,13 @@ class LlamaForCausalLM(Layer):
         [B, vocab] logits to the host every step would serialize the decode
         loop on transfer; first-max tie-break matches np.argmax, so tokens
         are bitwise the generate() oracle's). One captured lowering per
-        (batch, seq-bucket) aval signature; KV caches donated."""
+        (batch, seq-bucket) aval signature; KV caches donated.
+
+        ``return_logits=True`` additionally returns each slot's last-token
+        logits row ([B, vocab]) so the engine can run HOST-side per-slot
+        temperature/top-p sampling; the greedy argmax still comes from the
+        same on-device computation, so greedy rows in a mixed batch stay
+        bitwise the argmax-only variant's."""
         model = self
         plist = list(model.parameters())
 
@@ -366,6 +372,54 @@ class LlamaForCausalLM(Layer):
                 lv = logits._value
                 last = lv[jnp.arange(lv.shape[0]), last_pos, :]
                 nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                out_caches = [(kc._value, vc._value) for kc, vc in new_caches]
+                if return_logits:
+                    return nxt, last, out_caches
+                return nxt, out_caches
+            finally:
+                # never leak tracers into the eager Parameters
+                for p, v in zip(plist, saved):
+                    p._value = v
+
+        from ..jit import capture as _capture
+        if _capture.step_capture_enabled():
+            return _capture.capture_step(step, donate=(2,))
+        return jax.jit(step, donate_argnums=(2,))
+
+    def _build_verify_step(self):
+        """Speculative-verify step (inference/serving/speculative): scores a
+        whole [B, W] token WINDOW per slot in one call — row b holds the
+        slot's pending token followed by W-1 draft proposals, ``off`` [B] is
+        each slot's write cursor. The window rides the same per-slot offset
+        plumbing the [B, 1] slot step uses: `_rope` broadcasts the [B]
+        offset over the window positions, `kv_cache_upd` vmaps one
+        dynamic_update_slice per row at its own cursor, and the decode mask
+        lets window position i attend exactly positions <= off[b] + i — so
+        position i sees precisely the prefix a sequential decode would have
+        cached, and its argmax is bitwise the token the sequential path
+        would emit (tests/test_serving.py asserts this end to end).
+
+        Returns the per-position greedy argmax [B, W] i32 (the verify
+        targets; one host transfer per verify, not per token) and the
+        updated caches (donated). Rejected positions need no cache repair:
+        the acceptance cursor just doesn't advance past them, later writes
+        overwrite, and the ragged lengths keep them out of attention. One
+        captured lowering per (B, W) aval signature — the engine always
+        calls at [max_batch, k+1], so late joins reuse it."""
+        model = self
+        plist = list(model.parameters())
+
+        def step(param_vals, tok, caches, off):
+            saved = [p._value for p in plist]
+            try:
+                for p, v in zip(plist, param_vals):
+                    p._value = v
+                with no_grad():
+                    logits, new_caches = model.forward(
+                        Tensor(tok),
+                        caches=[(Tensor(kc), Tensor(vc)) for kc, vc in caches],
+                        position_offset=off)
+                nxt = jnp.argmax(logits._value, axis=-1).astype(jnp.int32)
                 return nxt, [(kc._value, vc._value) for kc, vc in new_caches]
             finally:
                 # never leak tracers into the eager Parameters

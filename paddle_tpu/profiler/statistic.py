@@ -92,7 +92,12 @@ def serving_summary() -> str:
     A healthy loaded engine pins `avg_occupancy` near 1.0 with
     `step.lowerings` frozen at (buckets + 1) and `step.hits` climbing;
     climbing `timed_out` means admission is outrunning capacity (grow the
-    pool / batch, or shed load by shortening TTLs)."""
+    pool / batch, or shed load by shortening TTLs). Speculative engines
+    add a `spec:` line — drafter kind, k, cumulative acceptance rate,
+    draft-vs-verify call counts, and the tokens-per-verify histogram; an
+    acceptance rate near 0 means the drafter never pays for its window
+    (turn spec off or switch drafters), tokens/verify near k+1 means the
+    workload is a speculation jackpot (consider raising k)."""
     from ..inference.serving import serving_info
 
     infos = serving_info()
@@ -117,6 +122,16 @@ def serving_summary() -> str:
             f"{pool['page_size']}, allocs={pool['allocs']} "
             f"releases={pool['releases']})",
         ]
+        spec = e.get("spec")
+        if spec:
+            drafter = spec.get("drafter") or {}
+            lines.append(
+                f"  spec: drafter={drafter.get('kind')} k={spec['k']} "
+                f"acceptance={spec['acceptance_rate']:.2f} "
+                f"tokens/verify={spec['tokens_per_verify']:.2f} "
+                f"verify_steps={spec['verify_steps']} "
+                f"draft_steps={spec['draft_steps']} "
+                f"hist={spec['tokens_per_verify_hist']}")
         if step:
             lines.append(
                 f"  step capture: lowerings={step.get('lowerings')} "

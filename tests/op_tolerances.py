@@ -36,22 +36,25 @@ FWD_OVERRIDES = {
     "expm1": {"bfloat16": (1e-1, 1e-2)},
     # reductions over n elements accumulate n roundings
     "sum": {"bfloat16": (1e-1, 5e-2), "float16": (1e-2, 2e-3)},
-    "logsumexp": {"bfloat16": (1e-1, 5e-2)},
+    # fp16 legs: same reduction-accumulation argument at fp16's 11-bit
+    # mantissa (~8x tighter than bf16, looser than the elementwise default)
+    "logsumexp": {"bfloat16": (1e-1, 5e-2), "float16": (1e-2, 2e-3)},
     "matmul": {"bfloat16": (1e-1, 5e-2), "float16": (1e-2, 2e-3)},
     "linear": {"bfloat16": (1e-1, 5e-2), "float16": (1e-2, 2e-3)},
     "conv2d": {"bfloat16": (1.5e-1, 5e-2), "float16": (2e-2, 5e-3)},
-    "einsum": {"bfloat16": (1e-1, 5e-2)},
+    "einsum": {"bfloat16": (1e-1, 5e-2), "float16": (1e-2, 2e-3)},
     "norm": {"bfloat16": (1e-1, 5e-2)},
     "std": {"bfloat16": (1.5e-1, 5e-2), "float16": (2e-2, 5e-3)},
     "var": {"bfloat16": (1.5e-1, 5e-2), "float16": (2e-2, 5e-3)},
-    # softmax family: exp + normalization; absolute scale is <= 1 so atol rules
-    "softmax": {"bfloat16": (1e-1, 2e-2)},
-    "log_softmax": {"bfloat16": (1e-1, 5e-2)},
-    "cross_entropy": {"bfloat16": (1e-1, 5e-2)},
+    # softmax family: exp + normalization; absolute scale is <= 1 so atol
+    # rules (fp16 legs: the same exp/normalization rounding, ~8x tighter)
+    "softmax": {"bfloat16": (1e-1, 2e-2), "float16": (1e-2, 2e-3)},
+    "log_softmax": {"bfloat16": (1e-1, 5e-2), "float16": (1e-2, 2e-3)},
+    "cross_entropy": {"bfloat16": (1e-1, 5e-2), "float16": (1e-2, 2e-3)},
     "sdpa": {"bfloat16": (1.5e-1, 5e-2), "float16": (2e-2, 5e-3)},
     # normalizations divide by a reduced statistic
     "layer_norm": {"bfloat16": (1.5e-1, 5e-2), "float16": (2e-2, 5e-3)},
-    "rms_norm": {"bfloat16": (1.5e-1, 5e-2)},
+    "rms_norm": {"bfloat16": (1.5e-1, 5e-2), "float16": (2e-2, 5e-3)},
     "batch_norm": {"bfloat16": (1.5e-1, 5e-2), "float16": (2e-2, 5e-3)},
     "group_norm": {"bfloat16": (1.5e-1, 5e-2), "float16": (2e-2, 5e-3)},
     "instance_norm": {"bfloat16": (1.5e-1, 5e-2), "float16": (2e-2, 5e-3)},
@@ -67,25 +70,30 @@ FWD_OVERRIDES = {
 
 GRAD_OVERRIDES = {
     # grad of matmul is another matmul: same accumulation as forward
-    "matmul": {"bfloat16": (2e-1, 1e-1)},
-    "linear": {"bfloat16": (2e-1, 1e-1)},
+    # (fp16 legs follow conv2d's bf16->fp16 scaling: ~5x tighter rtol)
+    "matmul": {"bfloat16": (2e-1, 1e-1), "float16": (5e-2, 1e-2)},
+    "linear": {"bfloat16": (2e-1, 1e-1), "float16": (5e-2, 1e-2)},
     "conv2d": {"bfloat16": (2.5e-1, 1e-1), "float16": (5e-2, 1e-2)},
-    "einsum": {"bfloat16": (2e-1, 1e-1)},
+    "einsum": {"bfloat16": (2e-1, 1e-1), "float16": (5e-2, 1e-2)},
     "sdpa": {"bfloat16": (2.5e-1, 1e-1), "float16": (5e-2, 1e-2)},
     "layer_norm": {"bfloat16": (2.5e-1, 1e-1), "float16": (5e-2, 1e-2)},
-    "rms_norm": {"bfloat16": (2.5e-1, 1e-1)},
+    "rms_norm": {"bfloat16": (2.5e-1, 1e-1), "float16": (5e-2, 1e-2)},
     "group_norm": {"bfloat16": (2.5e-1, 1e-1), "float16": (5e-2, 1e-2)},
     "instance_norm": {"bfloat16": (2.5e-1, 1e-1), "float16": (5e-2, 1e-2)},
     "batch_norm": {"bfloat16": (2.5e-1, 1e-1), "float16": (5e-2, 1e-2)},
-    "softmax": {"bfloat16": (2e-1, 5e-2)},
-    "log_softmax": {"bfloat16": (2e-1, 1e-1)},
-    "cross_entropy": {"bfloat16": (2e-1, 1e-1)},
-    "logsumexp": {"bfloat16": (2e-1, 1e-1)},
+    # softmax-family grads chain the forward's exp rounding (fp16 ~5x
+    # tighter than bf16, looser than the elementwise default)
+    "softmax": {"bfloat16": (2e-1, 5e-2), "float16": (5e-2, 1e-2)},
+    "log_softmax": {"bfloat16": (2e-1, 1e-1), "float16": (5e-2, 1e-2)},
+    "cross_entropy": {"bfloat16": (2e-1, 1e-1), "float16": (5e-2, 1e-2)},
+    "logsumexp": {"bfloat16": (2e-1, 1e-1), "float16": (5e-2, 1e-2)},
     "tan": {"bfloat16": (3e-1, 1e-1)},
     "pow": {"bfloat16": (2e-1, 1e-1)},
     "sqrt": {"bfloat16": (2e-1, 5e-2)},    # d/dx = 1/(2 sqrt x): blows up near 0
     "rsqrt": {"bfloat16": (2e-1, 1e-1)},
-    "erf": {"float16": (5e-2, 1e-2)},
+    # erf: the missing bf16 leg IS the default — recorded explicitly so the
+    # entry covers every swept dtype (dtype-rule-coverage)
+    "erf": {"bfloat16": (1.5e-1, 5e-2), "float16": (5e-2, 1e-2)},
     "gelu": {"bfloat16": (2e-1, 1e-1)},
     "silu": {"bfloat16": (2e-1, 5e-2)},
     "mish": {"bfloat16": (2e-1, 1e-1)},

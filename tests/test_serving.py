@@ -168,25 +168,75 @@ def test_oversized_request_rejected_typed():
 
 
 def test_unsupported_sampling_params_rejected_typed():
-    """The greedy-only engine must REJECT real sampling asks up front with
-    the typed SamplingUnsupported (NotImplementedError family) instead of
-    silently decoding greedy — closing the 'rejects nothing on
-    temperature' debt. Greedy-equivalent spellings stay accepted."""
+    """Asks the engine cannot honor stay TYPED rejections (never silently
+    greedy): top_p without a positive temperature has no distribution to
+    draw from, and a SPECULATIVE engine is greedy-only by construction
+    (greedy acceptance is the exactness argument). Greedy-equivalent
+    spellings stay accepted everywhere."""
     from paddle_tpu.inference.serving import SamplingUnsupported
 
     m = _model(seed=23)
     eng = ServingEngine(m, max_batch=2, max_seq_len=32)
-    with pytest.raises(SamplingUnsupported, match="temperature"):
-        eng.submit(_prompt(4), max_new_tokens=2, temperature=0.8)
     with pytest.raises(NotImplementedError, match="top_p"):
         eng.submit(_prompt(4), max_new_tokens=2, top_p=0.9)
-    assert eng.info()["rejected"] == 2
+    assert eng.info()["rejected"] == 1
+    # invalid VALUES are typed rejections too, not silently-served nonsense:
+    # a negative temperature would invert the distribution, top_p outside
+    # (0, 1] has no nucleus, non-finite values poison the softmax
+    with pytest.raises(SamplingUnsupported, match="finite"):
+        eng.submit(_prompt(4), max_new_tokens=2, temperature=-1.0)
+    with pytest.raises(SamplingUnsupported, match="top_p"):
+        eng.submit(_prompt(4), max_new_tokens=2, temperature=0.5, top_p=0.0)
+    with pytest.raises(SamplingUnsupported, match="top_p"):
+        eng.submit(_prompt(4), max_new_tokens=2, temperature=0.5, top_p=1.5)
+    with pytest.raises(SamplingUnsupported, match="finite"):
+        eng.submit(_prompt(4), max_new_tokens=2,
+                   temperature=float("nan"))
+    assert eng.info()["rejected"] == 5
     # temperature=0 / top_p=1 ARE greedy: accepted and served
     r = eng.submit(_prompt(4), max_new_tokens=2, temperature=0.0, top_p=1.0)
     eng.run()
     assert r.result().size == 6
     # a rejected request never touched the pool
     assert eng.pool.info()["active_pages"] == 0
+
+    spec = ServingEngine(m, max_batch=2, max_seq_len=32, spec_k=2)
+    with pytest.raises(SamplingUnsupported, match="SPECULATIVELY"):
+        spec.submit(_prompt(4), max_new_tokens=2, temperature=0.8)
+    with pytest.raises(SamplingUnsupported, match="top_p"):
+        spec.submit(_prompt(4), max_new_tokens=2, top_p=0.9)
+    assert spec.info()["rejected"] == 2
+    rg = spec.submit(_prompt(4), max_new_tokens=2, temperature=0.0, top_p=1.0)
+    spec.run()
+    assert rg.result().size == 6
+
+
+def test_per_slot_sampling_greedy_rows_bitwise():
+    """Per-slot temperature/top-p sampling (the retired blanket
+    SamplingUnsupported): a sampled slot decodes host-side off its logits
+    row while greedy neighbors in the SAME batch stay bitwise the
+    sequential oracle — and a sampled stream is reproducible per seed."""
+    m = _model(seed=47)
+    pg, ps = _prompt(5, seed=70), _prompt(7, seed=71)
+    oracle = np.asarray(
+        m.generate(P.to_tensor(pg.reshape(1, -1)), max_new_tokens=8).numpy())[0]
+    greedy_s = np.asarray(
+        m.generate(P.to_tensor(ps.reshape(1, -1)), max_new_tokens=8).numpy())[0]
+
+    eng = ServingEngine(m, max_batch=3, max_seq_len=64)
+    rg = eng.submit(pg, max_new_tokens=8)
+    r1 = eng.submit(ps, max_new_tokens=8, temperature=0.8, top_p=0.9,
+                    seed=123)
+    r2 = eng.submit(ps, max_new_tokens=8, temperature=0.8, top_p=0.9,
+                    seed=123)
+    eng.run()
+    np.testing.assert_array_equal(rg.result(), oracle)  # bitwise, mixed batch
+    np.testing.assert_array_equal(r1.result(), r2.result())  # same seed
+    assert not np.array_equal(r1.result(), greedy_s), \
+        "temperature=0.8 stream should not be the greedy stream"
+    info = eng.info()
+    assert info["sampled_tokens"] == 16
+    assert info["finished"] == 3 and info["pool"]["active_pages"] == 0
 
 
 def test_behind_head_reservation_cannot_wedge_fifo():
@@ -387,3 +437,194 @@ def test_serving_summary_renders_counters():
     assert info["tokens_generated"] == 8
     assert info["step"]["lowerings"] >= 2  # prefill bucket(s) + decode
     del eng  # engines are weakly registered; drop for other tests
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: propose-k draft, single-call batch-slot verify
+# ---------------------------------------------------------------------------
+
+def _draft_model(seed=99, vocab=64):
+    P.seed(seed)
+    cfg = LlamaConfig.tiny(vocab=vocab, hidden=16, layers=1, heads=2,
+                           inter=32, seq=64)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.mark.parametrize("drafter", ["ngram", "model"])
+def test_speculative_output_bitwise_identical(drafter):
+    """THE speculative contract: greedy output is bitwise the
+    non-speculative engine's (itself pinned to sequential generate()) on
+    mixed prompt lengths, for BOTH drafter backends — the drafter is pure
+    opportunity, never correctness. The verify executable lowers exactly
+    once for the fixed [max_batch, k+1] signature."""
+    m = _model(seed=53)
+    prompts = [_prompt(5, seed=80), _prompt(8, seed=81), _prompt(11, seed=82)]
+    oracle = [np.asarray(
+        m.generate(P.to_tensor(p.reshape(1, -1)), max_new_tokens=9).numpy())[0]
+        for p in prompts]
+    base = ServingEngine(m, max_batch=4, max_seq_len=64, page_size=8)
+    base_outs = base.generate(prompts, max_new_tokens=9)
+    for o, e in zip(oracle, base_outs):
+        np.testing.assert_array_equal(o, e)
+
+    kw = {"draft_model": _draft_model()} if drafter == "model" else {}
+    spec = ServingEngine(m, max_batch=4, max_seq_len=64, page_size=8,
+                         spec_k=3, drafter=drafter, **kw)
+    spec_outs = spec.generate(prompts, max_new_tokens=9)
+    for o, e in zip(base_outs, spec_outs):
+        np.testing.assert_array_equal(o, e)
+    info = spec.info()
+    assert info["spec"]["k"] == 3
+    assert info["spec"]["drafter"]["kind"] == drafter
+    assert info["spec"]["verify"]["lowerings"] == 1, \
+        "one verify lowering per (max_batch, k+1) signature"
+    assert info["spec"]["verify_steps"] > 0
+    # every verify emits >= 1 token per served slot (the bonus token)
+    assert info["spec"]["tokens_per_verify"] >= 1.0
+    assert info["pool"]["active_pages"] == 0
+
+
+def test_speculative_eos_matches_oracle():
+    """EOS inside an accepted window must stop the request exactly where
+    the sequential path stops (the EOS is kept, later accepted tokens are
+    discarded by the emission cap)."""
+    m = _model(seed=11)
+    p = _prompt(6, seed=4)
+    base = np.asarray(
+        m.generate(P.to_tensor(p.reshape(1, -1)), max_new_tokens=8).numpy())[0]
+    eos = int(base[6 + 2])  # the 3rd generated token, forced to be "EOS"
+    eng = ServingEngine(m, max_batch=2, max_seq_len=64, eos_token_id=eos,
+                        spec_k=4)
+    req = eng.submit(p, max_new_tokens=8)
+    eng.run()
+    out = req.result()
+    assert req.finish_reason == "eos"
+    assert out.size == 6 + 3 and out[-1] == eos
+    np.testing.assert_array_equal(out, base[:9])
+
+
+def test_spec_late_join_changes_nothing_inflight():
+    """The PR 7 join contract survives speculation: a request joining while
+    A speculates mid-stream changes NEITHER A's tokens (bitwise) NOR any
+    lowering count — the verify signature is pinned at [max_batch, k+1]."""
+    m = _model(seed=59)
+    pa, pb = _prompt(5, seed=85), _prompt(7, seed=86)  # same bucket (8)
+
+    solo = ServingEngine(m, max_batch=4, max_seq_len=64, spec_k=2)
+    ra_solo = solo.submit(pa, max_new_tokens=12)
+    solo.run()
+    solo_tokens = list(ra_solo.output_tokens)
+
+    eng = ServingEngine(m, max_batch=4, max_seq_len=64, spec_k=2)
+    ra = eng.submit(pa, max_new_tokens=12)
+    eng.step()
+    eng.step()
+    assert 1 < len(ra.output_tokens) < 12  # genuinely mid-stream
+    step_before = eng.info()["step"]["lowerings"]
+    verify_before = eng.info()["spec"]["verify"]["lowerings"]
+    rb = eng.submit(pb, max_new_tokens=6)
+    eng.run()
+    assert eng.info()["step"]["lowerings"] == step_before
+    assert eng.info()["spec"]["verify"]["lowerings"] == verify_before, \
+        "a join must not add a verify lowering"
+    assert list(ra.output_tokens) == solo_tokens, \
+        "a late joiner perturbed an in-flight speculative request"
+    assert rb.state is RequestState.FINISHED and len(rb.output_tokens) == 6
+
+
+def test_spec_eviction_with_inflight_drafts_returns_pages():
+    """Regression (ISSUE 9 satellite): a queued request expiring
+    (RequestTimeout) and a mid-decode TTL eviction while the slot holds
+    in-flight draft state must return every page, drop the drafter's
+    per-request state, and leave the verify signature's lowering count
+    unchanged — rejection really is cursor arithmetic, no pool churn."""
+    m = _model(seed=61)
+    eng = ServingEngine(m, max_batch=1, max_seq_len=64, page_size=16,
+                        spec_k=3)
+    ra = eng.submit(_prompt(4, seed=90), max_new_tokens=30)  # holds the slot
+    eng.step()
+    assert ra.state is RequestState.DECODING
+    assert eng.drafter._idx, "drafter holds in-flight state for A"
+    pages_a = eng.pool.info()["active_pages"]
+    verify_before = eng.info()["spec"]["verify"]["lowerings"]
+
+    # 1. queued request expires -> typed RequestTimeout, reservation back
+    rb = eng.submit(_prompt(4, seed=91), max_new_tokens=8, ttl=0.02)
+    assert eng.pool.info()["active_pages"] > pages_a  # B reserved queued
+    time.sleep(0.05)
+    eng.step()
+    assert rb.state is RequestState.TIMED_OUT
+    with pytest.raises(RequestTimeout):
+        rb.result()
+    assert eng.pool.info()["active_pages"] == pages_a
+
+    # 2. A itself expires MID-DECODE with draft state in flight
+    ra.deadline = type(ra.deadline)(0.0, what="expired now")
+    time.sleep(0.01)
+    eng.step()   # eviction pass sees the expired deadline
+    assert ra.state is RequestState.TIMED_OUT
+    assert len(ra.output_tokens) > 0          # partial output preserved
+    assert eng.pool.info()["active_pages"] == 0
+    assert not eng.drafter._idx, "evicted request's drafter state leaked"
+
+    # 3. the slot serves the next request; no signature ever re-lowered
+    rc = eng.submit(_prompt(5, seed=92), max_new_tokens=4)
+    eng.run()
+    assert rc.state is RequestState.FINISHED and len(rc.output_tokens) == 4
+    assert eng.info()["spec"]["verify"]["lowerings"] == verify_before
+
+
+def test_spec_capacity_guard_includes_verify_scratch():
+    """A request whose prompt+max_new+k cannot fit the static layout is a
+    typed sizing error up front (the verify window may write k positions
+    past the accepted cursor, so those are part of the ask)."""
+    m = _model(seed=67)
+    eng = ServingEngine(m, max_batch=2, max_seq_len=32, spec_k=4)
+    with pytest.raises(ValueError, match="verify scratch"):
+        eng.submit(_prompt(20), max_new_tokens=10)   # 20+10+4 > 32
+    # the same ask fits a non-speculative engine
+    eng2 = ServingEngine(m, max_batch=2, max_seq_len=32)
+    r = eng2.submit(_prompt(20), max_new_tokens=10)
+    eng2.run()
+    assert r.result().size == 30
+
+
+def test_ngram_drafter_unit():
+    """Prompt-lookup mechanics: longest-suffix match replays its
+    continuation, the self-match falls back to the previous occurrence,
+    no-match falls back to repeat-last, proposals are exactly k."""
+    from paddle_tpu.inference.serving import NGramDrafter
+
+    class R:  # minimal request stand-in
+        rid, prompt, output_tokens = 7, np.asarray([1, 2, 3, 1, 2]), []
+
+    d = NGramDrafter(max_n=3)
+    d.on_join(R)
+    # suffix (1, 2) last occurred at the start -> continuation is 3, 1, 2
+    assert d.propose({0: R}, 3) == {0: [3, 1, 2]}
+    # observe new tokens; suffix (9,) has no earlier occurrence -> repeat
+    R.output_tokens = [9]
+    d.observe(R, 1)
+    assert d.propose({0: R}, 2) == {0: [9, 9]}
+    d.on_evict(R)
+    assert not d._idx
+
+
+def test_spec_summary_renders_acceptance():
+    from paddle_tpu import profiler
+    m = _model(seed=71)
+    eng = ServingEngine(m, max_batch=2, max_seq_len=32, spec_k=2)
+    eng.generate([_prompt(4, seed=95), _prompt(6, seed=96)],
+                 max_new_tokens=6)
+    text = profiler.serving_summary()
+    assert "spec: drafter=ngram k=2" in text
+    assert "acceptance=" in text and "tokens/verify=" in text
+    info = eng.info()["spec"]
+    assert info["draft_tokens_proposed"] > 0
+    # the default n-gram drafter counts propose() calls so the advertised
+    # draft-vs-verify diagnostic is live, not a hard-wired 0
+    assert info["draft_steps"] > 0
+    assert 0.0 <= info["acceptance_rate"] <= 1.0
+    hist = info["tokens_per_verify_hist"]
+    assert len(hist) == 4 and sum(hist) > 0   # emitted 1..k+1 per slot
+    del eng
