@@ -217,22 +217,33 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _axis_of(group)
     if axis is None:
         return _Task(tensor)  # single device / no mesh: identity
-    red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
-           ReduceOp.MIN: jax.lax.pmin,
-           ReduceOp.AVG: lambda v, a: jax.lax.pmean(v, a)}.get(op, jax.lax.psum)
+    # routed through the comms subsystem (distributed/comms): the call is
+    # recorded (owner/bytes/deadline) and rides the quantized wire when
+    # comms.quantized() is active and the reduction is eligible
+    red_op = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max", ReduceOp.MIN: "min",
+              ReduceOp.AVG: "avg"}.get(op, "sum")
+    from . import comms as _comms
     if _in_shard_map(axis):
-        out = apply(lambda v: red(v, axis), tensor, op_name="all_reduce")
+        out = apply(lambda v: _comms.wire_all_reduce(
+            v, axis, red_op, owner="collective.all_reduce"),
+            tensor, op_name="all_reduce")
         _update_inplace(tensor, out)
         return _Task(tensor)
-    # global view: psum over the axis via a pass-through shard_map
+    # global view: reduce over the axis via a pass-through shard_map
     _check_replicated(tensor, axis, "all_reduce")
     mesh = mesh_mod.get_mesh()
     axes = axis if isinstance(axis, tuple) else (axis,)
 
     def f(v):
         spec = _replicated_spec(v.ndim)
-        fn = jax.shard_map(lambda x: red(x, axes),
-                           mesh=mesh, in_specs=spec, out_specs=spec)
+        # check_vma=False: the quantized two-shot body (all_to_all +
+        # all_gather) defeats shard_map's replication inference even
+        # though the result IS replicated — same setting api._shard_map
+        # uses (a bare psum happened to pass the check; the routed body
+        # must disable it explicitly)
+        fn = jax.shard_map(lambda x: _comms.wire_all_reduce(
+            x, axes, red_op, owner="collective.all_reduce"),
+            mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
         return fn(v)
     # On a replicated global array every shard is identical: psum multiplies by
     # the axis size — matching per-rank all_reduce semantics.
@@ -255,8 +266,10 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis_concat=0):
             return _Task(tensor)
         return tensor
     if _in_shard_map(axis):
-        gathered = apply(lambda v: jax.lax.all_gather(v, axis), tensor,
-                         op_name="all_gather")
+        from . import comms as _comms
+        gathered = apply(lambda v: _comms.wire_all_gather(
+            v, axis, owner="collective.all_gather"), tensor,
+            op_name="all_gather")
         if isinstance(tensor_list, list):
             from ..ops.manip import unbind
             tensor_list.extend(unbind(gathered, 0))
@@ -310,7 +323,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
         tensor._set_value(_u(src))
         return _Task(tensor)
     if _in_shard_map(axis):
-        out = apply(lambda v: jax.lax.psum_scatter(v, axis, scatter_dimension=0,
+        out = apply(lambda v: jax.lax.psum_scatter(v, axis, scatter_dimension=0,  # staticcheck: ok[naked-collective] — p2p/permute edge: exactness-critical by contract, never quantized
                                                    tiled=True),
                     src, op_name="reduce_scatter")
         _update_inplace(tensor, out)
@@ -430,7 +443,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         return _Task(in_tensor_list[0] if in_tensor_list else None)
     stacked = stack_op(list(in_tensor_list), axis=0)
     if _in_shard_map(axis):
-        out = apply(lambda v: jax.lax.all_to_all(v, axis, split_axis=0,
+        out = apply(lambda v: jax.lax.all_to_all(v, axis, split_axis=0,  # staticcheck: ok[naked-collective] — p2p/permute edge: exactness-critical by contract, never quantized
                                                  concat_axis=0, tiled=False),
                     stacked, op_name="all_to_all")
         out_tensor_list.extend(unbind(out, 0))
@@ -454,7 +467,7 @@ def _shift(tensor, axis, offset):
     def f(v):
         n = jax.lax.axis_size(axis)
         perm = [(i, (i + offset) % n) for i in range(n)]
-        return jax.lax.ppermute(v, axis, perm)
+        return jax.lax.ppermute(v, axis, perm)  # staticcheck: ok[naked-collective] — p2p/permute edge: exactness-critical by contract, never quantized
     return apply(f, tensor, op_name="ppermute")
 
 
@@ -493,7 +506,7 @@ def _peer_list(peer, n):
 def _from_src(v, ax, src_i):
     """Every rank receives rank `src_i`'s value (multicast / broadcast-from)."""
     idx = jax.lax.axis_index(ax)
-    return jax.lax.psum(jnp.where(idx == src_i, v, jnp.zeros_like(v)), ax)
+    return jax.lax.psum(jnp.where(idx == src_i, v, jnp.zeros_like(v)), ax)  # staticcheck: ok[naked-collective] — p2p/permute edge: exactness-critical by contract, never quantized
 
 
 def _update_inplace(tensor, out):
@@ -532,7 +545,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
         if sorted(m) != list(range(n)):
             raise ValueError(f"send dst mapping {m} is not a permutation")
         perm = [(i, m[i]) for i in range(n)]
-    out = apply(lambda v: jax.lax.ppermute(v, ax, perm), tensor, op_name="send")
+    out = apply(lambda v: jax.lax.ppermute(v, ax, perm), tensor, op_name="send")  # staticcheck: ok[naked-collective] — p2p/permute edge: exactness-critical by contract, never quantized
     return _Task(out)
 
 
@@ -559,13 +572,13 @@ def recv(tensor, src=0, group=None, sync_op=True):
         out = apply(lambda v: _from_src(v, ax, src_i), tensor, op_name="recv")
     elif sorted(m) == list(range(n)):
         perm = [(m[j], j) for j in range(n)]
-        out = apply(lambda v: jax.lax.ppermute(v, ax, perm), tensor,
+        out = apply(lambda v: jax.lax.ppermute(v, ax, perm), tensor,  # staticcheck: ok[naked-collective] — p2p/permute edge: exactness-critical by contract, never quantized
                     op_name="recv")
     else:
         src_map = jnp.asarray(m)
 
         def f(v):
-            g = jax.lax.all_gather(v, ax)
+            g = jax.lax.all_gather(v, ax)  # staticcheck: ok[naked-collective] — p2p/permute edge: exactness-critical by contract, never quantized
             return g[src_map[jax.lax.axis_index(ax)]]  # staticcheck: ok[closure-capture] — static rank->src routing table, identical on every call
         out = apply(f, tensor, op_name="recv")
     _update_inplace(tensor, out)

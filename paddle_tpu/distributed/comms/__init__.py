@@ -1,0 +1,30 @@
+"""paddle_tpu.distributed.comms — quantized + schedule-aware collectives.
+
+The communication subsystem every framework collective routes through
+(ROADMAP "Quantized + schedule-aware collectives"):
+
+- :mod:`.quantize` — the blockwise int8/fp8 wire format (per-block scale,
+  stochastic-rounding option, inf/nan guard) à la EQuARX.
+- :mod:`.api` — the opt-in ``quantized()`` context, the EQuARX two-shot
+  all-reduce / quantized all-gather, the trainer's ``grad_sync`` hook,
+  chaos faultpoints ``comm.quantize/collective/dequant`` and the
+  PT_COMM_DEADLINE -> ``CommTimeout`` no-hang story.
+- :mod:`.schedule` — CommOp/CommSchedule records (owner, logical vs wire
+  bytes, deadline, overlap slot) feeding ``profiler.comm_summary()``; the
+  capture-tier pass (jit/passes/comm_schedule.py) tags and slots the
+  collective equations of captured step programs.
+
+See README "Quantized collectives & comm schedules".
+"""
+from .api import (  # noqa: F401
+    comm_deadline, comms_cache_key, grad_sync, quant_state, quantized,
+    quantized_all_reduce, wire_all_gather, wire_all_reduce,
+)
+from .quantize import (  # noqa: F401
+    DEFAULT_BLOCK, dequantize_blockwise, logical_bytes, quantize_blockwise,
+    wire_bytes,
+)
+from .schedule import (  # noqa: F401
+    CommOp, CommSchedule, comm_clear, comm_info, current_schedule, record,
+    step_schedule,
+)

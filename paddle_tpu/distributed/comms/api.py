@@ -1,0 +1,420 @@
+"""Quantized + schedule-aware collectives: the routed comms layer.
+
+Every framework collective is supposed to pass through here (the
+``naked-collective`` staticcheck rule enforces it): the call gets a
+:class:`~.schedule.CommOp` record (owner, axis, logical vs wire bytes,
+deadline, slot), and — when the opt-in context is active — the eligible
+reductions ride the EQuARX-style quantized wire format instead of
+full precision.
+
+The context (AMP-idiom, thread-local)::
+
+    with comms.quantized(dtype="int8"):          # or "fp8"
+        step = compile_train_step(model, loss_fn, opt, mesh=mesh)
+        step(batch)        # dp gradient sync moves int8 + scales
+
+Like amp.auto_cast, the context is consulted at TRACE time: wrap the
+step's construction (first call), not each invocation.  A captured step
+built with the context off is **bitwise identical** to one built before
+this subsystem existed — the off path adds zero equations.  Exactness-
+critical traffic (checkpoint, reshard, p2p pipeline edges) passes
+``exact=True`` and never quantizes regardless of the context.
+
+Quantized all-reduce is the EQuARX two-shot decomposition: quantize ->
+all_to_all the per-rank chunks (shot 1, wire = int8/fp8 payload + fp32
+per-block scales) -> dequantize + reduce in fp32 -> requantize ->
+all_gather (shot 2, same wire format) -> dequantize.  Reducing in fp32
+between the shots means quantization error does not compound with ranks.
+
+Every phase is named for chaos (``comm.quantize`` / ``comm.collective``
+/ ``comm.dequant`` — the no-hang matrix arms each) and runs under one
+cumulative Deadline (PT_COMM_DEADLINE) that converts a stall into a typed
+:class:`CommTimeout`.  A dropped wire (ConnectionError) is retried once.
+Scope: the phases guard the host-side ISSUE path (per eager call; once
+per lowering for a captured step) — a peer failing during the execution
+of an already-compiled program is bounded by the elastic liveness layer,
+not by this deadline.
+
+Env knobs:
+- ``PT_COMM_QUANT``    default wire dtype for ``quantized()`` entered with
+  no argument ("int8"/"fp8"; also lets ops tooling force the context's
+  default — the context itself stays opt-in).
+- ``PT_COMM_BLOCK``    quantization block size (default 256 elements).
+- ``PT_COMM_DEADLINE`` per-collective budget in seconds (default 60).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.deadline import CommTimeout, Deadline, env_timeout
+from ..chaos import faultpoint, register_fault
+from . import quantize as Q
+from .schedule import CommOp, record
+
+__all__ = [
+    "quantized", "quant_state", "comms_cache_key", "comm_deadline",
+    "grad_sync", "quantized_all_reduce", "wire_all_reduce",
+    "wire_all_gather",
+]
+
+# chaos sites — registered at import so the fault matrix enumerates them
+SITE_QUANTIZE = register_fault(
+    "comm.quantize", "blockwise quantization of a collective's payload")
+SITE_COLLECTIVE = register_fault(
+    "comm.collective", "the wire passes of a quantized/scheduled collective")
+SITE_DEQUANT = register_fault(
+    "comm.dequant", "dequantization of a collective's received payload")
+
+
+def comm_deadline() -> float:
+    return env_timeout("PT_COMM_DEADLINE", 60.0)
+
+
+def _default_block() -> int:
+    from ...utils.deadline import env_int
+    return env_int("PT_COMM_BLOCK", Q.DEFAULT_BLOCK)
+
+
+class _QuantState(threading.local):
+    def __init__(self):
+        self.dtype: Optional[str] = None     # None = exact (the default)
+        self.block: int = _default_block()
+        self.stochastic: bool = False
+
+
+_state = _QuantState()
+
+
+def quant_state() -> _QuantState:
+    return _state
+
+
+def comms_cache_key():
+    """Hashable token of the comms regime a compiled program bakes in —
+    the compile-tier cache-key component beside amp_cache_key: a step
+    captured with the context OFF must not serve a call made with it ON
+    (and vice versa); each regime gets its own lowering, once."""
+    if _state.dtype is None:
+        return False
+    return (_state.dtype, _state.block, _state.stochastic)
+
+
+@contextmanager
+def quantized(dtype: Optional[str] = None, block: Optional[int] = None,
+              stochastic: bool = False):
+    """Opt into the quantized wire format for eligible collectives traced
+    inside the context.  ``dtype`` defaults to PT_COMM_QUANT (or int8)."""
+    if dtype is None:
+        dtype = os.environ.get("PT_COMM_QUANT", "").strip() or "int8"
+    if dtype not in Q.WIRE_DTYPES:
+        raise ValueError(
+            f"comms.quantized: unknown wire dtype {dtype!r} "
+            f"(pick from {Q.WIRE_DTYPES})")
+    Q._wire_dtype(dtype)  # fail fast when fp8 is unavailable on this jax
+    if stochastic and dtype != "int8":
+        raise ValueError(
+            "stochastic rounding is int8-only (uniform grid); "
+            "fp8+stochastic would bias the rounding — see comms/quantize.py")
+    prev = (_state.dtype, _state.block, _state.stochastic)
+    _state.dtype = dtype
+    _state.block = int(block) if block else _default_block()
+    _state.stochastic = bool(stochastic)
+    try:
+        yield _state
+    finally:
+        _state.dtype, _state.block, _state.stochastic = prev
+
+
+# ---------------------------------------------------------------------------
+# phase runner: chaos + deadline + drop-retry, shared by every collective
+# ---------------------------------------------------------------------------
+
+def _phase(site: str, dl: Deadline, owner: str) -> None:
+    """One named phase: the armed fault fires here (host-side, at trace
+    time — the eager path hits it per call, a captured step once per
+    lowering).  A dropped wire is retried once; a stall (delay mode, or a
+    genuinely slow peer) becomes the typed CommTimeout when the cumulative
+    budget is gone."""
+    try:
+        faultpoint(site)
+    except ConnectionError:
+        faultpoint(site)  # retry once: a transient wire death is absorbed
+    dl.check(f"{site} ({owner})", exc=CommTimeout)
+
+
+def _deadline(owner: str, budget: Optional[float]) -> Deadline:
+    return Deadline(budget if budget is not None else comm_deadline(),
+                    what=f"comms:{owner}")
+
+
+# ---------------------------------------------------------------------------
+# shard_map compat (jax>=0.7 jax.shard_map vs 0.4 experimental)
+# ---------------------------------------------------------------------------
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """Fully manual over every mesh axis with replicated specs — the same
+    global-view pattern distributed/collective.py uses.  jax.shard_map is
+    native on >=0.7 and the package __init__ installs the translating shim
+    on the 0.4 line, so this spelling works on both."""
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def _axis_size(axis) -> int:
+    """Static size of a BOUND named axis (inside shard_map), across jax
+    versions (lax.axis_size is newer than the 0.4 line; axis_frame is the
+    stable-in-practice fallback there).  Falls back to the global mesh for
+    an axis the trace hasn't bound."""
+    try:
+        # native on jax>=0.7; the package shim provides it on the 0.4 line
+        return int(jax.lax.axis_size(axis))
+    except Exception:  # noqa: BLE001 — not bound: use the mesh extent
+        from ...parallel import mesh as mesh_mod
+        return mesh_mod.mesh_axis_size(axis)
+
+
+# ---------------------------------------------------------------------------
+# the quantized kernels (pure jax; run inside shard_map with `axis` bound)
+# ---------------------------------------------------------------------------
+
+def _two_shot_bound(v, axis: str, op: str, wire_dtype: str, block: int):
+    """EQuARX two-shot all-reduce over bound mesh axis `axis`:
+    reduce-scatter (as quantized all_to_all + fp32 reduce) then quantized
+    all-gather.  Returns an array of v's shape/dtype on every rank."""
+    n = _axis_size(axis)
+    shape, dtype = v.shape, v.dtype
+    flat = jnp.ravel(v).astype(jnp.float32)
+    size = flat.shape[0]
+    # pad so the block count divides n: every rank owns an equal chunk of
+    # whole blocks (scales never straddle ranks)
+    nb = Q.n_blocks(size, block)
+    nb_pad = -(-nb // n) * n
+    pad = nb_pad * block - size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+
+    # shot 1: quantize once, scatter chunk j to rank j
+    q, s = Q.quantize_blockwise(flat, wire_dtype, block)
+    qx = jax.lax.all_to_all(q.reshape(n, -1), axis, split_axis=0,  # staticcheck: ok[naked-collective] — this IS the comms wire layer
+                            concat_axis=0, tiled=False)
+    sx = jax.lax.all_to_all(s.reshape(n, -1), axis, split_axis=0,  # staticcheck: ok[naked-collective] — this IS the comms wire layer
+                            concat_axis=0, tiled=False)
+    # dequantize every peer's contribution, reduce in fp32
+    per_blocks = nb_pad // n
+    vals = qx.astype(jnp.float32).reshape(n, per_blocks, block) \
+        * sx.reshape(n, per_blocks, 1)
+    red = jnp.sum(vals, axis=0)
+    if op == "avg":
+        red = red / n
+    red = red.reshape(per_blocks * block)
+
+    # shot 2: requantize the reduced chunk, gather all chunks
+    q2, s2 = Q.quantize_blockwise(red, wire_dtype, block)
+    qg = jax.lax.all_gather(q2, axis)  # staticcheck: ok[naked-collective] — this IS the comms wire layer
+    sg = jax.lax.all_gather(s2, axis)  # staticcheck: ok[naked-collective] — this IS the comms wire layer
+    full = qg.astype(jnp.float32).reshape(n, per_blocks, block) \
+        * sg.reshape(n, per_blocks, 1)
+    return full.reshape(nb_pad * block)[:size].reshape(shape).astype(dtype)
+
+
+_LAX_RED = {
+    "sum": jax.lax.psum,       # staticcheck: ok[naked-collective] — the comms layer's own exact path
+    "avg": jax.lax.pmean,      # staticcheck: ok[naked-collective] — the comms layer's own exact path
+    "max": jax.lax.pmax,       # staticcheck: ok[naked-collective] — the comms layer's own exact path
+    "min": jax.lax.pmin,       # staticcheck: ok[naked-collective] — the comms layer's own exact path
+}
+
+
+def _quant_eligible(v, op: str, axis, exact: bool) -> bool:
+    if exact or _state.dtype is None:
+        return False
+    if op not in ("sum", "avg"):
+        return False
+    if isinstance(axis, (tuple, list)) and len(axis) > 1:
+        return False  # two-shot rides one axis; multi-axis groups stay exact
+    return jnp.issubdtype(jnp.result_type(v), jnp.floating)
+
+
+def _record(owner, kind, axis, v, volume, quantized_dt, dl, block, n=1):
+    """CommOp record for one issued collective.  `volume` is the per-device
+    wire multiplier in units of the payload: an n-rank two-shot all-reduce
+    moves 2*(n-1)/n payloads, an all-gather receives (n-1).  Quantized
+    wire bytes are computed from the PADDED payload the kernel actually
+    moves (the two-shot pads to n-divisible whole blocks), so tiny leaves
+    honestly show compression < 1 instead of flattering the headline.
+    volume == 0 (a local round trip, nothing on the wire) records zeros."""
+    size = int(v.size) if hasattr(v, "size") else 1
+    itemsize = jnp.dtype(jnp.result_type(v)).itemsize
+    logical = int(volume * size * itemsize)
+    if quantized_dt and volume > 0:
+        nb_pad = -(-Q.n_blocks(size, block) // max(n, 1)) * max(n, 1)
+        wire = int(volume * (nb_pad * block + 4 * nb_pad))
+    else:
+        wire = logical
+    ax = axis if isinstance(axis, str) or axis is None else \
+        "+".join(str(a) for a in axis)
+    return record(CommOp(
+        owner=owner, site=f"{owner}/{kind}/{ax or 'local'}", kind=kind,
+        axis=ax, shape=tuple(getattr(v, "shape", ())),
+        dtype=str(jnp.result_type(v)), bytes_logical=logical,
+        bytes_wire=wire, quantized=quantized_dt, deadline_s=dl.timeout))
+
+
+def _ar_volume(n: int) -> float:
+    """Per-device wire multiplier of an n-rank two-shot all-reduce.
+    Zero when the axis is trivial: nothing crosses a wire, and the
+    accounting must say so (no fictitious bytes either way)."""
+    return 2.0 * (n - 1) / n if n > 1 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# bound-axis primitives (call INSIDE shard_map) — what collective.py routes to
+# ---------------------------------------------------------------------------
+
+def wire_all_reduce(v, axis, op: str = "sum", *, owner: str = "collective",
+                    exact: bool = False, budget: Optional[float] = None):
+    """All-reduce over the bound mesh axis `axis` (inside shard_map).
+    Quantizes when the context is on and the reduction is eligible;
+    otherwise the exact lax reduction.  Always recorded."""
+    dl = _deadline(owner, budget)
+    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+    n = 1
+    for a in axes:
+        n *= _axis_size(a)
+    if _quant_eligible(v, op, axis, exact):
+        st = _state
+        _phase(SITE_QUANTIZE, dl, owner)
+        _phase(SITE_COLLECTIVE, dl, owner)
+        ax = axis[0] if isinstance(axis, (tuple, list)) else axis
+        out = _two_shot_bound(v, ax, op, st.dtype, st.block)
+        _phase(SITE_DEQUANT, dl, owner)
+        _record(owner, "all_reduce", axis, v, _ar_volume(n), st.dtype, dl,
+                st.block, n=n)
+        return out
+    _phase(SITE_COLLECTIVE, dl, owner)
+    red = _LAX_RED.get(op, jax.lax.psum)  # staticcheck: ok[naked-collective] — the comms layer's own exact path
+    _record(owner, "all_reduce", axis, v, _ar_volume(n), None, dl,
+            _state.block)
+    return red(v, axis)
+
+
+def wire_all_gather(v, axis, *, owner: str = "collective",
+                    exact: bool = False, budget: Optional[float] = None):
+    """All-gather over the bound mesh axis (inside shard_map): returns the
+    stacked [n, ...] result.  Quantized when the context is on — ZeRO
+    param/state gathers are the intended rider."""
+    dl = _deadline(owner, budget)
+    n = _axis_size(axis)
+    if _quant_eligible(v, "sum", axis, exact):
+        st = _state
+        _phase(SITE_QUANTIZE, dl, owner)
+        q, s = Q.quantize_blockwise(v, st.dtype, st.block)
+        _phase(SITE_COLLECTIVE, dl, owner)
+        qg = jax.lax.all_gather(q, axis)  # staticcheck: ok[naked-collective] — this IS the comms wire layer
+        sg = jax.lax.all_gather(s, axis)  # staticcheck: ok[naked-collective] — this IS the comms wire layer
+        _phase(SITE_DEQUANT, dl, owner)
+        out = jax.vmap(lambda qq, ss: Q.dequantize_blockwise(
+            qq, ss, v.shape, v.dtype, st.block))(qg, sg)
+        _record(owner, "all_gather", axis, v, n - 1, st.dtype, dl,
+                st.block)
+        return out
+    _phase(SITE_COLLECTIVE, dl, owner)
+    _record(owner, "all_gather", axis, v, n - 1, None, dl,
+            _state.block)
+    return jax.lax.all_gather(v, axis)  # staticcheck: ok[naked-collective] — the comms layer's own exact path
+
+
+# ---------------------------------------------------------------------------
+# global-view entry points (arrays, possibly under jit — no bound axis)
+# ---------------------------------------------------------------------------
+
+def quantized_all_reduce(v, axis: Optional[str] = None, mesh=None,
+                         op: str = "avg", *, owner: str = "comms",
+                         budget: Optional[float] = None):
+    """Quantized all-reduce of a global-view array over mesh axis `axis`.
+
+    With no mesh/axis (or axis extent 1) there is nothing to synchronize:
+    the value still makes the quantize -> dequantize round trip, so the
+    numerics (and the chaos/deadline story) are identical whether the
+    caller runs on one device or many.  On a replicated input, ``avg``
+    preserves the value up to round-trip error — the contract
+    ``grad_sync`` relies on.  Requires the context to be on.
+    """
+    st = _state
+    if st.dtype is None:
+        raise ValueError(
+            "quantized_all_reduce outside comms.quantized(): enter the "
+            "context (or use collective.all_reduce for the exact path)")
+    from ...parallel import mesh as mesh_mod
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+    n = (mesh.shape[axis]
+         if mesh is not None and axis in getattr(mesh, "axis_names", ())
+         else 1)
+    dl = _deadline(owner, budget)
+    if n <= 1:
+        # local leg: same three phases, NOTHING on the wire (volume 0 —
+        # the record keeps the count/site, not fictitious byte savings)
+        _phase(SITE_QUANTIZE, dl, owner)
+        q, s = Q.quantize_blockwise(v, st.dtype, st.block)
+        _phase(SITE_COLLECTIVE, dl, owner)
+        _phase(SITE_DEQUANT, dl, owner)
+        out = Q.dequantize_blockwise(
+            q, s, getattr(v, "shape", ()), jnp.result_type(v), st.block)
+        _record(owner, "all_reduce", axis, v, 0, st.dtype, dl, st.block)
+        return out
+    _phase(SITE_QUANTIZE, dl, owner)
+    _phase(SITE_COLLECTIVE, dl, owner)
+    from jax.sharding import PartitionSpec
+    spec = PartitionSpec()
+    fn = _shard_map(
+        lambda x: _two_shot_bound(x, axis, op, st.dtype, st.block),
+        mesh, (spec,), spec)
+    out = fn(v)
+    _phase(SITE_DEQUANT, dl, owner)
+    _record(owner, "all_reduce", axis, v, _ar_volume(n), st.dtype, dl,
+            st.block, n=n)
+    return out
+
+
+def grad_sync(grads, mesh=None, axis: str = "dp",
+              owner: str = "trainer.grad_sync"):
+    """The trainer's gradient-sync hook (list OR pytree of gradients).
+
+    Context off: returns `grads` UNCHANGED — zero equations added, the
+    compiled step is bitwise the pre-comms program.  Context on (at trace
+    time) with a non-trivial `axis` on the mesh: every floating gradient
+    re-rides the wire as a quantized all-reduce (avg over the already-
+    GSPMD-reduced replicated values — value-preserving up to the wire
+    round trip, which is exactly the perturbation a quantized sync
+    imposes).  Non-float leaves pass through untouched, and so do leaves
+    smaller than one block per rank: the two-shot pads to n whole blocks,
+    so a tiny bias would move MORE bytes quantized than exact — the
+    accounting is padding-honest, and the gate keeps such leaves off the
+    quantized path entirely.
+    """
+    if _state.dtype is None:
+        return grads
+    from ...parallel import mesh as mesh_mod
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+    if mesh is None or axis not in getattr(mesh, "axis_names", ()) \
+            or mesh.shape[axis] <= 1:
+        return grads
+    n = mesh.shape[axis]
+    min_size = _state.block * n
+
+    def sync_leaf(g):
+        if jnp.issubdtype(jnp.result_type(g), jnp.floating) \
+                and int(getattr(g, "size", 0)) >= min_size:
+            return quantized_all_reduce(g, axis=axis, mesh=mesh, op="avg",
+                                        owner=owner)
+        return g
+
+    if isinstance(grads, list):
+        return [sync_leaf(g) for g in grads]
+    return jax.tree_util.tree_map(sync_leaf, grads)

@@ -1,0 +1,142 @@
+"""Blockwise wire quantization for collectives (EQuARX, arxiv 2506.17615).
+
+The wire format every quantized collective in this package speaks:
+
+    payload  int8 / float8_e4m3fn, one value per element
+    scales   float32, one per BLOCK of `block` consecutive elements
+             (flat order; the trailing block may be short)
+
+Per-block absmax scaling keeps the dynamic range local — one outlier
+gradient spike only wastes the resolution of its own block, not the whole
+tensor (the per-tensor-scale failure mode EQuARX measures).  The payload
+plus scales is what a quantized collective moves on the wire:
+``wire_bytes`` accounts exactly that, ``logical_bytes`` what the
+full-precision collective would have moved.
+
+Contracts (each has a known-answer test in tests/test_comms.py):
+
+- **all-zero block**: absmax 0 would divide by zero; the scale is clamped
+  to 1.0 and the block round-trips to exact zeros.
+- **inf/nan guard**: non-finite inputs must not poison the block's scale
+  (inf absmax -> every neighbor dequantizes to 0/nan).  The scale is
+  computed over FINITE values only; ``nan`` quantizes to 0, ``+/-inf``
+  saturates to the block's finite absmax.  Gradient sync pairs this with
+  the trainer's grad-finite skip: a poisoned step is discarded anyway,
+  but the wire format stays well-defined.
+- **odd tail block**: sizes that don't divide `block` are zero-padded for
+  the blocked kernel and sliced back after dequantize — round-trip
+  preserves the original shape exactly.
+- **stochastic rounding** (opt-in, int8 only): round-to-nearest biases
+  accumulated small gradients toward zero; with a key, ties break by
+  uniform noise so the rounding error is zero-mean (EQuARX's SR option).
+  The +/-0.5 noise equals one half-step only on int8's UNIFORM grid; on
+  fp8's non-uniform e4m3 grid it would be additive noise (biased near the
+  block max, resolution-destroying near zero), so fp8+stochastic is
+  rejected with a typed error instead of silently mis-rounding.
+
+Pure jax: everything here traces under jit/shard_map/capture.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK = 256
+
+# int8 symmetric range: +/-127 (keep -128 out so the range is symmetric
+# and dequantize(quantize(-x)) == -dequantize(quantize(x)))
+_INT8_MAX = 127.0
+# float8_e4m3fn's largest finite value (jax/ml_dtypes finfo max = 448)
+_FP8_MAX = 448.0
+
+WIRE_DTYPES = ("int8", "fp8")
+
+
+def _wire_dtype(dtype: str):
+    if dtype == "int8":
+        return jnp.int8, _INT8_MAX
+    if dtype == "fp8":
+        if not hasattr(jnp, "float8_e4m3fn"):
+            raise ValueError(
+                "fp8 wire format needs jnp.float8_e4m3fn, which this jax "
+                "does not provide — use dtype='int8'")
+        return jnp.float8_e4m3fn, _FP8_MAX
+    raise ValueError(f"unknown wire dtype {dtype!r}; pick from {WIRE_DTYPES}")
+
+
+def n_blocks(size: int, block: int = DEFAULT_BLOCK) -> int:
+    return -(-int(size) // int(block))
+
+
+def quantize_blockwise(x, dtype: str = "int8", block: int = DEFAULT_BLOCK,
+                       stochastic: bool = False, key=None):
+    """Quantize ``x`` to the wire format.
+
+    Returns ``(payload, scales)``: payload has ``x``'s shape flattened and
+    zero-padded to a block multiple (``[n_blocks * block]``), scales is
+    ``[n_blocks]`` float32.  Callers carry ``x.shape``/``x.size`` to
+    ``dequantize_blockwise`` (shape is static under trace, so this is
+    free).
+    """
+    wire, qmax = _wire_dtype(dtype)
+    block = int(block)
+    flat = jnp.ravel(x).astype(jnp.float32)
+    size = flat.shape[0]
+    nb = n_blocks(size, block)
+    pad = nb * block - size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    blocks = flat.reshape(nb, block)
+
+    finite = jnp.isfinite(blocks)
+    absfin = jnp.where(finite, jnp.abs(blocks), 0.0)
+    absmax = jnp.max(absfin, axis=1, keepdims=True)
+    # all-zero (or all-non-finite) block: scale 1.0, quantizes to zeros
+    scale = jnp.where(absmax > 0.0, absmax / qmax, 1.0)
+    # inf/nan guard: nan -> 0, +/-inf -> saturate at the finite absmax
+    guarded = jnp.where(jnp.isnan(blocks), 0.0,
+                        jnp.clip(blocks, -absmax, absmax))
+    scaled = guarded / scale
+    if stochastic:
+        if wire != jnp.int8:
+            raise ValueError(
+                "stochastic rounding is defined on int8's uniform grid "
+                "only; fp8's non-uniform steps would turn the +/-0.5 "
+                "noise into bias — use dtype='int8' with stochastic=True")
+        if key is None:
+            raise ValueError("stochastic rounding needs an explicit key")
+        noise = jax.random.uniform(key, blocks.shape, jnp.float32,
+                                   -0.5, 0.5)
+        scaled = scaled + noise
+    if wire == jnp.int8:
+        q = jnp.clip(jnp.round(scaled), -qmax, qmax).astype(jnp.int8)
+    else:
+        q = jnp.clip(scaled, -qmax, qmax).astype(wire)  # e4m3 cast rounds
+    return q.reshape(nb * block), scale.reshape(nb).astype(jnp.float32)
+
+
+def dequantize_blockwise(payload, scales, shape, dtype=jnp.float32,
+                         block: int = DEFAULT_BLOCK):
+    """Inverse of :func:`quantize_blockwise`: wire payload + scales back to
+    an array of ``shape`` in ``dtype`` (tail padding sliced off)."""
+    block = int(block)
+    nb = scales.shape[0]
+    vals = payload.astype(jnp.float32).reshape(nb, block) \
+        * scales.reshape(nb, 1)
+    size = 1
+    for d in shape:
+        size *= int(d)
+    return vals.reshape(nb * block)[:size].reshape(shape).astype(dtype)
+
+
+def wire_bytes(size: int, dtype: str = "int8",
+               block: int = DEFAULT_BLOCK) -> int:
+    """Bytes ONE pass of the quantized payload moves for `size` elements:
+    1 byte/element (int8 and fp8 alike) + 4 bytes per block scale."""
+    _wire_dtype(dtype)  # validate
+    return int(size) + 4 * n_blocks(size, block)
+
+
+def logical_bytes(size: int, itemsize: int = 4) -> int:
+    """Bytes one pass of the full-precision payload would move."""
+    return int(size) * int(itemsize)

@@ -27,7 +27,7 @@ def _exchange(x: Tensor, axis: str) -> Tensor:
     def f(v):
         n = jax.lax.axis_size(axis)
         parts = v.reshape((n, v.shape[0] // n) + v.shape[1:])
-        out = jax.lax.all_to_all(parts, axis, split_axis=0, concat_axis=0,
+        out = jax.lax.all_to_all(parts, axis, split_axis=0, concat_axis=0,  # staticcheck: ok[naked-collective] — expert-parallel a2a; route through comms when MoE lands (ROADMAP)
                                  tiled=False)
         return out.reshape(v.shape)
     return apply(f, x, op_name="global_scatter")

@@ -224,6 +224,18 @@ def _amp_key():
     return _call_deps()[0]()
 
 
+def _comms_key():
+    # comms quant regime (distributed/comms): like amp, consulted at trace
+    # time — a step captured exact must not serve quantized calls. False
+    # (off) for the overwhelmingly common case; import stays lazy so the
+    # capture tier never forces the distributed package in.
+    try:
+        from ..distributed.comms.api import comms_cache_key
+        return comms_cache_key()
+    except Exception:  # noqa: BLE001 — comms unavailable: one regime only
+        return False
+
+
 def _contains_tracer(leaves) -> bool:
     return any(isinstance(_unwrap(l), jcore.Tracer) for l in leaves)
 
@@ -453,7 +465,8 @@ class CapturedStep:
                 if f is _op_cache._UNHASHABLE:
                     return None
                 parts.append(("S", f))
-        return (treedef, tuple(parts), bool(grad_on), _amp_key())
+        return (treedef, tuple(parts), bool(grad_on), _amp_key(),
+                _comms_key())
 
     def _capture(self, entry: _Entry, leaves, treedef):
         fn = self._fn

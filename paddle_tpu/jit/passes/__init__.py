@@ -14,6 +14,11 @@ is jaxpr -> jaxpr, value-semantics preserving:
   folding (value-identical constvars collapse to one buffer).
 - ``dve``      — dead-value elimination: drops equations (and constants)
   whose results never reach an output; effectful equations are kept.
+- ``comm``     — comm-schedule pass (passes/comm_schedule.py): tags every
+  collective equation (any nesting level) with an overlap slot, registers
+  the tally with distributed/comms, and hoists independent collectives to
+  their earliest dependency-legal position so XLA can overlap wire time
+  with compute (GC3-style, arxiv 2201.11840).
 
 Donation inference (passes/donation.py) runs beside the pipeline: it maps
 (input avals, output avals) to the argument positions that can safely alias
@@ -23,8 +28,8 @@ Every pass records what it did into a :class:`PassReport`; the capture layer
 surfaces the totals through ``profiler.step_capture_summary()``.
 
 Env: ``PT_STEP_CAPTURE_PASSES`` — comma-separated subset of
-``fusion,cse,dve`` (default ``all``; ``0``/``none`` disables the pipeline
-while keeping capture itself on).
+``fusion,cse,dve,comm`` (default ``all``; ``0``/``none`` disables the
+pipeline while keeping capture itself on).
 """
 from __future__ import annotations
 
@@ -34,7 +39,7 @@ from typing import List, Tuple
 
 __all__ = ["PassReport", "run_pipeline", "default_passes"]
 
-_ALL = ("fusion", "cse", "dve")
+_ALL = ("fusion", "cse", "dve", "comm")
 
 
 @dataclass
@@ -45,6 +50,9 @@ class PassReport:
     consts_deduped: int = 0     # value-identical constants collapsed
     dve_removed: int = 0        # dead equations dropped
     dve_consts_dropped: int = 0  # constants orphaned by DVE
+    comm_tagged: int = 0        # collective eqns tagged (all nesting levels)
+    comm_hoisted: int = 0       # collectives moved to their earliest slot
+    comm_slots: int = 0         # max overlap slots at any one level
     donated_args: Tuple[int, ...] = ()   # flat arg positions inferred donatable
     eqns_before: int = 0
     eqns_after: int = 0
@@ -57,6 +65,9 @@ class PassReport:
             "consts_deduped": self.consts_deduped,
             "dve_removed": self.dve_removed,
             "dve_consts_dropped": self.dve_consts_dropped,
+            "comm_tagged": self.comm_tagged,
+            "comm_hoisted": self.comm_hoisted,
+            "comm_slots": self.comm_slots,
             "donated_args": list(self.donated_args),
             "eqns_before": self.eqns_before,
             "eqns_after": self.eqns_after,
@@ -83,6 +94,7 @@ def run_pipeline(closed, passes=None, report: PassReport | None = None):
     this, so the pipeline can only ever lose an optimization, not
     correctness.
     """
+    from . import comm_schedule as _comm
     from . import cse as _cse
     from . import dve as _dve
     from . import fusion as _fusion
@@ -93,7 +105,7 @@ def run_pipeline(closed, passes=None, report: PassReport | None = None):
         passes = default_passes()
     report.eqns_before = len(closed.jaxpr.eqns)
     table = {"fusion": _fusion.inline_calls, "cse": _cse.fold,
-             "dve": _dve.eliminate}
+             "dve": _dve.eliminate, "comm": _comm.schedule}
     for name in passes:
         fn = table.get(name)
         if fn is None:

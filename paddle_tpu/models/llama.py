@@ -875,6 +875,11 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
             grads = jax.tree_util.tree_map(lambda g: g / k, tot_g)
         else:
             loss, grads = loss_and_grads(param_vals, batch, rng)
+        # comms hook (distributed/comms): with comms.quantized() active at
+        # trace time the dp gradient sync re-rides the quantized wire;
+        # off = identity, bitwise (same contract as parallel/trainer.py)
+        from ..distributed import comms as _comms
+        grads = _comms.grad_sync(grads, mesh=mesh, axis="dp")
         clip = getattr(base_opt, "_grad_clip", None)
         if clip is not None:
             from ..nn.clip import ClipGradByGlobalNorm
@@ -945,9 +950,23 @@ def build_hybrid_train_step(model: LlamaForCausalLM, optimizer, mesh=None,
         return jitted.lower(state["params"], state["opt"], vals, lr, st,
                             rng).compile().memory_analysis()
 
+    def analyze_comm(batch):
+        """Comm-volume + overlap-slot columns of the EXACT step program
+        (jit/passes/comm_schedule.analyze): collective count, payload
+        bytes, slots — what the MULTICHIP dryrun and SCHEDULE_BENCH emit."""
+        from ..jit.passes import comm_schedule as _cs
+        vals = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                for k, v in batch.items()}
+        lr = jnp.asarray(base_opt.get_lr(), jnp.float32)
+        st = jnp.asarray(1, jnp.int32)
+        rng = gen.next_key()
+        return _cs.analyze(jax.make_jaxpr(pure_step)(
+            state["params"], state["opt"], vals, lr, st, rng))
+
     step.state = state
     step.lower_text = lower_text
     step.memory_stats = memory_stats
+    step.analyze_comm = analyze_comm
     step.write_back = lambda: _write_back(model, state["params"], outer_names,
                                           outer_params, block_names)
     return step

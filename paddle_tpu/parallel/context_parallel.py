@@ -101,8 +101,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                 kc, vc)
             o_new, lse_new = _merge_partials(o_acc, lse_acc, o_t, lse_t)
             # skipped chunks contribute weight exp(-inf) = 0
-            k_next = jax.lax.ppermute(kc, axis_name, perm)
-            v_next = jax.lax.ppermute(vc, axis_name, perm)
+            k_next = jax.lax.ppermute(kc, axis_name, perm)  # staticcheck: ok[naked-collective] — ring-attention hand-off: the rotate IS the schedule (comm pass tags/slots it)
+            v_next = jax.lax.ppermute(vc, axis_name, perm)  # staticcheck: ok[naked-collective] — ring-attention hand-off: the rotate IS the schedule (comm pass tags/slots it)
             return (o_new, lse_new, k_next, v_next), None
 
         o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
@@ -135,8 +135,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         l_new = l * alpha + p.sum(axis=-1)
         o_new = o * alpha[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
-        k_next = jax.lax.ppermute(kc, axis_name, perm)
-        v_next = jax.lax.ppermute(vc, axis_name, perm)
+        k_next = jax.lax.ppermute(kc, axis_name, perm)  # staticcheck: ok[naked-collective] — ring-attention hand-off: the rotate IS the schedule (comm pass tags/slots it)
+        v_next = jax.lax.ppermute(vc, axis_name, perm)  # staticcheck: ok[naked-collective] — ring-attention hand-off: the rotate IS the schedule (comm pass tags/slots it)
         return (o_new, l_new, m_new, k_next, v_next), None
 
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
@@ -200,6 +200,14 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
 
 @functools.lru_cache(maxsize=64)
 def _cp_callable(mesh, axis, mode, causal, scale, impl="auto"):
+    if getattr(jax.shard_map, "_pt_compat", False):
+        # 0.4-line jax: partial-manual collectives ABORT the process inside
+        # XLA SPMD partitioning (a CHECK failure, not a catchable error) —
+        # fail fast with a typed error instead of taking the interpreter
+        # down with the whole test session
+        raise NotImplementedError(
+            "context-parallel attention needs native partial-manual "
+            "shard_map collectives (jax>=0.7); unavailable on this jax")
     if mode == "ring":
         local = partial(_ring_attention_local, impl=impl)
     else:

@@ -90,14 +90,14 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
             j = (t - (S - 1)) % n_microbatches
             outs = outs.at[j].set(jnp.where((idx == S - 1) & (t >= S - 1),
                                             out, outs[j]))
-            state_next = jax.lax.ppermute(out, axis, perm)
+            state_next = jax.lax.ppermute(out, axis, perm)  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
             return (state_next, outs), None
 
         (state, outputs), _ = jax.lax.scan(step, (state, outputs),
                                            jnp.arange(T))
         # replicate the last stage's outputs to every pp rank (so the loss can
         # be computed in the global view)
-        outputs = jax.lax.psum(
+        outputs = jax.lax.psum(  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
             jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)), axis)
         return outputs
 
@@ -153,12 +153,12 @@ def _spmd_pipeline_vpp(stage_fn, stage_params, microbatches, *,
             mi = jnp.clip(m, 0, M - 1)
             outs = outs.at[mi].set(
                 jnp.where(active & is_last_vs, y, outs[mi]))
-            a_next = jax.lax.ppermute(jnp.where(active, y, jnp.zeros_like(y)),
+            a_next = jax.lax.ppermute(jnp.where(active, y, jnp.zeros_like(y)),  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
                                       axis, perm)
             return (a_next, outs), None
 
         (_, outputs), _ = jax.lax.scan(step, (state, outputs), jnp.arange(T))
-        outputs = jax.lax.psum(
+        outputs = jax.lax.psum(  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
             jnp.where((idx == S - 1), outputs, jnp.zeros_like(outputs)), axis)
         return outputs
 
@@ -306,9 +306,9 @@ def spmd_pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
             dx_all = carry["dx"].at[mb].set(
                 jnp.where(do_bwd & (idx == 0), dx, carry["dx"][mb]))
 
-            a_next = jax.lax.ppermute(
+            a_next = jax.lax.ppermute(  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
                 jnp.where(do_fwd, y, jnp.zeros_like(y)), axis, down)
-            g_next = jax.lax.ppermute(
+            g_next = jax.lax.ppermute(  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
                 jnp.where(do_bwd, dx, jnp.zeros_like(dx)), axis, up)
             return dict(a_in=a_next, g_in=g_next, x_stash=x_stash,
                         g_stage=g_stage, g_head=g_head, loss=loss,
@@ -316,12 +316,12 @@ def spmd_pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
 
         carry, _ = jax.lax.scan(round_, carry0, jnp.arange(R))
 
-        loss = jax.lax.psum(jnp.where(idx == S - 1, carry["loss"], 0.0), axis)
+        loss = jax.lax.psum(jnp.where(idx == S - 1, carry["loss"], 0.0), axis)  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
         g_head = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(
+            lambda g: jax.lax.psum(  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
                 jnp.where(idx == S - 1, g, jnp.zeros_like(g)), axis),
             carry["g_head"])
-        dx = jax.lax.psum(
+        dx = jax.lax.psum(  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
             jnp.where(idx == 0, carry["dx"], jnp.zeros_like(carry["dx"])),
             axis)
         return loss, carry["g_stage"], g_head, dx
@@ -446,9 +446,9 @@ def spmd_pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
                 jnp.where(do_bwd & (idx == 0), dx, carry["dx"][m_b]))
 
             # ---- stage hand-off (activations down, cotangents up) ----
-            a_next = jax.lax.ppermute(
+            a_next = jax.lax.ppermute(  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
                 jnp.where(do_fwd, y, jnp.zeros_like(y)), axis, down)
-            g_next = jax.lax.ppermute(
+            g_next = jax.lax.ppermute(  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
                 jnp.where(do_bwd, dx, jnp.zeros_like(dx)), axis, up)
             return dict(a_in=a_next, g_in=g_next, x_stash=x_stash,
                         g_stage=g_stage, g_head=g_head, loss=loss,
@@ -457,12 +457,12 @@ def spmd_pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
         carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
 
         # replicate last-stage scalars / stage-0 dx across pp
-        loss = jax.lax.psum(jnp.where(idx == S - 1, carry["loss"], 0.0), axis)
+        loss = jax.lax.psum(jnp.where(idx == S - 1, carry["loss"], 0.0), axis)  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
         g_head = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(
+            lambda g: jax.lax.psum(  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
                 jnp.where(idx == S - 1, g, jnp.zeros_like(g)), axis),
             carry["g_head"])
-        dx = jax.lax.psum(
+        dx = jax.lax.psum(  # staticcheck: ok[naked-collective] — pipeline-internal: this collective IS the schedule (comm pass tags/slots it)
             jnp.where(idx == 0, carry["dx"], jnp.zeros_like(carry["dx"])),
             axis)
         return loss, carry["g_stage"], g_head, dx

@@ -113,6 +113,14 @@ def _zero_state_spec(param_spec: PartitionSpec, shape, axis, mesh):
     return param_spec
 
 
+def _comms_grad_sync(grads, mesh, axis="dp"):
+    """Lazy-import shim over comms.grad_sync (the off-path/mesh guards
+    live THERE, once): returns the SAME list unless the comms.quantized()
+    context is active at trace time."""
+    from ..distributed import comms
+    return comms.grad_sync(grads, mesh=mesh, axis=axis)
+
+
 class TrainStep:
     """Callable train step holding device-side param/opt-state pytrees."""
 
@@ -254,6 +262,12 @@ class TrainStep:
 
         acc = self._accumulate_steps
         mesh = self.mesh
+        # the data-parallel axis the (optional) quantized grad sync rides:
+        # first batch-spec axis alive on the mesh
+        batch_axes = (self._batch_spec,) if isinstance(self._batch_spec, str) \
+            else tuple(self._batch_spec)
+        sync_axis = next((a for a in batch_axes if mesh is not None
+                          and a in mesh.axis_names), "dp")
         use_scaling = self._use_scaling
         dynamic = self._dynamic_scaling
         cfg = self._scale_cfg
@@ -319,6 +333,16 @@ class TrainStep:
             # sanitize so clip/update math can't poison state with nan
             # before the where-select discards it
             grads = [jnp.where(finite, g, jnp.zeros_like(g)) for g in grads]
+
+            # comms hook: with comms.quantized() active AT TRACE TIME, the
+            # dp gradient sync re-rides the quantized wire (EQuARX two-shot
+            # all-reduce; distributed/comms). Off = identity, bitwise.
+            # Deliberately AFTER the grad-finite flag: the wire format's
+            # inf/nan guard (nan->0, inf saturates) would otherwise make an
+            # overflowed step look finite — the skip/loss-scaling machinery
+            # must judge the RAW gradients, then the (sanitized) applied
+            # gradients ride the quantized sync.
+            grads = _comms_grad_sync(grads, mesh, sync_axis)
 
             if clip is not None:
                 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue

@@ -140,6 +140,39 @@ def serving_summary() -> str:
     return "\n".join(lines)
 
 
+def comm_summary() -> str:
+    """Comm-subsystem accounting (distributed/comms) as text: per call
+    site the collective count, LOGICAL bytes (what full precision would
+    move) vs WIRE bytes (what actually moves), the compression ratio, the
+    wire dtype when the quantized context was on, and the overlap slots
+    the capture-tier comm pass assigned.  Sites owned by ``xla`` are the
+    collective equations tagged inside captured step programs (counted
+    once per lowering); the rest are api-level collectives (grad sync,
+    routed dist.all_reduce/all_gather).  A healthy quantized dp step shows
+    the grad-sync site at ~3.9x compression (int8, block 256); 1.0x there
+    means the context wasn't active when the step was BUILT — it is
+    consulted at trace time, like amp.auto_cast."""
+    from ..distributed.comms import comm_info
+
+    info = comm_info()
+    if not info["sites"]:
+        return "comms: no recorded collectives"
+    head = (f"{'Site':<40} {'N':>5} {'Logical':>12} {'Wire':>12} "
+            f"{'Ratio':>7} {'Q':>5} {'Slots':>6}")
+    lines = [
+        f"comms: {info['collectives']} collective(s), "
+        f"{info['total_logical']} logical -> {info['total_wire']} wire bytes",
+        head, "-" * len(head),
+    ]
+    for site, s in info["sites"].items():
+        slots = ",".join(str(x) for x in s["slots"]) or "-"
+        lines.append(
+            f"{site[:40]:<40} {s['count']:>5} {s['bytes_logical']:>12} "
+            f"{s['bytes_wire']:>12} {s['compression']:>7} "
+            f"{(s['quantized'] or '-'):>5} {slots:>6}")
+    return "\n".join(lines)
+
+
 def reshard_summary() -> str:
     """Live-reshard reports (distributed/reshard.py) as text: per executed
     plan the ladder rung that ran (reshard / partial-restore /

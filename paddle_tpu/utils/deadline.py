@@ -64,6 +64,13 @@ class ReshardTimeout(DeadlineExceeded):
     (distributed/reshard.py)."""
 
 
+class CommTimeout(DeadlineExceeded):
+    """A comms-subsystem collective (quantize / wire / dequantize phase)
+    ran out of its PT_COMM_DEADLINE budget — a peer stalled mid-collective.
+    The schedule entry (distributed/comms/schedule.py) names the owner and
+    site so the stuck collective is identifiable from the error alone."""
+
+
 class MembershipTimeout(DeadlineExceeded):
     """The elastic membership never reached the required size within the
     budget (ElasticManager.require_np) — the typed form of wait_for_np's

@@ -53,6 +53,15 @@ def main(site: str) -> None:
         states = {"a": {"w": full[:4].copy()}, "b": {"w": full[4:].copy()}}
         out, _ = rs.redistribute(src, dst, params, states, budget=BUDGET)
         assert np.array_equal(out["a"]["w"], full)
+    elif site.startswith("comm."):
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import comms
+
+        with comms.quantized("int8"):
+            out = comms.quantized_all_reduce(
+                jnp.ones((2048,), jnp.float32), owner="no-hang-child",
+                budget=BUDGET)
+        assert out.shape == (2048,)
     elif site == "io.worker_batch":
         import numpy as np
         import paddle_tpu.io as io

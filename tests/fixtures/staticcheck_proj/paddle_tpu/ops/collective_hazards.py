@@ -1,0 +1,30 @@
+"""Fixture: naked-collective positives/negatives (tests/test_staticcheck)."""
+import jax
+from jax import lax
+
+
+def naked_psum(v):
+    return lax.psum(v, "dp")                      # line 7: FLAGGED
+
+
+def naked_all_gather(v):
+    return jax.lax.all_gather(v, "mp")            # line 11: FLAGGED
+
+
+def routed_ok(v, comms):
+    # routed through the comms subsystem: not a lax attribute call
+    return comms.wire_all_reduce(v, "dp", "sum")
+
+
+def unrelated_attr_ok(engine):
+    # `.psum` on something that is not lax stays quiet
+    return engine.psum("dp")
+
+
+def non_collective_lax_ok(v):
+    # lax math is not wire traffic
+    return lax.tanh(v)
+
+
+def suppressed(v):
+    return lax.ppermute(v, "pp", [(0, 1)])  # staticcheck: ok[naked-collective] — deliberate fixture pragma

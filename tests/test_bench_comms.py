@@ -1,0 +1,63 @@
+"""Guard for the quantized-collectives bench (bench_comms.py).
+
+The wire-reduction number is deterministic accounting (program wire
+format, not timing), so the >=3.5x acceptance floor is asserted even in
+the tier-1 smoke run; the loss-parity tolerance is asserted at the full
+step count only under slow (more steps = the real accumulation regime).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(steps: int):
+    env = dict(os.environ, PT_COMM_BENCH_STEPS=str(steps))
+    env.pop("XLA_FLAGS", None)  # the bench pins its own 2-device cpu
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench_comms.py")],
+                       capture_output=True, text=True, timeout=600, env=env,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout  # exactly ONE JSON line on stdout
+    return json.loads(lines[0]), r.stderr
+
+
+@pytest.mark.skipif(os.environ.get("PT_TIGHT_BUDGET") == "1",
+                    reason="wall-clock budget is tight; perf smoke skipped")
+def test_bench_comms_smoke_json_contract():
+    payload, stderr = _run_bench(steps=6)
+    assert payload["metric"] == "comm_wire_reduction_int8"
+    assert payload["unit"] == "x"
+    # deterministic accounting: the floor holds at any step count
+    assert payload["value"] >= 3.5, payload
+    assert payload["vs_baseline"] >= 1.0, payload
+    # the off path is bitwise repeatable (the comms hook adds nothing)
+    assert payload["bitwise_off"] is True, payload
+    assert payload["grad_sync_bytes_wire"] > 0
+    assert payload["grad_sync_bytes_logical"] > \
+        payload["grad_sync_bytes_wire"]
+    # the summary table made it to stderr next to the artifact pointer
+    assert "trainer.grad_sync" in stderr
+    assert "artifact ->" in stderr
+    art = stderr.split("artifact ->", 1)[1].strip().splitlines()[0]
+    with open(art) as f:
+        detail = json.load(f)["detail"]
+    assert "trainer.grad_sync/all_reduce/dp" in detail["sites"]
+    assert len(detail["loss_curve_off"]) == 6
+    # the captured step's comm pass saw the quantized wire legs
+    assert detail["pass_report"] is None or \
+        detail["pass_report"]["comm_tagged"] >= 2
+    os.unlink(art)  # tiny-step artifacts are not trajectory evidence
+
+
+@pytest.mark.slow
+def test_bench_comms_meets_acceptance_floor():
+    payload, _ = _run_bench(steps=30)
+    assert payload["value"] >= 3.5, payload
+    assert payload["loss_parity"] is True, payload
+    assert payload["bitwise_off"] is True, payload

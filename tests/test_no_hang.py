@@ -28,13 +28,15 @@ import pytest
 
 import paddle_tpu.io as io
 from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed import comms as comms_mod  # noqa: F401 — registers comm.* sites
 from paddle_tpu.distributed import reshard as reshard_mod  # noqa: F401 — registers reshard.* sites
 from paddle_tpu.distributed import rpc as rpc_mod
 from paddle_tpu.distributed import store as store_mod
 from paddle_tpu.distributed.store import _GET, _PyStoreServer
 from paddle_tpu.io.dataloader import DataLoaderWorkerError
-from paddle_tpu.utils.deadline import (DataLoaderTimeout, RpcTimeout,
-                                       StoreConnectionError, StoreTimeout)
+from paddle_tpu.utils.deadline import (CommTimeout, DataLoaderTimeout,
+                                       RpcTimeout, StoreConnectionError,
+                                       StoreTimeout)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -76,6 +78,21 @@ MATRIX = {
     ("reshard.commit", "delay:2.0"):  ("typed", "ReshardTimeout"),
     ("reshard.commit", "error"):      ("typed", "FaultInjected"),
     ("reshard.commit", "drop"):       ("clean", None),
+    # quantized/scheduled collectives (distributed/comms): all three
+    # phases run under one cumulative PT_COMM_DEADLINE; a stall becomes
+    # the typed CommTimeout, a dropped wire is absorbed by retry-once
+    ("comm.quantize", "crash"):       ("sigkill", None),
+    ("comm.quantize", "delay:2.0"):   ("typed", "CommTimeout"),
+    ("comm.quantize", "error"):       ("typed", "FaultInjected"),
+    ("comm.quantize", "drop"):        ("clean", None),
+    ("comm.collective", "crash"):     ("sigkill", None),
+    ("comm.collective", "delay:2.0"): ("typed", "CommTimeout"),
+    ("comm.collective", "error"):     ("typed", "FaultInjected"),
+    ("comm.collective", "drop"):      ("clean", None),
+    ("comm.dequant", "crash"):        ("sigkill", None),
+    ("comm.dequant", "delay:2.0"):    ("typed", "CommTimeout"),
+    ("comm.dequant", "error"):        ("typed", "FaultInjected"),
+    ("comm.dequant", "drop"):         ("clean", None),
 }
 
 
@@ -425,6 +442,48 @@ def test_rpc_drop_fault_raises_connection_error(solo_rpc, arm):
     with pytest.raises(ConnectionError):
         run_bounded(lambda: rpc_mod.rpc_sync("solo", int, args=("7",)),
                     10.0, "rpc_sync under drop fault")
+
+
+# ---------------- comms (quantized collectives) ----------------
+
+def _comm_roundtrip(budget):
+    """One quantized collective (no mesh: the local round-trip leg — same
+    three phases, same deadline/chaos story as the wired path)."""
+    import jax.numpy as jnp
+
+    with comms_mod.quantized("int8"):
+        return comms_mod.quantized_all_reduce(
+            jnp.ones((512,), jnp.float32), owner="no-hang-test",
+            budget=budget)
+
+
+@pytest.mark.parametrize("site", ["comm.quantize", "comm.collective",
+                                  "comm.dequant"])
+def test_comm_delay_fault_raises_typed_comm_timeout(arm, site):
+    """A stalled peer at any comm phase becomes the typed CommTimeout at
+    ~the injected delay — never a hang (the cumulative PT_COMM_DEADLINE
+    is the authority)."""
+    arm(site, "delay:1.0")
+    t0 = time.monotonic()
+    with pytest.raises(CommTimeout):
+        run_bounded(lambda: _comm_roundtrip(0.3), 10.0,
+                    f"quantized collective under delay fault at {site}")
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_comm_error_fault_propagates_typed(arm):
+    arm("comm.collective", "error")
+    with pytest.raises(chaos.FaultInjected):
+        run_bounded(lambda: _comm_roundtrip(5.0), 10.0,
+                    "quantized collective under error fault")
+
+
+def test_comm_drop_fault_absorbed_by_retry(arm):
+    arm("comm.quantize", "drop", hits="1")
+    out = run_bounded(lambda: _comm_roundtrip(5.0), 10.0,
+                      "quantized collective under drop fault")
+    assert out is not None
+    assert chaos._fault_hits.get("comm.quantize", 0) >= 1
 
 
 # ---------------- DataLoader ----------------

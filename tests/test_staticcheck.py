@@ -45,7 +45,8 @@ def test_all_rules_fire_on_fixtures(fixture_findings):
     assert rules >= {"tracer-branch", "numpy-on-tracer", "host-sync",
                      "registry-consistency", "mutable-global",
                      "dead-export", "key-reuse", "closure-capture",
-                     "unbounded-blocking", "dtype-rule-coverage"}, rules
+                     "unbounded-blocking", "dtype-rule-coverage",
+                     "naked-collective"}, rules
     assert len(rules) >= 5  # the acceptance floor, trivially exceeded
 
 
@@ -148,6 +149,26 @@ def test_closure_capture_known_answers(fixture_findings):
     others = [f for f in fixture_findings
               if f.path.endswith("closure_hazards.py")
               and f.rule != "closure-capture"]
+    assert others == [], others
+
+
+def test_naked_collective_known_answers(fixture_findings):
+    """collective_hazards.py: the two positives fire (lax.psum,
+    jax.lax.all_gather); the comms-routed call, a non-lax `.psum`
+    attribute, non-collective lax math, the pragma'd ppermute, and the
+    fixture's own distributed/comms/ module (the allowlisted wire layer)
+    all stay quiet."""
+    nc = [f for f in fixture_findings if f.rule == "naked-collective"]
+    assert all(f.path == "paddle_tpu/ops/collective_hazards.py"
+               for f in nc), nc
+    assert {f.line for f in nc} == {7, 11}, nc
+    assert all(f.severity == "warning" for f in nc)
+    # no OTHER rule trips over the collective fixture, and nothing at all
+    # fires inside the allowlisted comms dir
+    others = [f for f in fixture_findings
+              if (f.path.endswith("collective_hazards.py")
+                  and f.rule != "naked-collective")
+              or "distributed/comms/" in f.path]
     assert others == [], others
 
 

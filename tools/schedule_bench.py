@@ -82,9 +82,9 @@ def build(pp=4, M=8, mb=8, h=256):
             return loss, (gs, gh)
         return step
 
-    return dict(gpipe=jax.jit(gpipe_step),
-                f1b_fused=jax.jit(f1b_step("fused")),
-                f1b_compact=jax.jit(f1b_step("compact"))), \
+    raw = dict(gpipe=gpipe_step, f1b_fused=f1b_step("fused"),
+               f1b_compact=f1b_step("compact"))
+    return {k: jax.jit(v) for k, v in raw.items()}, raw, \
         (params, head, x, labels)
 
 
@@ -114,12 +114,20 @@ def main():
     ap.add_argument("--save", help="also write JSON to this path")
     args = ap.parse_args()
 
-    fns, fargs = build(pp=args.pp, M=args.mb, mb=args.rows, h=args.h)
+    fns, raw, fargs = build(pp=args.pp, M=args.mb, mb=args.rows, h=args.h)
+    from paddle_tpu.jit.passes import comm_schedule as _cs
     res = {}
     losses = {}
     for name, fn in fns.items():
         f, t, l = measure(fn, fargs, iters=args.iters)
         res[name] = {"flops": f, "step_ms": round(t * 1e3, 2)}
+        # comm-volume + overlap-slot columns: the schedule's collective
+        # equations as the capture-tier comm pass sees them (GC3-style
+        # accounting — count, payload bytes, concurrently-issuable slots)
+        try:
+            res[name]["comm"] = _cs.analyze(jax.make_jaxpr(raw[name])(*fargs))
+        except Exception as e:  # noqa: BLE001 — columns are best-effort
+            res[name]["comm"] = {"error": str(e)[:120]}
         losses[name] = l
     for name, l in losses.items():
         assert abs(l - losses["gpipe"]) < 1e-5 * max(1.0, abs(losses["gpipe"])), \

@@ -6,6 +6,7 @@ from . import (  # noqa: F401
     host_sync,
     key_reuse,
     mutable_global,
+    naked_collective,
     numpy_on_tracer,
     registry_consistency,
     tracer_branch,

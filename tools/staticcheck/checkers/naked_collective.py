@@ -1,0 +1,73 @@
+"""naked-collective: direct lax collectives outside the comms subsystem.
+
+Every framework collective is supposed to route through
+``paddle_tpu/distributed/comms/`` so it gets a CommOp record (owner,
+logical-vs-wire bytes, deadline, overlap slot) and — when the quantized
+context is on — the EQuARX wire format.  A direct ``jax.lax.psum`` /
+``all_gather`` / ``ppermute`` / ``all_to_all`` call anywhere else is
+invisible to ``profiler.comm_summary()``, never quantizes, and carries no
+deadline: exactly the scattered-collectives state the comms subsystem
+replaced.
+
+Flagged call shapes (attribute calls only — ``from jax.lax import psum``
+is not an idiom this tree uses):
+
+  - ``jax.lax.psum(...)`` / ``lax.psum(...)`` and the rest of the
+    collective family (psum/pmean/pmax/pmin/psum_scatter/all_gather/
+    ppermute/all_to_all/reduce_scatter);
+
+outside ``paddle_tpu/distributed/comms/`` (the one module allowed to
+touch the wire).  Deliberate direct sites — the shard_map-internal
+pipeline/ring-attention schedules whose collectives ARE the schedule, and
+the comms layer's own exact fallbacks — carry
+``# staticcheck: ok[naked-collective]`` with a rationale; anything new
+fails the ratchet.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, register
+
+COLLECTIVE_NAMES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "ppermute", "all_to_all", "reduce_scatter", "pshuffle",
+})
+
+ALLOWED_PREFIX = "paddle_tpu/distributed/comms/"
+
+
+def _is_lax_attr(func: ast.AST) -> bool:
+    """True for `lax.<name>` / `jax.lax.<name>` / `*.lax.<name>` chains."""
+    if not isinstance(func, ast.Attribute):
+        return False
+    base = func.value
+    if isinstance(base, ast.Name):
+        return base.id == "lax"
+    if isinstance(base, ast.Attribute):
+        return base.attr == "lax"
+    return False
+
+
+@register
+class NakedCollectiveChecker(Checker):
+    rule = "naked-collective"
+    severity = "warning"
+
+    def check_module(self, mod: Module):
+        if not mod.path.startswith("paddle_tpu/") \
+                or mod.path.startswith(ALLOWED_PREFIX):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            name = node.func.attr
+            if name in COLLECTIVE_NAMES and _is_lax_attr(node.func):
+                yield mod.finding(
+                    self.rule, self.severity, node,
+                    f"direct jax.lax.{name}() outside distributed/comms/ — "
+                    f"unaccounted, unquantizable, deadline-less wire "
+                    f"traffic; route through comms.wire_all_reduce/"
+                    f"wire_all_gather (or pragma a deliberate "
+                    f"schedule-internal site with its rationale)")
