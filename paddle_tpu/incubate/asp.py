@@ -13,9 +13,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..utils.memo import LockedLRU
 
 _EXCLUDED: set = set()
-_MASKS: dict = {}
+# audited mask registry (utils/memo idiom): keyed by param identity,
+# written from prune_model/decorate under the instance lock
+_MASKS: LockedLRU = LockedLRU(maxsize=None)
 
 
 def calculate_density(x) -> float:
@@ -79,7 +82,7 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
         mask = create_mask(p, n=n, m=m)
         p._value = p._value * mask._value
         masks[name] = mask
-        _MASKS[id(p)] = mask
+        _MASKS.put(id(p), mask)
     return masks
 
 

@@ -10,6 +10,7 @@ XLA-compiled backward.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Any, Callable, Optional
 
 import jax
@@ -41,6 +42,9 @@ def _is_tensor_leaf(x):
     return isinstance(x, Tensor)
 
 
+_SF_SEQ = itertools.count()
+
+
 class StaticFunction:
     """Wraps fn/Layer.forward; compiles per (input signature, training, statics)."""
 
@@ -51,6 +55,10 @@ class StaticFunction:
         self._input_spec = input_spec
         self._cache = {}
         self.__name__ = getattr(function, "__name__", "static_fn")
+        # distinct lint-record identity per wrapped function: every Layer
+        # wraps `forward`, so the bare name alone would collapse all
+        # to_static models into one profiler.lint_summary() row
+        self._lint_name = f"to_static/{self.__name__}#{next(_SF_SEQ)}"
 
     @property
     def concrete_programs(self):
@@ -179,7 +187,8 @@ class StaticFunction:
             flat_in_template[i]._value for i in tensor_idx)
         key0 = jax.random.key(0)  # aval-equal to gen.next_key()'s typed keys
         lowered, prog = _capture.lower_step(
-            lambda *a: pure(*a[:-1], rng_key=a[-1]), (*example, key0))
+            lambda *a: pure(*a[:-1], rng_key=a[-1]), (*example, key0),
+            name=self._lint_name)
         if prog is not None:
             def jitted(*vals, rng_key=None, _lowered=lowered):
                 if rng_key is None:

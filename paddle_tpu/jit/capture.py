@@ -43,6 +43,8 @@ Env knobs:
 - ``PT_STEP_CAPTURE_DONATE`` (default ``off``) — ``auto`` turns on
   donation inference for `capture_step` wrappers that don't choose.
 - ``PT_STEP_CAPTURE_PASSES`` — see jit/passes/.
+- ``PT_STEP_CAPTURE_LINT`` (default 1) — analyze-only jaxpr lint per
+  lowering (jit/passes/lint.py); results in ``profiler.lint_summary()``.
 """
 from __future__ import annotations
 
@@ -59,6 +61,7 @@ from ..core import generator as gen
 from ..core.tensor import Tensor
 from ..utils.memo import Lazy, LockedLRU
 from . import passes as _passes
+from .passes import lint as _lint
 from .passes.donation import infer_donation
 
 __all__ = ["capture_step", "CapturedStep", "lower_step", "capture_info",
@@ -128,6 +131,15 @@ def _merge_report(report, donated=()):
         _TOTALS.consts_deduped += report.consts_deduped
         _TOTALS.dve_removed += report.dve_removed
         _TOTALS.donated_args += len(donated)
+
+
+def _lint_step(name: str, closed, report, donated=()):
+    """Per-lowering jaxpr lint (passes/lint.py): analyze-only, recorded
+    under the step's name for profiler.lint_summary()."""
+    if not _lint.lint_enabled():
+        return
+    _lint.record_lint(name, closed, donated=donated,
+                      comm_tagged=_lint.comm_tagged_of(report))
 
 
 def _note_bailout(reason: str):
@@ -256,7 +268,7 @@ def _leaf_sig(v):
 
 def lower_step(fn: Callable, example_args: Sequence[Any],
                donate_argnums=(), in_shardings=_UNSET,
-               passes=None):
+               passes=None, name: Optional[str] = None):
     """Trace ``fn`` once over concrete ``example_args``, run the graft pass
     pipeline, and return ``(dispatcher, GraftProgram | None)``.
 
@@ -308,13 +320,33 @@ def lower_step(fn: Callable, example_args: Sequence[Any],
             return plain()(*args)
 
         dispatcher.lower = jitted.lower
+        # flat invar positions the jit donates (top-level argnums -> leaf
+        # spans) — recorded on the program so the jaxpr lint's donation
+        # rule sees what the executable actually aliases
+        donated_flat: tuple = ()
+        if donate_argnums:
+            spans, start = [], 0
+            for a in example_args:
+                n = len(jax.tree_util.tree_leaves(a))
+                spans.append((start, start + n))
+                start += n
+            wanted = set(donate_argnums)
+            donated_flat = tuple(
+                i for j, (lo, hi) in enumerate(spans) if j in wanted
+                for i in range(lo, hi))
         from ..static.graft_program import GraftProgram
         prog = GraftProgram(
             closed, op_names, report,
             in_avals=tuple(v.aval for v in closed.jaxpr.invars),
             out_avals=tuple(getattr(v, "aval", None)
-                            for v in closed.jaxpr.outvars))
+                            for v in closed.jaxpr.outvars),
+            donate=donated_flat)
         _merge_report(report)
+        # a caller-supplied name keeps lint records distinct when fn is a
+        # wrapper lambda (the to_static path) — '<lambda>' rows would
+        # clobber each other in profiler.lint_summary()
+        _lint_step(name or getattr(fn, "__name__", "step"), closed, report,
+                   donated_flat)
         return dispatcher, prog
     except Exception as e:  # noqa: BLE001 — correctness net: plain jit
         _note_bailout(f"lower_step:{type(e).__name__}: {e}")
@@ -373,9 +405,17 @@ class CapturedStep:
 
     def programs(self):
         """GraftPrograms of the currently-cached signatures."""
-        with self._cache._lock:
-            entries = list(self._cache._d.values())
-        return [e.program for e in entries if e.program is not None]
+        return [e.program for _, e in self._cache.items()
+                if e.program is not None]
+
+    def bailout_reason(self) -> str:
+        """Reason of the first poisoned signature, '' when none — the
+        observability counterpart of cache_info()['bailouts'] (the
+        staticcheck jaxpr tier reports it on a failed canonical step)."""
+        for _, e in self._cache.items():
+            if e.poisoned and e.reason:
+                return e.reason
+        return ""
 
     # ---- the tier ----
     def __call__(self, *args, **kwargs):
@@ -544,6 +584,7 @@ class CapturedStep:
             donate=donated)
         report.donated_args = donated
         _merge_report(report, donated)
+        _lint_step(self.__name__, closed, report, donated)
 
     @staticmethod
     def _donate_to_flat(leaves, treedef, arr_pos, donate_args):

@@ -24,6 +24,13 @@ Donation inference (passes/donation.py) runs beside the pipeline: it maps
 (input avals, output avals) to the argument positions that can safely alias
 their output buffers (params/opt-state style updates).
 
+The analyze-only lint pass (passes/lint.py) also runs beside the pipeline,
+per lowering: semantic hazards of the captured program (recompile-hazard,
+donation-miss, unscheduled-collective, dead-compute, host-callback) —
+read-only, recorded for ``profiler.lint_summary()`` and wrapped into the
+ratcheted CI gate by the staticcheck jaxpr tier
+(tools/staticcheck/jaxpr/).
+
 Every pass records what it did into a :class:`PassReport`; the capture layer
 surfaces the totals through ``profiler.step_capture_summary()``.
 

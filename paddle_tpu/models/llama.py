@@ -333,6 +333,9 @@ class LlamaForCausalLM(Layer):
                 for p, v in zip(plist, saved):
                     p._value = v
 
+        # distinct name: the three cache-step builders all define `step`,
+        # and jaxpr-lint records (profiler.lint_summary) key on it
+        step.__name__ = "llama_cached_step"
         from ..jit import capture as _capture
         if _capture.step_capture_enabled():
             # donate arg 2 (the KV caches); the decode loop rebinds them
@@ -381,6 +384,7 @@ class LlamaForCausalLM(Layer):
                 for p, v in zip(plist, saved):
                     p._value = v
 
+        step.__name__ = "llama_slot_step"
         from ..jit import capture as _capture
         if _capture.step_capture_enabled():
             return _capture.capture_step(step, donate=(2,))
@@ -426,6 +430,7 @@ class LlamaForCausalLM(Layer):
                 for p, v in zip(plist, saved):
                     p._value = v
 
+        step.__name__ = "llama_verify_step"
         from ..jit import capture as _capture
         if _capture.step_capture_enabled():
             return _capture.capture_step(step, donate=(2,))

@@ -19,6 +19,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..autograd.grad_mode import no_grad
 from ..core import generator as gen
 from ..core.tensor import Tensor
+from ..utils.memo import LockedLRU
 from . import mesh as mesh_mod
 
 SHARD_STATE_MIN_SIZE = 1024  # don't bother sharding tiny states
@@ -60,7 +61,9 @@ def _param_sharding_spec(p, mesh):
     return PartitionSpec(*clean)
 
 
-_warned_specs = set()
+# audited once-per-key registry (utils/memo idiom); the keyspace is
+# bounded by distinct (shape, axis, dim) triples, so no eviction
+_warned_specs = LockedLRU(maxsize=None)
 
 
 def _warn_dropped_spec(p, axis, dim):
@@ -70,7 +73,7 @@ def _warn_dropped_spec(p, axis, dim):
     key = (tuple(getattr(p, "shape", ())), str(axis), dim)
     if key in _warned_specs:
         return
-    _warned_specs.add(key)
+    _warned_specs.put(key, True)
     import logging
     logging.getLogger("paddle_tpu").warning(
         "sharding axis %r dropped for param of shape %s: dim %s not divisible "

@@ -13,7 +13,7 @@ from .profiler import (  # noqa: F401
     load_profiler_result, make_scheduler,
 )
 from .statistic import (  # noqa: F401
-    comm_summary, op_cache_summary, reshard_summary, serving_summary,
-    step_capture_summary,
+    comm_summary, lint_summary, op_cache_summary, reshard_summary,
+    serving_summary, step_capture_summary,
 )
 from .timer import benchmark  # noqa: F401

@@ -83,6 +83,32 @@ def step_capture_summary() -> str:
     return "\n".join(lines)
 
 
+def lint_summary() -> str:
+    """Per-step jaxpr-lint results (jit/passes/lint.py) as text: for every
+    recently-lowered captured step, its equation count and the semantic
+    findings the analyze-only lint pass recorded at lowering time
+    (recompile-hazard / donation-miss / unscheduled-collective /
+    dead-compute / host-callback). A healthy tree shows `clean` on every
+    row — the same rules gate CI through the staticcheck jaxpr tier, so a
+    finding here will fail `python -m tools.staticcheck --ci` once the
+    step is one of the canonical traced steps."""
+    from ..jit.passes import lint
+
+    records = lint.lint_records()
+    if not records:
+        return "jaxpr lint: no recorded lowerings"
+    head = f"{'Step':<28} {'Eqns':>6} {'Findings':>9}  Rules"
+    lines = [f"jaxpr lint: {len(records)} step(s) "
+             f"(enabled={lint.lint_enabled()})", head, "-" * len(head)]
+    for name, rec in records.items():
+        rules = ",".join(rec["rules_hit"]) or "clean"
+        lines.append(f"{name[:28]:<28} {rec['eqns']:>6} "
+                     f"{len(rec['findings']):>9}  {rules}")
+        for f in rec["findings"][:8]:
+            lines.append(f"    {f['rule']}: {f['message'][:100]}")
+    return "\n".join(lines)
+
+
 def serving_summary() -> str:
     """Live serving-engine counters (inference/serving) as text: admission
     funnel (submitted -> admitted -> finished / timed_out / rejected),

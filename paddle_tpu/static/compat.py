@@ -62,14 +62,34 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
 
 # ---- misc graph utilities ----
 
-_name_scopes: List[str] = []
+class _ScopeStack:
+    """Audited name-scope stack (utils/memo idiom: module state lives on a
+    locked instance, not a bare module-level list; see
+    tools/staticcheck/checkers/mutable_global.py for why)."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._stack: List[str] = []
+
+    def push(self, prefix: str):
+        with self._lock:
+            self._stack.append(prefix)
+
+    def pop(self):
+        with self._lock:
+            if self._stack:
+                self._stack.pop()
+
+
+_name_scopes = _ScopeStack()
 
 
 @contextlib.contextmanager
 def name_scope(prefix=None):
     """Hierarchical op-name prefix (reference framework name_scope); purely
     cosmetic here — XLA owns scheduling — but kept for profiler grouping."""
-    _name_scopes.append(prefix or "")
+    _name_scopes.push(prefix or "")
     try:
         yield
     finally:

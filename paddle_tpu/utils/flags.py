@@ -13,9 +13,12 @@ import os
 from typing import Any, Dict
 
 from . import native
+from .memo import LockedLRU
 
-_TYPES: Dict[str, type] = {}
-_PY_FALLBACK: Dict[str, str] = {}
+# audited registries (utils/memo idiom): genuinely bounded keyspaces —
+# one entry per defined flag — so eviction is disabled
+_TYPES: LockedLRU = LockedLRU(maxsize=None)
+_PY_FALLBACK: LockedLRU = LockedLRU(maxsize=None)
 
 
 def _store(name: str, val: str):
@@ -23,7 +26,7 @@ def _store(name: str, val: str):
     if lib is not None:
         lib.pt_flags_set(name.encode(), val.encode())
     else:
-        _PY_FALLBACK[name] = val
+        _PY_FALLBACK.put(name, val)
 
 
 def _load(name: str):
@@ -50,7 +53,7 @@ def _parse(name: str, raw: str):
 
 
 def define_flag(name: str, default, help_: str = ""):
-    _TYPES[name] = type(default)
+    _TYPES.put(name, type(default))
     env = os.environ.get(name)
     raw = env if env is not None else str(default)
     _store(name, raw)

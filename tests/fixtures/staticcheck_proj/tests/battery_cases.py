@@ -1,0 +1,11 @@
+"""Fixture battery: exercises ops by name, the governance route the
+registry-consistency checker resolves through public `__all__` exports.
+A cases-table string key counts only because the table's values reach
+the package (parse-only fixture: the import never executes)."""
+import paddle_tpu as P
+
+CASES = {
+    "fixbattery": P.run_case,   # key governs; the value ties the table
+                                # to the package (a bare-config dict
+                                # would govern nothing)
+}

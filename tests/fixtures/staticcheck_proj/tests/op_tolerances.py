@@ -13,6 +13,9 @@ FWD_OVERRIDES = {
     "fixtanh": {"float16": (1e-2, 1e-3)},
     # lacks bfloat16 with no skip -> dtype-rule-coverage fires
     "fixrelu": {"float16": (1e-2, 1e-3)},
+    # lacks bfloat16 but the family-sweep LOOP below records the skip:
+    # quiet (the loop-registered-skip resolution, PR 11)
+    "fixdtloop": {"float16": (1e-2, 1e-3)},
 }
 
 GRAD_OVERRIDES = {
@@ -26,3 +29,12 @@ SKIPS = {
     ("fixtanh", "fwd", "bfloat16"): "fixture: recorded skip covers the gap",
     ("fixlstm", "grad", "*"): "fixture: wildcard skip (no grad overrides)",
 }
+
+# family-sweep registration (the loop-registered form the extended
+# resolver follows): governs `fixloopskip` without a literal entry, and
+# covers fixdtloop's missing-bfloat16 hole for dtype-rule-coverage
+_LOOP_FAMILY = ("fixloopskip", "fixdtloop")
+for _op in _LOOP_FAMILY:
+    for _dt in ("bfloat16", "float16"):
+        for _chk in ("fwd", "grad"):
+            SKIPS.setdefault((_op, _chk, _dt), "fixture: family sweep")

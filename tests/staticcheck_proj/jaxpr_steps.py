@@ -1,0 +1,137 @@
+"""Jaxpr-tier known-answer fixture steps (never collected by pytest).
+
+One deliberately hazardous step per jaxpr rule, traced through the SAME
+capture machinery the canonical steps use (tools/staticcheck/jaxpr/steps
+``trace_step``), so the known-answer tests prove the whole pipeline —
+capture -> pass pipeline -> lint rules -> Finding mapping -> ratchet.
+
+``collect(root)`` is the PT_STATICCHECK_STEPS hook: pointing the CLI at
+this file swaps the canonical steps for these, which is how the tests
+demonstrate that `python -m tools.staticcheck --ci` exits nonzero on a
+NEW jaxpr-tier finding.
+
+`quantized_writeback_step` is the PR-10 regression net: the MULTICHIP
+write_back-before-rebuild donation bug donated an fp32 buffer whose
+value was rebuilt at a different dtype/shape, so nothing aliased the
+donation and the later host write_back read a deleted array — at the
+jaxpr level that is a donated input matching no output, exactly what
+``jaxpr-donation-miss`` reports.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _arr(shape, dtype=np.float32, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape)
+                       .astype(dtype))
+
+
+# ---- host-callback ---------------------------------------------------------
+
+def callback_step(x):
+    jax.debug.print("step sum={s}", s=jnp.sum(x))
+    return x + 1.0
+
+
+def pragma_callback_step(x):  # staticcheck: ok[jaxpr-host-callback] — fixture: deliberate allowlisted site
+    jax.debug.print("allowlisted sum={s}", s=jnp.sum(x))
+    return x + 1.0
+
+
+# ---- dead-compute (inside a scan body: beyond the DVE pass's reach) --------
+
+def dead_in_scan_step(x):
+    def body(c, t):
+        junk = jnp.exp(t) * jnp.sin(t)  # noqa: F841 — dead by design
+        return c + t, t
+    total, _ys = jax.lax.scan(body, jnp.zeros((), x.dtype), x)
+    return total
+
+
+# ---- recompile-hazard ------------------------------------------------------
+
+def weak_scalar_step(x, s):
+    # `s` arrives as a weak-typed scalar (a python float leaked in)
+    return x * s
+
+
+def _static_n_step(x, n):
+    return x + float(n)
+
+
+_churn_counter = [0]
+
+
+def churn_args():
+    # a varying python-int static: every call is a fresh signature
+    _churn_counter[0] += 1
+    return (_arr((8, 8)), _churn_counter[0])
+
+
+# ---- unscheduled-collective ------------------------------------------------
+
+def naked_collective_step(x):
+    # psum under pmap; traced with the comm pass EXCLUDED from the
+    # pipeline, so the collective has no CommOp tag
+    return jax.pmap(lambda v: jax.lax.psum(v, "i"),
+                    axis_name="i")(x[None])[0]
+
+
+def fp32_beside_quantized_step(x):
+    # the EQuARX replace-not-shadow violation: an int8 wire leg and a
+    # float32 psum on the SAME axis — the fp32 collective the quantized
+    # path was supposed to retire still runs beside it
+    def body(v):
+        wire = jax.lax.psum((v * 127.0).astype(jnp.int8), "i")
+        full = jax.lax.psum(v, "i")
+        return full + wire.astype(jnp.float32) / 127.0
+    return jax.pmap(body, axis_name="i")(x[None])[0]
+
+
+# ---- donation-miss ---------------------------------------------------------
+
+def quantized_writeback_step(w):
+    # donated fp32 param rebuilt as int8 blocks: no output matches the
+    # donated aval (the PR-10 write_back-before-rebuild shape)
+    scale = jnp.max(jnp.abs(w)) / 127.0
+    return (w / scale).astype(jnp.int8), scale
+
+
+def partial_donation_step(a, b):
+    # donate=(0,) only: `b` is equally donatable (an unclaimed matching
+    # output exists) — the step silently holds two copies of it
+    return a * 2.0, b * 2.0
+
+
+# ---- control ---------------------------------------------------------------
+
+def clean_step(x):
+    return jnp.tanh(x) * 2.0
+
+
+def collect(root):
+    """PT_STATICCHECK_STEPS entry point -> list[StepResult]."""
+    from tools.staticcheck.jaxpr.steps import trace_step
+
+    t = functools.partial(trace_step, root=root)
+    mk = lambda *shapes: (lambda: tuple(_arr(s) for s in shapes))  # noqa: E731
+    return [
+        t("fixture/callback", callback_step, mk((8, 8))),
+        t("fixture/pragma_callback", pragma_callback_step, mk((8, 8))),
+        t("fixture/dead_in_scan", dead_in_scan_step, mk((16,))),
+        t("fixture/weak_scalar", weak_scalar_step,
+          lambda: (_arr((8, 8)), jnp.asarray(3.0))),
+        t("fixture/signature_churn", _static_n_step, churn_args),
+        t("fixture/naked_collective", naked_collective_step, mk((4, 4)),
+          passes=("fusion", "cse", "dve")),
+        t("fixture/fp32_beside_quantized", fp32_beside_quantized_step,
+          mk((4, 4))),
+        t("fixture/quantized_writeback", quantized_writeback_step,
+          mk((64, 64)), donate=(0,)),
+        t("fixture/partial_donation", partial_donation_step,
+          mk((32, 32), (32, 32)), donate=(0,)),
+        t("fixture/clean", clean_step, mk((8, 8))),
+    ]

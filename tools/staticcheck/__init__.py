@@ -13,6 +13,13 @@ hazards statically:
 - ``mutable-global``     module globals written outside `set_*` installers
 - ``dead-export``        `__all__` names that don't resolve
 
+...plus the later rules (key-reuse, closure-capture, unbounded-blocking,
+dtype-rule-coverage, naked-collective) and the **jaxpr tier** (jaxpr/):
+the canonical captured steps traced through jit/capture.py and
+semantically linted (jaxpr-recompile-hazard, jaxpr-donation-miss,
+jaxpr-unscheduled-collective, jaxpr-dead-compute, jaxpr-host-callback) —
+both tiers share the Finding model, pragma allowlist, and baseline.
+
 Run `python -m tools.staticcheck --help` for the CLI; the checked-in
 `baseline.json` makes the CI gate a ratchet (only NEW violations fail).
 """

@@ -5,9 +5,16 @@ Modes:
   --ci                 apply the baseline ratchet; exit 1 on NEW findings
   --update-baseline    rewrite baseline.json from the current finding set
 
+Both tiers run in one invocation: the AST tier over the scan paths, and
+(for a default whole-repo scan of THIS repo) the jaxpr tier — the
+canonical captured steps traced and semantically linted (see jaxpr/).
+`--no-jaxpr`, a scoped path list, or PT_STATICCHECK_FAST=1 skips the
+jaxpr trace (the in-process tier-1 gate uses the env to stay inside its
+wall-clock share).
+
 Examples:
   python -m tools.staticcheck                       # full report
-  python -m tools.staticcheck --ci                  # the CI gate
+  python -m tools.staticcheck --ci                  # the CI gate (2 tiers)
   python -m tools.staticcheck --rules host-sync paddle_tpu/ops
   python -m tools.staticcheck --json > findings.json
 """
@@ -46,27 +53,54 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit JSON instead of text")
     ap.add_argument("--list-rules", action="store_true",
                     help="list registered rule ids and exit")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip the jaxpr tier (canonical-step trace)")
     args = ap.parse_args(argv)
+
+    from . import jaxpr as jaxpr_tier
 
     if args.list_rules:
         for c in sorted(all_checkers(), key=lambda c: c.rule):
             mod = sys.modules[type(c).__module__]
             doc = (mod.__doc__ or "").strip().splitlines()
             print(f"{c.rule:24s} [{c.severity}] {doc[0] if doc else ''}")
+        for r in jaxpr_tier.JAXPR_RULES:
+            print(f"{r:24s} [warning] jaxpr tier (tools/staticcheck/jaxpr)")
         return 0
 
     rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
     findings = run(args.root, paths=args.paths or None, rules=rules)
+    # jaxpr tier: whole-repo scans of THIS repo only (the canonical steps
+    # are this repo's; a fixture root has its own via PT_STATICCHECK_STEPS)
+    want_jaxpr = not args.no_jaxpr and not args.paths \
+        and not jaxpr_tier.fast_mode() \
+        and (os.environ.get(jaxpr_tier.steps_env()) is not None
+             or os.path.realpath(args.root) == os.path.realpath(REPO_ROOT))
+    jaxpr_collected = False
+    if want_jaxpr and (rules is None
+                      or any(r.startswith("jaxpr-") for r in rules)):
+        jx = jaxpr_tier.collect_findings(args.root)
+        jaxpr_collected = not jaxpr_tier.fast_mode()
+        if rules is not None:
+            jx = [f for f in jx if f.rule in set(rules)]
+            # a rules filter means the jaxpr findings are PARTIAL — the
+            # baseline-update path below must still preserve the rest
+            jaxpr_collected = False
+        findings = findings + jx
     baseline_path = args.baseline or DEFAULT_BASELINE
 
     if args.update_baseline:
         # scoped invocations merge: entries outside the scanned paths are
-        # preserved, so a partial scan can't resurface the rest as "new"
+        # preserved, so a partial scan can't resurface the rest as "new";
+        # likewise a run that SKIPPED the jaxpr tier must not drop its
+        # grandfathered jaxpr-* entries
         scanned = None
         if args.paths:
             scanned = [os.path.relpath(p, args.root) if os.path.isabs(p)
                        else p for p in args.paths]
-        save_baseline(findings, baseline_path, scanned_paths=scanned)
+        save_baseline(
+            findings, baseline_path, scanned_paths=scanned,
+            preserve_rule_prefix=None if jaxpr_collected else "jaxpr-")
         print(f"baseline updated: {len(findings)} finding(s) recorded"
               + (f" under {', '.join(scanned)}" if scanned else "")
               + f" -> {baseline_path}")

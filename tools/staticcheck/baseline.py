@@ -22,13 +22,21 @@ def _counts(findings: list[Finding]) -> dict[str, int]:
 
 
 def save_baseline(findings: list[Finding], path: str = DEFAULT_BASELINE,
-                  scanned_paths=None) -> None:
+                  scanned_paths=None, preserve_rule_prefix=None) -> None:
     """Rewrite the baseline from `findings`. With `scanned_paths` (a partial
     scan), only entries whose file lives under one of those paths are
     replaced; everything else is preserved — a scoped `--update-baseline
     some/dir` must not silently drop the grandfathered findings the scan
-    never visited."""
+    never visited. With `preserve_rule_prefix`, existing entries whose
+    rule starts with it survive the rewrite — used when a whole tier
+    (the jaxpr trace) was skipped, so the update cannot silently drop its
+    grandfathered keys."""
     counts = _counts(findings)
+    if preserve_rule_prefix:
+        kept = {k: v for k, v in load_baseline(path).items()
+                if k.split("::", 1)[0].startswith(preserve_rule_prefix)
+                and k not in counts}
+        counts = dict(sorted({**kept, **counts}.items()))
     if scanned_paths:
         prefixes = tuple(p.strip("/").rstrip("/") for p in scanned_paths)
 

@@ -13,10 +13,10 @@ for that (op, leg, dtype) in the SKIPS table.
 Pre-existing holes are baselined (the ratchet stops the set growing); a
 NEW op must record every swept dtype — a pair or a reasoned skip.
 
-Limits: only the literal SKIPS dict is read (the family-level loop-added
-skips are invisible to static parsing, same as the registry-consistency
-pass) — if a loop-skipped family ever gains an override entry, record a
-literal skip or pragma the entry.
+Skips are read from the literal SKIPS dict AND from the family-level
+loop registrations (`for _op in _LINALG_OPS: SKIPS.setdefault(...)`) via
+the resolver shared with the registry-consistency pass — a loop-skipped
+family never counts as an uncovered hole.
 """
 from __future__ import annotations
 
@@ -104,6 +104,9 @@ class DtypeRuleCoverageChecker(Checker):
             return  # no tolerance registry in this tree
         swept = _sweep_dtypes(project.root)
         skips = _literal_skips(assigns.get("SKIPS", ast.Dict([], [])))
+        from .registry_consistency import _family_skip_entries
+        skips |= {e for e in _family_skip_entries(project.root)
+                  if len(e) == 3}
         path = TOLERANCES_PATH.replace(os.sep, "/")
         for table, leg in _TABLES.items():
             for op, line, dtypes in _dict_entries(assigns.get(table)):

@@ -20,6 +20,26 @@ indirection: `apply(..., op_name=self.mode.lower())` where `__init__` binds
 `self.mode = <param>` resolves through the string constants subclasses
 pass to `super().__init__(...)` (and direct instantiations), lowercased
 when the site calls `.lower()` — the rnn.py LSTM/GRU dispatch shape.
+An implied name is only believed when it isn't shadowed by a local
+binding in the enclosing function (`apply(primal, ...)` where `primal`
+is a parameter is a helper, not an op).
+
+Governance resolution follows three routes (PR 11 burn-down — each has a
+known-answer fixture):
+
+1. the literal registries: FWD/GRAD_OVERRIDES keys, SKIPS keys,
+   OP_COVERAGE.json counts;
+2. family-sweep registrations: module-level `for _op in _FAMILY:
+   SKIPS.setdefault((_op, ...), ...)` loops over constant name
+   collections (the linalg/fft/selection recorded-skip idiom) — these
+   govern the ORPHAN direction only; a blanket family record is not a
+   per-op claim, so it never makes a name "stale";
+3. battery governance: an op whose name is public API (module-level
+   `__all__` export — including the loop-built `__all__.append` form —
+   or a public module-level def/alias assignment) AND is exercised by
+   name somewhere under tests/ (attribute/name reference or a cases-table
+   string key) is governed by that battery. Ops reachable only through
+   private indirection, or exercised by no battery, stay orphans.
 """
 from __future__ import annotations
 
@@ -35,18 +55,20 @@ COVERAGE_PATH = "OP_COVERAGE.json"
 _ENTRY_NAMES = {"apply", "defprim", "_wrap"}
 
 
-def _op_name_of_call(node: ast.Call) -> str | None:
-    """Static op name of one apply()/defprim()/_wrap() call, or None."""
+def _op_name_of_call(node: ast.Call) -> tuple[str | None, bool]:
+    """-> (static op name of one apply()/defprim()/_wrap() call or None,
+    implied?) — implied means the name came from the callable argument,
+    not an explicit op_name=/defprim literal."""
     for kw in node.keywords:
         if kw.arg == "op_name":
             if isinstance(kw.value, ast.Constant) \
                     and isinstance(kw.value.value, str):
-                return kw.value.value
-            return None  # op_name is dynamic — handled by factory pass
+                return kw.value.value, False
+            return None, False  # dynamic op_name — handled by factory pass
     if call_name(node) == "defprim" and len(node.args) > 1 \
             and isinstance(node.args[1], ast.Constant) \
             and isinstance(node.args[1].value, str):
-        return node.args[1].value
+        return node.args[1].value, False
     if node.args:
         a0 = node.args[0]
         implied = a0.id if isinstance(a0, ast.Name) else \
@@ -54,8 +76,39 @@ def _op_name_of_call(node: ast.Call) -> str | None:
         # local helper names (`apply(f, ...)`, `apply(_impl, ...)`) are not
         # op names — only believe an implied name that looks like one
         if implied and len(implied) > 2 and not implied.startswith("_"):
-            return implied
-    return None
+            return implied, True
+    return None, False
+
+
+def _locally_bound(node: ast.AST, name: str) -> bool:
+    """Is `name` a parameter / local binding of the function enclosing
+    `node`? An implied op name that is really a local variable
+    (`apply(primals, ...)` in a vjp helper) would otherwise surface as a
+    phantom ungoverned op."""
+    cur = getattr(node, "_sc_parent", None)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        cur = getattr(cur, "_sc_parent", None)
+    if cur is None:
+        return False
+    args = cur.args
+    params = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    for va in (args.vararg, args.kwarg):
+        if va is not None:
+            params.add(va.arg)
+    if name in params:
+        return True
+    for n in ast.walk(cur):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name) and leaf.id == name:
+                        return True
+        elif isinstance(n, (ast.For, ast.comprehension)):
+            for leaf in ast.walk(n.target):
+                if isinstance(leaf, ast.Name) and leaf.id == name:
+                    return True
+    return False
 
 
 def _factory_params(tree: ast.AST) -> dict[str, str]:
@@ -189,6 +242,253 @@ def _load_coverage_names(root: str) -> set[str] | None:
         return set(json.load(f).get("counts", {}))
 
 
+_REGISTRY_DICTS = {"SKIPS", "FWD_OVERRIDES", "GRAD_OVERRIDES"}
+
+
+def _const_str_seq(node: ast.AST, seqs: dict) -> list[str] | None:
+    """A constant sequence of strings: a literal tuple/list, or a Name
+    bound at module level to one (collected into `seqs`)."""
+    if isinstance(node, ast.Name):
+        return seqs.get(node.id)
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, TypeError, SyntaxError):
+        return None
+    if isinstance(v, (tuple, list)) and v \
+            and all(isinstance(x, str) for x in v):
+        return list(v)
+    return None
+
+
+def _family_skip_entries(root: str) -> set[tuple]:
+    """Registry keys registered by module-level family-sweep loops
+    (`for _op in _LINALG_OPS: SKIPS.setdefault((_op, check, dt), reason)`)
+    — the alias-collection registration the literal parser above can't
+    follow. Keys expand the cross product of every loop-bound element;
+    unresolvable elements become the ``"*"`` wildcard. Shared with the
+    dtype-rule-coverage checker so a loop-skipped family never counts as
+    an uncovered hole."""
+    path = os.path.join(root, TOLERANCES_PATH)
+    if not os.path.exists(path):
+        return set()
+    from ..core import parse_file_cached
+    tree = parse_file_cached(root, path).tree
+    seqs: dict[str, list[str]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            vals = _const_str_seq(node.value, {})
+            if vals:
+                seqs[node.targets[0].id] = vals
+    entries: set[tuple] = set()
+
+    def elt_values(e: ast.AST, bindings: dict) -> list[str]:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            return [e.value]
+        if isinstance(e, ast.Name) and e.id in bindings:
+            return bindings[e.id]
+        return ["*"]
+
+    def key_entries(key: ast.AST, bindings: dict) -> list[tuple]:
+        elts = key.elts if isinstance(key, ast.Tuple) else [key]
+        out: list[tuple] = [()]
+        for e in elts:
+            out = [t + (v,) for t in out for v in elt_values(e, bindings)]
+        # a key whose op element is unresolved governs nothing
+        return [t for t in out if t and t[0] != "*"]
+
+    def walk(stmts, bindings: dict):
+        for stmt in stmts:
+            if isinstance(stmt, ast.For):
+                vals = _const_str_seq(stmt.iter, seqs)
+                inner = dict(bindings)
+                if vals is not None and isinstance(stmt.target, ast.Name):
+                    inner[stmt.target.id] = vals
+                walk(stmt.body, inner)
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("setdefault", "update") \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in _REGISTRY_DICTS \
+                        and node.args:
+                    entries.update(key_entries(node.args[0], bindings))
+                elif isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Subscript) \
+                        and isinstance(node.targets[0].value, ast.Name) \
+                        and node.targets[0].value.id in _REGISTRY_DICTS:
+                    entries.update(
+                        key_entries(node.targets[0].slice, bindings))
+
+    walk(tree.body, {})
+    return entries
+
+
+def _load_family_skip_names(root: str) -> set[str]:
+    """Op names of the family-sweep registrations. Orphan-direction
+    governance only: a blanket family record never makes a name
+    'stale'."""
+    return {e[0] for e in _family_skip_entries(root)}
+
+
+_TEST_SCAN_EXCLUDE = {"__pycache__", "fixtures", "staticcheck_proj"}
+
+
+def _public_surface(project: Project) -> set[str]:
+    """Names the scanned package exports: module-level `__all__` entries
+    (literal assigns, `+=`, `.extend(...)`, `.append(...)` — including
+    appends loop-bound over constant name collections) plus public
+    module-level defs and alias assignments (`acos = _unop("acos", ...)`)."""
+    out: set[str] = set()
+    for mod in project.modules:
+        if not mod.path.startswith("paddle_tpu"):
+            continue
+        seqs: dict[str, list[str]] = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                vals = _const_str_seq(node.value, {})
+                if vals:
+                    seqs[node.targets[0].id] = vals
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and not node.name.startswith("_"):
+                out.add(node.name)
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, (ast.Call, ast.Name, ast.Attribute)):
+                # alias registrations only (`acos = _unop("acos", ...)`,
+                # re-binds of callables) — a constant assignment like
+                # `PAGE_SIZE = 16` is config, not public-op surface
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and not t.id.startswith("_") \
+                            and t.id != "__all__":
+                        out.add(t.id)
+        for node in ast.walk(mod.tree):
+            lit = None
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == "__all__"
+                            for t in node.targets):
+                lit = node.value
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.target.id == "__all__":
+                lit = node.value
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("append", "extend") \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "__all__" and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                    out.add(a0.value)
+                    continue
+                if isinstance(a0, ast.Name):
+                    # `for name in _NAMES: __all__.append(name)` — resolve
+                    # through the nearest enclosing for over a const seq
+                    cur = getattr(node, "_sc_parent", None)
+                    while cur is not None:
+                        if isinstance(cur, ast.For) \
+                                and isinstance(cur.target, ast.Name) \
+                                and cur.target.id == a0.id:
+                            vals = _const_str_seq(cur.iter, seqs)
+                            if vals:
+                                out.update(vals)
+                            break
+                        cur = getattr(cur, "_sc_parent", None)
+                    continue
+                lit = a0
+            if lit is not None:
+                try:
+                    v = ast.literal_eval(lit)
+                except (ValueError, TypeError, SyntaxError):
+                    continue
+                if isinstance(v, (tuple, list)):
+                    out.update(x for x in v if isinstance(x, str))
+    return out
+
+
+_PKG = "paddle_tpu"
+
+
+def _module_pkg_aliases(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """-> (attr_bases, bare_names) the module binds to the package:
+    `import paddle_tpu as P` / `import paddle_tpu.nn.functional as F`
+    give attribute bases; `from paddle_tpu.x import name [as n]` gives
+    bare names (the imported name is itself a package reference)."""
+    bases: set[str] = set()
+    bare: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == _PKG or a.name.startswith(_PKG + "."):
+                    bases.add(a.asname or a.name.split(".", 1)[0])
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and (node.module == _PKG
+                     or node.module.startswith(_PKG + ".")):
+            for a in node.names:
+                bare.add(a.asname or a.name)
+                bare.add(a.name)
+    return bases, bare
+
+
+def _battery_references(root: str) -> set[str]:
+    """Names the test batteries under <root>/tests/ reference THROUGH the
+    package: attributes whose base resolves to a paddle_tpu import alias
+    (`P.acos`, `F.relu`, OpTest cases passing `P.acos` uncalled), names
+    imported from the package (`from paddle_tpu.models import ...`), and
+    string keys of dict-literal cases tables (`"acos": Case(...)`).
+    Incidental identifiers — loop variables, builtins, np./jnp. usage —
+    never count (an op must be exercised via the package to be battery
+    governed). The registry file itself (op_tolerances.py) is excluded —
+    references there ARE the registry, already loaded above."""
+    from ..astutil import attr_root
+    from ..core import parse_file_cached
+    refs: set[str] = set()
+    tests_dir = os.path.join(root, "tests")
+    if not os.path.isdir(tests_dir):
+        return refs
+    for dirpath, dirs, files in os.walk(tests_dir):
+        dirs[:] = sorted(d for d in dirs if d not in _TEST_SCAN_EXCLUDE)
+        for fn in sorted(files):
+            if not fn.endswith(".py") or fn == "op_tolerances.py":
+                continue
+            try:
+                mod = parse_file_cached(root, os.path.join(dirpath, fn))
+            except (SyntaxError, OSError):
+                continue
+            bases, bare = _module_pkg_aliases(mod.tree)
+            refs |= bare
+
+            def pkg_ref_in(node) -> bool:
+                for n in ast.walk(node):
+                    if isinstance(n, ast.Attribute) \
+                            and attr_root(n) in bases:
+                        return True
+                    if isinstance(n, ast.Name) and n.id in bare:
+                        return True
+                return False
+
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Attribute):
+                    if attr_root(node) in bases:
+                        refs.add(node.attr)
+                elif isinstance(node, ast.Dict):
+                    # cases-table keys count only when the table's VALUES
+                    # reach the package (`"acos": Case(P.acos, ...)`) —
+                    # a config dict like {"dropout": 0.1} governs nothing
+                    if not any(v is not None and pkg_ref_in(v)
+                               for v in node.values):
+                        continue
+                    for k in node.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str) \
+                                and k.value.isidentifier():
+                            refs.add(k.value)
+    return refs
+
+
 @register
 class RegistryConsistencyChecker(Checker):
     rule = "registry-consistency"
@@ -225,8 +525,9 @@ class RegistryConsistencyChecker(Checker):
             if isinstance(node, ast.Call):
                 self._calls.append((mod, node))
                 if call_name(node) in _ENTRY_NAMES:
-                    name = _op_name_of_call(node)
-                    if name:
+                    name, implied = _op_name_of_call(node)
+                    if name and not (implied
+                                     and _locally_bound(node, name)):
                         self._sites.setdefault(name, (mod, node))
         return ()
 
@@ -306,13 +607,19 @@ class RegistryConsistencyChecker(Checker):
         self._resolve_factory_sites()
         self._resolve_self_attr_sites()
         registry = (tol or set()) | (cov or set())
-        for name in sorted(set(self._sites) - registry):
+        # orphan-direction governance beyond the literal registries:
+        # family-sweep skip loops + battery-exercised public ops
+        family = _load_family_skip_names(project.root)
+        battery = _public_surface(project) & _battery_references(project.root)
+        governed = registry | family | battery
+        for name in sorted(set(self._sites) - governed):
             mod, node = self._sites[name]
             yield mod.finding(
                 self.rule, self.severity, node,
                 f"op {name!r} is dispatched here but has no tolerance "
-                f"entry in {TOLERANCES_PATH} and no {COVERAGE_PATH} record "
-                f"— ungoverned ops can silently regress",
+                f"entry in {TOLERANCES_PATH}, no {COVERAGE_PATH} record, "
+                f"no family-sweep skip, and no test battery references it "
+                f"by name — ungoverned ops can silently regress",
                 context=name)
         for name in sorted(registry - set(self._sites)):
             where = []

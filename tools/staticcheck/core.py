@@ -11,6 +11,7 @@ import ast
 import dataclasses
 import os
 import re
+import threading
 from typing import Iterable, Iterator
 
 SEVERITIES = ("error", "warning", "info")
@@ -99,6 +100,46 @@ class Module:
                        else self.line_text(line))
 
 
+class _ModuleCache:
+    """Parsed-Module cache keyed by (root, path, mtime, size), shared by
+    every checker and every run() in one process — the in-process tier-1
+    gate scans the repo and the fixture projects several times, and
+    re-parsing ~400 files each time dominated its wall clock. State lives
+    on this instance under a lock (the utils/memo audited-container
+    idiom); a stale file (new mtime/size) reparses transparently."""
+
+    def __init__(self, maxsize: int = 4096):
+        self._d: dict = {}
+        self._lock = threading.Lock()
+        self._maxsize = maxsize
+
+    def get_or_parse(self, root: str, path: str) -> Module:
+        try:
+            st = os.stat(path)
+            key = (root, os.path.abspath(path), st.st_mtime_ns, st.st_size)
+        except OSError:
+            return Module(root, path)
+        with self._lock:
+            mod = self._d.get(key)
+        if mod is not None:
+            return mod
+        mod = Module(root, path)
+        with self._lock:
+            if len(self._d) >= self._maxsize:
+                self._d.clear()  # full flush: keys are cheap to rebuild
+            self._d[key] = mod
+        return mod
+
+
+_MODULE_CACHE = _ModuleCache()
+
+
+def parse_file_cached(root: str, path: str) -> Module:
+    """Cached Module for any file (checkers use this for registries and
+    test batteries that live outside the scan paths)."""
+    return _MODULE_CACHE.get_or_parse(root, path)
+
+
 class Project:
     """The set of modules under analysis plus the project root (so cross-file
     checkers can reach registries that live outside the scan paths)."""
@@ -150,7 +191,7 @@ def load_project(root: str, paths: Iterable[str] | None = None) -> Project:
     modules = []
     for f in iter_py_files(root, paths):
         try:
-            modules.append(Module(root, f))
+            modules.append(_MODULE_CACHE.get_or_parse(root, f))
         except SyntaxError as e:
             raise SyntaxError(f"staticcheck cannot parse {f}: {e}") from e
     return Project(root, modules)

@@ -1,0 +1,137 @@
+"""Jaxpr tier: semantic analysis of the repo's captured step programs.
+
+The AST tier (the checkers/ package) can only see Python source; since
+the whole-step capture substrate landed, every hot path — TrainStep,
+to_static, the serving decode/verify steps — runs through a captured
+jaxpr where the real hazards live.  This tier traces the canonical steps
+through the repo's own capture machinery (steps.py) and runs the shared
+rule engine ``paddle_tpu/jit/passes/lint.py`` over the closed jaxprs,
+wrapping each hit into the existing :class:`Finding` model so the
+pragma allowlist and the ``baseline.json`` ratchet cover both tiers in
+one ``python -m tools.staticcheck --ci`` invocation.
+
+Rules (prefixed ``jaxpr-`` to keep the namespace distinct from the AST
+rules; definitions live in jit/passes/lint.py so the in-process
+``profiler.lint_summary()`` view and this gate can never drift):
+
+- ``jaxpr-recompile-hazard``       weak_type avals on program inputs,
+  signature churn on equivalent re-capture, and capture bailouts of a
+  canonical step (a step that silently rides the eager tier re-pays
+  dispatch every call — the hazard the capture tier exists to remove)
+- ``jaxpr-donation-miss``          donatable-but-not-donated inputs;
+  donated inputs matching no output (write_back-before-rebuild class)
+- ``jaxpr-unscheduled-collective`` collective eqns with no comm-pass
+  tag, and fp32 collectives running beside a quantized wire leg
+- ``jaxpr-dead-compute``           dead subgraphs beyond DVE's reach
+- ``jaxpr-host-callback``          callback/IO eqns inside a step
+
+Findings anchor at the step-builder's def line, so one
+``# staticcheck: ok[jaxpr-...]`` pragma there is the deliberate-site
+allowlist, same as the AST tier.
+
+Tracing imports paddle_tpu (CPU backend forced); ``PT_STATICCHECK_FAST=1``
+skips the tier entirely — the in-process tier-1 gate uses that to stay
+inside its wall-clock share while the standalone CLI gate runs both
+tiers.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..core import Finding, parse_file_cached
+
+# mirrors paddle_tpu/jit/passes/lint.py RULES (asserted in tests); kept
+# literal so `--list-rules` never has to import paddle_tpu
+RULE_PREFIX = "jaxpr-"
+JAXPR_RULES = ("jaxpr-recompile-hazard", "jaxpr-donation-miss",
+               "jaxpr-unscheduled-collective", "jaxpr-dead-compute",
+               "jaxpr-host-callback")
+
+FAST_ENV = "PT_STATICCHECK_FAST"
+
+
+def fast_mode() -> bool:
+    return os.environ.get(FAST_ENV, "").lower() in ("1", "true", "yes")
+
+
+def steps_env() -> str:
+    """Name of the steps-override env var (steps.py owns the constant but
+    importing it must stay lazy — it pulls paddle_tpu on first trace)."""
+    return "PT_STATICCHECK_STEPS"
+
+
+def _mk(rule: str, path: str, line: int, message: str,
+        context: str) -> Finding:
+    return Finding(rule=rule, severity="warning", path=path, line=line,
+                   col=0, message=message, context=context)
+
+
+def _step_findings(step, root: str) -> List[Finding]:
+    from paddle_tpu.jit.passes import lint
+
+    out: List[Finding] = []
+    if step.program is None:
+        out.append(_mk(
+            "jaxpr-recompile-hazard", step.anchor_path, step.anchor_line,
+            f"canonical step {step.name!r} failed capture "
+            f"({step.error}) — it silently rides the eager tier, "
+            f"re-paying python dispatch every call",
+            f"{step.name}:capture-bailout"))
+        return out
+    if step.churn:
+        out.append(_mk(
+            "jaxpr-recompile-hazard", step.anchor_path, step.anchor_line,
+            f"step {step.name!r} re-lowered (or fell back) on a second "
+            f"call with equivalent inputs — the cache key churns "
+            f"(python scalar, fresh closure, or unhashable static in the "
+            f"signature)",
+            f"{step.name}:signature-churn"))
+    for f in lint.analyze(step.program.closed_jaxpr,
+                          donated=step.program.donate,
+                          comm_tagged=lint.comm_tagged_of(
+                              step.program.pass_report),
+                          name=step.name):
+        out.append(_mk(
+            RULE_PREFIX + f["rule"], step.anchor_path, step.anchor_line,
+            f"[{step.name}] {f['message']}",
+            f"{step.name}:{f['detail']}"))
+    return out
+
+
+def collect_findings(root: str, steps=None,
+                     steps_file: Optional[str] = None) -> List[Finding]:
+    """Trace the canonical steps (or ``steps``/``steps_file`` overrides)
+    and return ratchet-ready findings, pragma suppression applied at each
+    finding's anchor line."""
+    if fast_mode():
+        return []
+    if steps is None:
+        from . import steps as steps_mod
+        try:
+            steps = steps_mod.load_steps(root, steps_file=steps_file)
+        except Exception as e:  # noqa: BLE001 — keep the AST tier's results
+            return [_mk(
+                "jaxpr-recompile-hazard", "tools/staticcheck/jaxpr/steps.py",
+                1,
+                f"canonical-step tracing failed to even start "
+                f"({type(e).__name__}: {str(e)[:160]}) — the jaxpr tier "
+                f"is blind; fix the step builders",
+                "canonical:load-failure")]
+    findings: List[Finding] = []
+    for step in steps:
+        findings.extend(_step_findings(step, root))
+    # pragma allowlist: same semantics as the AST tier, applied at the
+    # anchor (step-builder def) line; anchors ride the shared parse cache
+    kept: List[Finding] = []
+    for f in findings:
+        if not f.path.startswith("<"):
+            try:
+                mod = parse_file_cached(root, os.path.join(root, f.path))
+            except Exception:  # noqa: BLE001 — unreadable anchor: keep
+                mod = None
+            if mod is not None and mod.suppressed(f.rule, f.line):
+                continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.context))
+    return kept

@@ -1,0 +1,253 @@
+"""Canonical captured steps the jaxpr tier traces.
+
+The AST tier scans source; this module produces the *programs* the rules
+run over: each canonical step is traced through the repo's own capture
+machinery (jit/capture.py) exactly the way production code builds it —
+TrainStep on the proxy llama, the serving batch-slot decode and
+speculative-verify steps, and a to_static program — so the findings are
+about what actually lowers, not a synthetic re-trace.
+
+Every step is captured TWICE with equivalent fresh inputs. A second
+lowering (or a fallback call) on value-equal avals is the signature-churn
+form of the recompile hazard: something non-aval (a fresh closure, a
+python scalar, an unhashable static) leaked into the cache key.
+
+``PT_STATICCHECK_STEPS=/path/to/module.py`` swaps the canonical set for a
+module exposing ``collect() -> list[StepResult]`` (the known-answer
+fixture projects use this; ``trace_step`` below is the helper they build
+on). Models are deliberately tiny — this is a linter, not a benchmark.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import os
+import runpy
+from typing import Callable, List, Optional
+
+STEPS_ENV = "PT_STATICCHECK_STEPS"
+
+
+@dataclasses.dataclass
+class StepResult:
+    """One traced canonical step, ready for the rules."""
+    name: str
+    anchor_path: str          # project-root-relative file to report against
+    anchor_line: int          # pragma line: `# staticcheck: ok[rule]` here
+    program: object = None    # GraftProgram (None when capture failed)
+    churn: bool = False       # re-lowered / fell back on equivalent inputs
+    error: str = ""           # capture-bailout reason when program is None
+
+
+def _anchor(obj, root: str):
+    try:
+        path = os.path.relpath(inspect.getsourcefile(obj), root)
+        line = inspect.getsourcelines(obj)[1]
+        return path.replace(os.sep, "/"), line
+    except Exception:  # noqa: BLE001 — builtins/C callables: best effort
+        return "<unknown>", 1
+
+
+def trace_step(name: str, fn: Callable, make_args: Callable[[], tuple],
+               *, root: str, donate="off", passes=None,
+               allow_baked_rng: bool = True,
+               anchor=None) -> StepResult:
+    """Capture ``fn`` twice via capture_step with fresh equivalent args
+    from ``make_args()``; returns the StepResult the rules consume."""
+    from paddle_tpu.jit import capture
+
+    path, line = _anchor(anchor if anchor is not None else fn, root)
+    wrapper = capture.capture_step(fn, donate=donate, passes=passes,
+                                   allow_baked_rng=allow_baked_rng)
+    try:
+        wrapper(*make_args())
+        wrapper(*make_args())
+    except Exception as e:  # noqa: BLE001 — a crashing step is a bailout
+        return StepResult(name, path, line,
+                          error=f"{type(e).__name__}: {e}"[:200])
+    info = wrapper.cache_info()
+    programs = wrapper.programs()
+    if not programs:
+        return StepResult(name, path, line,
+                          error=wrapper.bailout_reason()
+                          or "capture produced no program")
+    return StepResult(name, path, line, program=programs[0],
+                      churn=info["lowerings"] != 1)
+
+
+# ---------------------------------------------------------------------------
+# the canonical set
+# ---------------------------------------------------------------------------
+
+def _tiny_llama():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=2,
+                           inter=64, seq=16)
+    return cfg, LlamaForCausalLM(cfg)
+
+
+def _train_step(root: str) -> StepResult:
+    """TrainStep on the proxy llama — the lower_step path (donation via
+    donate_argnums, shardings None on the single-device proxy)."""
+    import numpy as np
+
+    import paddle_tpu as P
+    from paddle_tpu.jit import capture
+    from paddle_tpu.parallel import trainer as trainer_mod
+
+    path, line = _anchor(trainer_mod.TrainStep._build, root)
+    try:
+        P.seed(1234)
+        cfg, model = _tiny_llama()
+        opt = P.optimizer.AdamW(learning_rate=1e-3,
+                                parameters=model.parameters())
+        step = trainer_mod.compile_train_step(
+            model,
+            lambda m, b: m.compute_loss(b["input_ids"], b["labels"]), opt)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, size=(2, 8)).astype("int32")
+        batch = {"input_ids": P.to_tensor(ids), "labels": P.to_tensor(ids)}
+        step(batch)
+        before = capture.capture_info()
+        step(batch)  # equivalent avals: must ride the captured executable
+        after = capture.capture_info()
+    except Exception as e:  # noqa: BLE001 — a build failure is a bailout
+        return StepResult("trainstep/llama", path, line,
+                          error=f"{type(e).__name__}: {e}"[:200])
+    prog = step.captured_program
+    if prog is None:
+        return StepResult("trainstep/llama", path, line,
+                          error=capture.capture_info()["last_bailout"]
+                          or "lower_step fell back to plain jit")
+    churn = after["fallback_calls"] > before["fallback_calls"] \
+        or after["lowerings"] > before["lowerings"]
+    return StepResult("trainstep/llama", path, line, program=prog,
+                      churn=churn)
+
+
+def _serving_steps(root: str) -> List[StepResult]:
+    """The engine's batch-slot decode step and the speculative verify
+    step, captured exactly as inference/serving builds them (KV caches
+    donated, per-slot offsets)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as P
+    from paddle_tpu.models import llama as llama_mod
+
+    try:
+        P.seed(1234)
+        cfg, model = _tiny_llama()
+        B, W = 2, 3
+        params = [p._value for p in model.parameters()]
+
+        def cache_args():
+            return [(kc._value, vc._value) for kc, vc in
+                    model.init_kv_caches(B, cfg.max_position_embeddings)]
+
+        tok = jnp.asarray(np.zeros((B, 1), np.int32))
+        win = jnp.asarray(np.zeros((B, W), np.int32))
+        off = jnp.zeros((B,), jnp.int32)
+        last = jnp.zeros((B,), jnp.int32)
+
+        out = []
+        slot = model._build_slot_step()
+        out.append(_wrapped_result(
+            "serving/slot_step", slot, root, model._build_slot_step,
+            lambda: (params, tok, cache_args(), off, last)))
+        verify = model._build_verify_step()
+        out.append(_wrapped_result(
+            "serving/verify_step", verify, root, model._build_verify_step,
+            lambda: (params, win, cache_args(), off)))
+        return out
+    except Exception as e:  # noqa: BLE001 — a build failure is a bailout
+        path, line = _anchor(llama_mod.LlamaForCausalLM, root)
+        err = f"{type(e).__name__}: {e}"[:200]
+        return [StepResult("serving/slot_step", path, line, error=err),
+                StepResult("serving/verify_step", path, line, error=err)]
+
+
+def _wrapped_result(name: str, wrapper, root: str, anchor,
+                    make_args) -> StepResult:
+    """Drive an already-built CapturedStep twice (the model step builders
+    pick their own donate config) and package the result."""
+    path, line = _anchor(anchor, root)
+    try:
+        wrapper(*make_args())
+        wrapper(*make_args())
+    except Exception as e:  # noqa: BLE001
+        return StepResult(name, path, line,
+                          error=f"{type(e).__name__}: {e}"[:200])
+    info = getattr(wrapper, "cache_info", lambda: {})()
+    programs = getattr(wrapper, "programs", lambda: [])()
+    if not programs:
+        reason = getattr(wrapper, "bailout_reason", lambda: "")()
+        return StepResult(name, path, line,
+                          error=reason or "capture produced no program "
+                                "(step fell back to the eager tier)")
+    return StepResult(name, path, line, program=programs[0],
+                      churn=info.get("lowerings", 1) != 1)
+
+
+def _to_static_step(root: str) -> StepResult:
+    """A to_static-compiled layer — the jit.api lower_step path."""
+    import numpy as np
+
+    import paddle_tpu as P
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import api as jit_api
+
+    path, line = _anchor(jit_api.StaticFunction._build, root)
+    try:
+        P.seed(1234)
+        model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                              nn.Linear(16, 4))
+        static = P.to_static(model)
+        x = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        static(P.to_tensor(x))
+        static(P.to_tensor(x.copy()))
+        sf = model._static_function
+    except Exception as e:  # noqa: BLE001 — a build failure is a bailout
+        return StepResult("to_static/mlp", path, line,
+                          error=f"{type(e).__name__}: {e}"[:200])
+    progs = [e[0].captured_program for e in sf.concrete_programs
+             if getattr(e[0], "captured_program", None) is not None]
+    if not progs:
+        return StepResult("to_static/mlp", path, line,
+                          error="to_static compile did not capture "
+                                "(lower_step fell back to plain jit)")
+    return StepResult("to_static/mlp", path, line, program=progs[0],
+                      churn=len(sf.concrete_programs) != 1)
+
+
+def _force_cpu():
+    """A linter must never grab the accelerator; env alone is not enough
+    because a sitecustomize may re-register a TPU plugin and override
+    jax_platforms (see tests/conftest.py), so force it at config level."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already initialized: keep it
+        pass
+
+
+def canonical_steps(root: str) -> List[StepResult]:
+    """Trace the repo's canonical steps on the CPU backend."""
+    _force_cpu()
+    results = [_train_step(root)]
+    results += _serving_steps(root)
+    results.append(_to_static_step(root))
+    return results
+
+
+def load_steps(root: str,
+               steps_file: Optional[str] = None) -> List[StepResult]:
+    """The canonical set, or the module named by PT_STATICCHECK_STEPS /
+    ``steps_file`` (must expose ``collect(root) -> list[StepResult]``)."""
+    target = steps_file or os.environ.get(STEPS_ENV)
+    if target:
+        _force_cpu()
+        mod = runpy.run_path(target)
+        return list(mod["collect"](root))
+    return canonical_steps(root)
