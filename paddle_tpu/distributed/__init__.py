@@ -24,6 +24,8 @@ from .checkpoint import (  # noqa: F401
 )
 from . import chaos  # noqa: F401
 from . import comms  # noqa: F401
+from . import embedding  # noqa: F401
+from .embedding import ShardedEmbedding  # noqa: F401
 # `reshard` is deliberately NOT in the auto_parallel import list above:
 # the live-resharding SUBMODULE owns the name and is itself callable
 # (delegating to auto_parallel.api.reshard), so `dist.reshard(x, mesh,

@@ -18,7 +18,8 @@ See README "Quantized collectives & comm schedules".
 """
 from .api import (  # noqa: F401
     comm_deadline, comms_cache_key, grad_sync, quant_state, quantized,
-    quantized_all_reduce, wire_all_gather, wire_all_reduce,
+    quantized_all_reduce, wire_all_gather, wire_all_reduce, wire_all_to_all,
+    wire_exchange,
 )
 from .quantize import (  # noqa: F401
     DEFAULT_BLOCK, dequantize_blockwise, logical_bytes, quantize_blockwise,

@@ -60,7 +60,7 @@ from .schedule import CommOp, record
 __all__ = [
     "quantized", "quant_state", "comms_cache_key", "comm_deadline",
     "grad_sync", "quantized_all_reduce", "wire_all_reduce",
-    "wire_all_gather",
+    "wire_all_gather", "wire_all_to_all", "wire_exchange",
 ]
 
 # chaos sites — registered at import so the fault matrix enumerates them
@@ -328,6 +328,79 @@ def wire_all_gather(v, axis, *, owner: str = "collective",
     _record(owner, "all_gather", axis, v, n - 1, None, dl,
             _state.block)
     return jax.lax.all_gather(v, axis)  # staticcheck: ok[naked-collective] — the comms layer's own exact path
+
+
+def wire_all_to_all(v, axis, *, owner: str = "collective",
+                    exact: bool = False, budget: Optional[float] = None):
+    """Block exchange over the bound mesh axis (inside shard_map).
+
+    ``v`` is ``[n, ...]`` with ``n == axis size``: block ``j`` lands on
+    rank ``j``, and the result stacks the block every peer addressed to
+    THIS rank at dim 0 (``[n, ...]`` again) — the dispatch/combine
+    traffic pattern of sharded-embedding lookups and MoE routing.
+
+    With the quantized context on and a floating payload, each of the
+    ``n`` destination blocks rides the wire as int8/fp8 + per-block fp32
+    scales (one quantize per destination, so scales never straddle
+    ranks); int payloads (id exchanges) and ``exact=True`` traffic stay
+    full precision and bitwise.  Always recorded: logical bytes count the
+    ``(n-1)/n`` of the payload that actually crosses a wire.
+    """
+    dl = _deadline(owner, budget)
+    n = _axis_size(axis)
+    if v.shape[0] != n:
+        raise ValueError(
+            f"wire_all_to_all: leading dim {v.shape[0]} must equal the "
+            f"axis {axis!r} size {n} (one block per destination rank)")
+    vol = (n - 1) / n if n > 1 else 0.0
+    if _quant_eligible(v, "sum", axis, exact):
+        st = _state
+        _phase(SITE_QUANTIZE, dl, owner)
+        q, s = jax.vmap(
+            lambda b: Q.quantize_blockwise(b, st.dtype, st.block))(v)
+        _phase(SITE_COLLECTIVE, dl, owner)
+        qx = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0,  # staticcheck: ok[naked-collective] — this IS the comms wire layer
+                                tiled=False)
+        sx = jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0,  # staticcheck: ok[naked-collective] — this IS the comms wire layer
+                                tiled=False)
+        _phase(SITE_DEQUANT, dl, owner)
+        block_shape = tuple(v.shape[1:])
+        out = jax.vmap(lambda qq, ss: Q.dequantize_blockwise(
+            qq, ss, block_shape, v.dtype, st.block))(qx, sx)
+        _record(owner, "all_to_all", axis, v, vol, st.dtype, dl, st.block,
+                n=n)
+        return out
+    _phase(SITE_COLLECTIVE, dl, owner)
+    _record(owner, "all_to_all", axis, v, vol, None, dl, _state.block)
+    return jax.lax.all_to_all(v, axis, split_axis=0, concat_axis=0,  # staticcheck: ok[naked-collective] — the comms layer's own exact path
+                              tiled=False)
+
+
+# the untiled split=concat=0 all_to_all is an involution across ranks
+# (block i on rank d swaps with block d on rank i), so its vjp is the
+# SAME exchange applied to the cotangent. Spelling that as a custom_vjp
+# keeps the quantized forward differentiable: the wire round trip's
+# round() would otherwise zero every gradient, and this way the sparse
+# gradient push rides the SAME quantized wire format as the lookup
+# (straight-through on the quantization error, exact when the context is
+# off — where it coincides with jax's own transpose).
+def _wire_exchange_fwd(v, axis, owner):
+    return wire_all_to_all(v, axis, owner=owner), None
+
+
+def _wire_exchange_bwd(axis, owner, _res, g):
+    return (wire_all_to_all(g, axis, owner=owner + ".grad"),)
+
+
+wire_exchange = jax.custom_vjp(
+    lambda v, axis, owner: wire_all_to_all(v, axis, owner=owner),
+    nondiff_argnums=(1, 2))
+wire_exchange.defvjp(_wire_exchange_fwd, _wire_exchange_bwd)
+wire_exchange.__doc__ = \
+    """Differentiable wire_all_to_all (positional: v, axis, owner): the
+    backward pass exchanges the cotangent blocks over the same wire —
+    quantized when the context is on (recorded under ``owner + '.grad'``),
+    bitwise-exact otherwise."""
 
 
 # ---------------------------------------------------------------------------

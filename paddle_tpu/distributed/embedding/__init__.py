@@ -1,0 +1,21 @@
+"""paddle_tpu.distributed.embedding — sharded embedding tables.
+
+The TPU-native reproduction of the reference's parameter-server embedding
+layer (PAPER.md L6, `fleet_executor`/`ps`): instead of a PS fleet holding
+the big tables, rows are hash-bucketed and **row-sharded over a named
+mesh axis** as ordinary (shardable) parameters, and a lookup is the
+portable-collective redistribution pattern of arxiv 2112.01075 —
+
+    local unique  ->  id all_to_all  ->  local gather  ->
+    quantized-wire all_to_all return
+
+with both exchange legs routed through :mod:`paddle_tpu.distributed.comms`
+(CommOp records, deadlines, chaos sites; the embedding return leg and the
+dedup'd sparse gradient push ride the EQuARX wire format under
+``comms.quantized()``, and are bitwise full-precision off it).
+
+See README "Sharded embeddings & streaming ingestion".
+"""
+from .sharded import (  # noqa: F401
+    ShardedEmbedding, hash_bucket, sharded_lookup, table_param_spec,
+)

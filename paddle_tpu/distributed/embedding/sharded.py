@@ -1,0 +1,233 @@
+"""Sharded embedding tables: hash-bucketed rows over a named mesh axis.
+
+The layout contract (shared with the reshard planner, which is why a
+scale event can ride the PR 8 executor):
+
+- the table is ONE logical ``[num_buckets, dim]`` array, row-sharded as
+  contiguous blocks over ``shard_axis`` (``PartitionSpec(axis, None)``,
+  exactly what GSPMD materializes) — shard ``d`` owns rows
+  ``[d * rows_local, (d+1) * rows_local)``;
+- raw ids map to rows via :func:`hash_bucket` — identity-mod when
+  ``hash_ids=False`` (ids already dense, the CTR-table case), a Knuth
+  multiplicative hash when ``hash_ids=True`` (arbitrary id spaces, the
+  "millions of users" case; collisions share a row by design);
+- the exchange path engages only when it is exact to do so: a live mesh,
+  ``shard_axis`` extent ``n > 1``, ``num_buckets % n == 0`` and
+  ``batch % n == 0``.  Anything else degrades to the dense gather (the
+  same degrade rule the trainer's placement uses), which GSPMD still
+  shards — correctness never depends on the fast path.
+
+The lookup inside ``shard_map`` (per rank, all static shapes so the whole
+train step captures and lowers once):
+
+1. flatten this rank's ids, ``hash_bucket`` them, **local unique** with a
+   static size bound (dedup: each distinct row crosses the wire once, and
+   the transpose of the unique-inverse gather is the dedup'd scatter-add
+   gradient push);
+2. pack unique ids into per-owner capacity buckets and exchange them with
+   ONE ``comms.wire_all_to_all`` (int32 ids — exact wire, recorded);
+3. **local gather** of the requested rows from this rank's table shard;
+4. return the rows with ``comms.wire_exchange`` — quantized int8/fp8 +
+   per-block scales when ``comms.quantized()`` was on at trace time
+   (bitwise full-precision off it), and its custom vjp pushes the sparse
+   row gradients back over the same wire.
+
+``capacity`` bounds per-destination requests (MoE-style dense buckets:
+XLA needs static shapes).  The default — the full flattened id count — is
+exact and never drops; a smaller capacity trades wire volume for dropped
+(zero-embedding) overflow lookups, and the accounting stays padding-
+honest either way because the CommOp records count the buckets actually
+exchanged.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.layer.layers import Layer
+from ...ops.dispatch import apply
+from ...parallel import mesh as mesh_mod
+
+__all__ = ["ShardedEmbedding", "hash_bucket", "sharded_lookup",
+           "table_param_spec"]
+
+# Knuth's multiplicative hash constant (2654435761 = 2^32 / phi); the
+# uint32 multiply mixes high bits into low before the bucket mod
+_HASH_MULT = 2654435761
+
+
+def hash_bucket(ids, num_buckets: int, hashed: bool = True):
+    """Map raw ids to table rows in ``[0, num_buckets)``.
+
+    ``hashed=False`` is the identity-mod mapping (dense id spaces — an id
+    < num_buckets keeps its row, so the dp1 path is bitwise the dense
+    ``nn.Embedding`` gather). ``hashed=True`` multiplicatively mixes the
+    id first so arbitrary/sparse id spaces spread uniformly over the
+    buckets.
+    """
+    ids = jnp.asarray(ids)
+    u = ids.astype(jnp.uint32)
+    if hashed:
+        u = u * jnp.uint32(_HASH_MULT)
+        u = u ^ (u >> jnp.uint32(16))
+    return (u % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+
+def _dense_lookup(b, w):
+    """The dense reference gather — the exact jnp.take F.embedding runs,
+    so the single-shard path is bitwise the nn.Embedding reference."""
+    return jnp.take(w, b.astype(jnp.int32), axis=0)
+
+
+def _exchange_lookup(ids, w, *, axis: str, n: int, num_buckets: int,
+                     hashed: bool, capacity: Optional[int], owner: str):
+    """The shard_map body: local view ``ids [B/n, ...]``,
+    ``w [num_buckets/n, dim]`` -> local embeddings ``[B/n, ..., dim]``."""
+    from .. import comms
+
+    rows_local = w.shape[0]
+    dim = w.shape[1]
+    flat = hash_bucket(ids, num_buckets, hashed).reshape(-1)      # [L]
+    L = flat.shape[0]
+    cap = int(capacity) if capacity else L
+
+    # 1. local unique (static size: L is the worst case, fill duplicates
+    #    the smallest id — padding slots are never read back because the
+    #    inverse map only points at real uniques)
+    uids, inv = jnp.unique(flat, size=L, fill_value=0, return_inverse=True)
+    inv = inv.reshape(-1)
+    owner_of = jnp.clip(uids // rows_local, 0, n - 1).astype(jnp.int32)
+
+    # 2. pack per-owner capacity buckets: sort by owner, position within
+    #    the owner group via searchsorted-over-self (first occurrence)
+    order = jnp.argsort(owner_of, stable=True)
+    so = owner_of[order]
+    su = uids[order]
+    group_start = jnp.searchsorted(so, so, side="left").astype(jnp.int32)
+    pos = jnp.arange(L, dtype=jnp.int32) - group_start
+    kept = pos < cap                       # capacity overflow -> dropped
+    send = jnp.zeros((n, cap), jnp.int32)
+    send = send.at[so, pos].set(su, mode="drop")
+    recv = comms.wire_all_to_all(send, axis, owner=f"{owner}.ids")
+
+    # 3. local gather: every received id is (supposed to be) ours; the
+    #    clip guards the fill/overflow slots, whose rows are never read
+    my_start = jax.lax.axis_index(axis).astype(jnp.int32) * rows_local
+    lidx = jnp.clip(recv - my_start, 0, rows_local - 1)
+    served = jnp.take(w, lidx, axis=0)                 # [n, cap, dim]
+
+    # 4. quantized-wire return (custom vjp: the dedup'd sparse gradient
+    #    push rides the same wire on the way back)
+    got = comms.wire_exchange(served, axis, f"{owner}.rows")
+
+    emb_sorted = got[so, jnp.clip(pos, 0, cap - 1)]    # [L, dim]
+    emb_sorted = jnp.where(kept[:, None], emb_sorted,
+                           jnp.zeros_like(emb_sorted))
+    uemb = jnp.zeros((L, dim), got.dtype).at[order].set(emb_sorted)
+    out = jnp.take(uemb, inv, axis=0)
+    return out.reshape(tuple(ids.shape) + (dim,))
+
+
+def _exchange_ok(mesh, axis: str, num_buckets: int, batch: int) -> int:
+    """Shard count when the exchange path is exact on this mesh, else 1."""
+    if mesh is None or axis not in getattr(mesh, "axis_names", ()):
+        return 1
+    n = int(mesh.shape[axis])
+    if n <= 1 or batch <= 0 or num_buckets % n != 0 or batch % n != 0:
+        return 1
+    return n
+
+
+def sharded_lookup(ids, weight, *, shard_axis: str = "dp",
+                   hash_ids: bool = False, capacity: Optional[int] = None,
+                   owner: str = "embedding"):
+    """Look ids up in a (possibly row-sharded) embedding table.
+
+    Tensor/array in, Tensor/array out, dispatched like every other op —
+    the captured train step records it by name. On a mesh whose
+    ``shard_axis`` is non-trivial (and divisibility holds) this is the
+    unique -> id all_to_all -> gather -> quantized-wire return exchange;
+    everywhere else it is bitwise the dense ``nn.Embedding`` gather.
+    """
+    num_buckets, _dim = (int(d) for d in weight.shape)
+    mesh = mesh_mod.get_mesh()
+    shape = tuple(getattr(ids, "shape", ()) or ())
+    batch = int(shape[0]) if shape else 0
+    n = _exchange_ok(mesh, shard_axis, num_buckets, batch)
+    if n == 1:
+        def f(i, w):
+            return _dense_lookup(hash_bucket(i, num_buckets, hash_ids), w)
+        return apply(f, ids, weight, op_name="sharded_lookup")
+
+    from jax.sharding import PartitionSpec
+    id_spec = PartitionSpec(*([shard_axis]
+                              + [None] * (len(ids.shape) - 1)))
+    out_spec = PartitionSpec(*([shard_axis] + [None] * len(ids.shape)))
+    w_spec = PartitionSpec(shard_axis, None)
+
+    def f(i, w):
+        body = jax.shard_map(
+            lambda il, wl: _exchange_lookup(
+                il, wl, axis=shard_axis, n=n, num_buckets=num_buckets,
+                hashed=hash_ids, capacity=capacity, owner=owner),
+            mesh=mesh, in_specs=(id_spec, w_spec), out_specs=out_spec,
+            check_vma=False)
+        return body(i, w)
+
+    return apply(f, ids, weight, op_name="sharded_lookup")
+
+
+def table_param_spec(num_buckets: int, dim: int, *, src_axis=None,
+                     dst_axis=None, dtype="float32"):
+    """The reshard planner's view of a row-sharded table: a
+    :class:`~paddle_tpu.distributed.reshard.ParamSpec` whose dim-0 spec
+    names the mesh axis on each side (``None`` = replicated). Contiguous
+    row blocks are exactly what both GSPMD and the brick planner cut, so
+    an embedding-table scale event (shrink/grow/re-axis) plans with zero
+    format translation and rides the PR 8 executor."""
+    from ..reshard import ParamSpec
+    return ParamSpec((int(num_buckets), int(dim)), dtype,
+                     src=(src_axis, None), dst=(dst_axis, None))
+
+
+class ShardedEmbedding(Layer):
+    """Drop-in ``nn.Embedding`` whose table row-shards over a mesh axis.
+
+    Same parameter creation (same initializer draws, so a seeded build is
+    bitwise the dense layer's), plus:
+
+    - ``shard_axis``  the mesh axis the rows shard over (annotated on the
+      weight via ``_sharding`` so TrainStep places it);
+    - ``hash_ids``    route arbitrary id spaces through :func:`hash_bucket`;
+    - ``capacity``    per-destination request bound (default: exact).
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 shard_axis: str = "dp", hash_ids: bool = False,
+                 capacity: Optional[int] = None, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.shard_axis = shard_axis
+        self.hash_ids = bool(hash_ids)
+        self.capacity = capacity
+        self.weight = self.create_parameter(
+            [self.num_embeddings, self.embedding_dim], attr=weight_attr)
+        if weight_attr is None or getattr(weight_attr, "initializer",
+                                          None) is None:
+            from ...nn.initializer import Normal
+            Normal(0.0, 1.0)(self.weight)
+        # row-sharded placement (TrainStep reads this annotation)
+        self.weight._sharding = (shard_axis, None)
+
+    def forward(self, x):
+        return sharded_lookup(
+            x, self.weight, shard_axis=self.shard_axis,
+            hash_ids=self.hash_ids, capacity=self.capacity)
+
+    def extra_repr(self):
+        return (f"{self.num_embeddings}, {self.embedding_dim}, "
+                f"shard_axis={self.shard_axis!r}, hash_ids={self.hash_ids}")

@@ -18,17 +18,24 @@ from ..ops.dispatch import apply
 from .collective import _axis_of, _in_shard_map
 
 
-def _exchange(x: Tensor, axis: str) -> Tensor:
+def _exchange(x: Tensor, axis: str, owner: str) -> Tensor:
     """all_to_all on dim 0: [world * n_per, ...] -> [world * n_per, ...] where
-    block i of the output is block `rank` gathered from peer i."""
+    block i of the output is block `rank` gathered from peer i. Routed
+    through the comms wire layer: the expert-parallel dispatch/combine
+    traffic gets CommOp accounting under its own leg's `owner` site (so
+    comm_summary attributes dispatch vs combine separately), rides the
+    quantized wire format under ``comms.quantized()`` (the textbook
+    EQuARX consumer), and the custom-vjp exchange keeps the routed tokens
+    differentiable — the combine gradient crosses back over the same
+    wire."""
     if axis is None or not _in_shard_map(axis):
         return x
 
     def f(v):
+        from .comms import wire_exchange
         n = jax.lax.axis_size(axis)
         parts = v.reshape((n, v.shape[0] // n) + v.shape[1:])
-        out = jax.lax.all_to_all(parts, axis, split_axis=0, concat_axis=0,  # staticcheck: ok[naked-collective] — expert-parallel a2a; route through comms when MoE lands (ROADMAP)
-                                 tiled=False)
+        out = wire_exchange(parts, axis, owner)
         return out.reshape(v.shape)
     return apply(f, x, op_name="global_scatter")
 
@@ -39,10 +46,10 @@ def global_scatter(x, local_count=None, global_count=None, group=None):
     x: [world_size * n_local_experts * capacity, d] (dense buckets, expert-major)
     or any tensor whose dim 0 is divisible by the group world size.
     """
-    return _exchange(x, _axis_of(group))
+    return _exchange(x, _axis_of(group), "moe.dispatch")
 
 
 def global_gather(x, local_count=None, global_count=None, group=None):
     """Inverse of global_scatter: return expert outputs to token owners.
     With dense equal-size buckets the exchange is symmetric."""
-    return _exchange(x, _axis_of(group))
+    return _exchange(x, _axis_of(group), "moe.combine")

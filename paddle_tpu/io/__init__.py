@@ -14,3 +14,7 @@ from .dataloader import (  # noqa: F401
     DataLoader, DataLoaderWorkerError, WorkerInfo, default_collate_fn,
     get_worker_info,
 )
+from .streaming import (  # noqa: F401
+    ShardedSampleStream, StreamLoader, restore_stream_checkpoint,
+    save_stream_checkpoint,
+)

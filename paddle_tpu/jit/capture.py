@@ -268,7 +268,8 @@ def _leaf_sig(v):
 
 def lower_step(fn: Callable, example_args: Sequence[Any],
                donate_argnums=(), in_shardings=_UNSET,
-               passes=None, name: Optional[str] = None):
+               out_shardings=_UNSET, passes=None,
+               name: Optional[str] = None):
     """Trace ``fn`` once over concrete ``example_args``, run the graft pass
     pipeline, and return ``(dispatcher, GraftProgram | None)``.
 
@@ -287,6 +288,12 @@ def lower_step(fn: Callable, example_args: Sequence[Any],
         jit_kwargs["donate_argnums"] = donate_argnums
     if in_shardings is not _UNSET:
         jit_kwargs["in_shardings"] = in_shardings
+    if out_shardings is not _UNSET:
+        # pin the output placements: a step whose body reshards (an
+        # explicit shard_map exchange, a row-sharded table) must hand its
+        # outputs back in the caller's canonical shardings, or the second
+        # call's in_shardings reject the first call's outputs
+        jit_kwargs["out_shardings"] = out_shardings
     if not _enabled:
         return jax.jit(fn, **jit_kwargs), None
     try:

@@ -1,15 +1,20 @@
 """DeepFM — BASELINE config "DeepFM CTR". Reference: PaddleRec deepfm
-(reference's PS-based CTR stack, SURVEY.md §2.5/§2.6). BASELINE.json maps the
-parameter-server world to ICI data-parallel allreduce on TPU: embedding tables
-live as ordinary (shardable) parameters; the FM + DNN compute is dense
-einsums that ride the MXU.
+(reference's PS-based CTR stack, SURVEY.md §2.5/§2.6). The reference
+serves its embedding tables from a parameter-server fleet; here the
+tables are :class:`~paddle_tpu.distributed.embedding.ShardedEmbedding` —
+hash-bucketed rows row-sharded over a named mesh axis, looked up via the
+comms-routed unique -> id all_to_all -> gather -> quantized-wire return
+exchange (distributed/embedding/). On a single shard (no mesh, axis
+extent 1) the tables are bitwise the dense ``nn.Embedding`` reference;
+the FM + DNN compute is dense einsums that ride the MXU either way.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..distributed.embedding import ShardedEmbedding
 from ..nn import functional as F
-from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.common import Linear
 from ..nn.layer.container import LayerList
 from ..nn.layer.layers import Layer
 from ..ops.dispatch import apply
@@ -19,18 +24,30 @@ class DeepFM(Layer):
     """sparse_field_num categorical fields + dense_dim numeric features.
 
     forward(sparse_ids [B, F], dense [B, D]) -> logits [B, 1]
+
+    ``shard_axis`` row-shards both embedding tables over that mesh axis
+    (lookups become the comms-routed exchange when the axis is alive);
+    ``hash_ids=True`` admits arbitrary id spaces by hash-bucketing into
+    ``sparse_feature_number`` rows (the millions-of-users case).
     """
 
     def __init__(self, sparse_feature_number: int, sparse_feature_dim: int = 9,
                  dense_feature_dim: int = 13, sparse_field_num: int = 26,
-                 layer_sizes=(512, 256, 128)):
+                 layer_sizes=(512, 256, 128), shard_axis: str = "dp",
+                 hash_ids: bool = False, lookup_capacity=None):
         super().__init__()
         self.sparse_field_num = sparse_field_num
         self.dense_feature_dim = dense_feature_dim
         k = sparse_feature_dim
-        # FM first order: per-feature scalar weight; second order: k-dim factors
-        self.emb_first = Embedding(sparse_feature_number, 1)
-        self.emb_factor = Embedding(sparse_feature_number, k)
+        # FM first order: per-feature scalar weight; second order: k-dim
+        # factors — both tables row-sharded over the same axis, so one
+        # scale event replans both with the same brick grid
+        self.emb_first = ShardedEmbedding(
+            sparse_feature_number, 1, shard_axis=shard_axis,
+            hash_ids=hash_ids, capacity=lookup_capacity)
+        self.emb_factor = ShardedEmbedding(
+            sparse_feature_number, k, shard_axis=shard_axis,
+            hash_ids=hash_ids, capacity=lookup_capacity)
         self.dense_first = Linear(dense_feature_dim, 1)
         self.dense_factor = Linear(dense_feature_dim, dense_feature_dim * k)
 

@@ -419,9 +419,20 @@ class TrainStep:
                     is_leaf=lambda x: hasattr(x, "ndim")),
                 None, None, None,
             )
+            # outputs pinned to the canonical placements: a body that
+            # reshards internally (the sharded-embedding exchange) must
+            # not let GSPMD hand params back in drifted shardings the
+            # next call's in_shardings would reject
+            replicated = NamedSharding(self.mesh, PartitionSpec())
+            out_shardings = (
+                replicated,
+                list(self._param_shardings),
+                [dict(s) for s in self._state_shardings],
+                {k: replicated for k in self._health},
+            )
             self._jitted, self.captured_program = _capture.lower_step(
                 pure_step, example, donate_argnums=donate,
-                in_shardings=in_shardings)
+                in_shardings=in_shardings, out_shardings=out_shardings)
         else:
             self._jitted, self.captured_program = _capture.lower_step(
                 pure_step, example, donate_argnums=donate)

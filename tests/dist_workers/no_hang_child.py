@@ -62,6 +62,14 @@ def main(site: str) -> None:
                 jnp.ones((2048,), jnp.float32), owner="no-hang-child",
                 budget=BUDGET)
         assert out.shape == (2048,)
+    elif site == "io.stream_fetch":
+        import numpy as np
+        from paddle_tpu.io import ShardedSampleStream, StreamLoader
+
+        shards = [[np.full((2,), 10 * s + i, np.float32) for i in range(4)]
+                  for s in range(3)]
+        stream = ShardedSampleStream(shards, seed=0)
+        list(StreamLoader(stream, batch_size=4, timeout=BUDGET))
     elif site == "io.worker_batch":
         import numpy as np
         import paddle_tpu.io as io

@@ -1,0 +1,64 @@
+"""Writer child for the streaming cursor-checkpoint chaos matrix.
+
+Consumes a deterministic ShardedSampleStream through a StreamLoader,
+committing (model-state, cursor) generations along the way:
+
+    batches 0,1   -> save_stream_checkpoint step=1   (commits cleanly)
+    batches 2,3   -> save_stream_checkpoint step=2   (the armed kill site
+                     fires inside/around THIS save: PT_CRASHPOINT names a
+                     stream.cursor_* or ckpt.* site, PT_CRASHPOINT_HITS=2
+                     lets generation 1 pass clean)
+    remainder     -> consumed, then a `survived` marker is written
+
+Each consumed sample's value is appended (flushed per line) to
+``consumed.log`` so the parent can reconstruct exactly what was delivered
+before the SIGKILL. The parent (tests/test_streaming.py) restores from
+the surviving committed generation and proves the zero-duplicate /
+zero-lost law against the deterministic stream order.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed.ckpt_manager import CheckpointManager  # noqa: E402
+from paddle_tpu.io import (ShardedSampleStream, StreamLoader,  # noqa: E402
+                           save_stream_checkpoint)
+
+BATCH = 4
+
+
+def build_stream():
+    # 4 shards x 5 samples of distinct scalars — the known answer
+    shards = [[np.asarray([10.0 * s + i], np.float32) for i in range(5)]
+              for s in range(4)]
+    return ShardedSampleStream(shards, seed=3)
+
+
+def state_for(step):
+    return {"w": np.full((4, 4), float(step), np.float32)}
+
+
+def main(out_dir: str) -> None:
+    mgr = CheckpointManager(os.path.join(out_dir, "ckpt"), keep_last_k=3)
+    stream = build_stream()
+    loader = StreamLoader(stream, batch_size=BATCH, timeout=30.0,
+                          to_tensors=False)
+    log = open(os.path.join(out_dir, "consumed.log"), "a")
+    for bi, batch in enumerate(loader):
+        for v in np.asarray(batch)[:, 0]:
+            log.write(f"{v}\n")
+        log.flush()
+        os.fsync(log.fileno())
+        if bi == 1:
+            save_stream_checkpoint(mgr, state_for(1), 1, stream)
+        elif bi == 3:
+            save_stream_checkpoint(mgr, state_for(2), 2, stream)
+    with open(os.path.join(out_dir, "survived"), "w") as f:
+        f.write("ran past every armed site\n")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -41,7 +41,9 @@ def pragma_callback_step(x):  # staticcheck: ok[jaxpr-host-callback] — fixture
     return x + 1.0
 
 
-# ---- dead-compute (inside a scan body: beyond the DVE pass's reach) --------
+# ---- dead-compute (inside a scan body; DVE now sweeps sub-jaxprs, so the
+# fixture captures with the dve pass TRIMMED — the rule's job is exactly
+# what remains when the pipeline didn't/couldn't clean it) -------------------
 
 def dead_in_scan_step(x):
     def body(c, t):
@@ -121,7 +123,8 @@ def collect(root):
     return [
         t("fixture/callback", callback_step, mk((8, 8))),
         t("fixture/pragma_callback", pragma_callback_step, mk((8, 8))),
-        t("fixture/dead_in_scan", dead_in_scan_step, mk((16,))),
+        t("fixture/dead_in_scan", dead_in_scan_step, mk((16,)),
+          passes=("fusion", "cse", "comm")),
         t("fixture/weak_scalar", weak_scalar_step,
           lambda: (_arr((8, 8)), jnp.asarray(3.0))),
         t("fixture/signature_churn", _static_n_step, churn_args),

@@ -1,0 +1,63 @@
+"""Guard for the sharded-embedding bench (bench_embedding.py).
+
+The wire-reduction number is deterministic accounting (program wire
+format, not timing), so the >=3.5x acceptance floor and the exactness
+ladder are asserted even in the tier-1 smoke run; the slow variant
+re-runs at the default timing iterations for the trajectory artifact.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(iters: int):
+    env = dict(os.environ, PT_EMBED_BENCH_ITERS=str(iters))
+    env.pop("XLA_FLAGS", None)  # the bench pins its own 2-device cpu
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_embedding.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout  # exactly ONE JSON line on stdout
+    return json.loads(lines[0]), r.stderr
+
+
+@pytest.mark.skipif(os.environ.get("PT_TIGHT_BUDGET") == "1",
+                    reason="wall-clock budget is tight; perf smoke skipped")
+def test_bench_embedding_smoke_json_contract():
+    payload, stderr = _run_bench(iters=2)
+    assert payload["metric"] == "embedding_wire_reduction_int8"
+    assert payload["unit"] == "x"
+    # deterministic accounting: the floor holds at any iteration count
+    assert payload["value"] >= 3.5, payload
+    assert payload["vs_baseline"] >= 1.0, payload
+    # the exactness ladder: dp1 bitwise dense, dp2 exchange bitwise off
+    assert payload["bitwise_dp1"] is True, payload
+    assert payload["bitwise_exact_dp2"] is True, payload
+    assert payload["bitwise_exact_grad_dp2"] is True, payload
+    assert 0 < payload["rows_bytes_wire"] < payload["rows_bytes_logical"]
+    assert payload["backend"] == "cpu-proxy"
+    # the summary table made it to stderr next to the artifact pointer
+    assert "embedding.rows/all_to_all/dp" in stderr
+    assert "artifact ->" in stderr
+    art = stderr.split("artifact ->", 1)[1].strip().splitlines()[0]
+    with open(art) as f:
+        detail = json.load(f)["detail"]
+    assert "embedding.ids/all_to_all/dp" in detail["sites"]
+    # the id leg stays exact int32 — only the row combine quantizes
+    assert detail["sites"]["embedding.ids/all_to_all/dp"]["quantized"] is None
+    assert detail["sites"]["embedding.rows/all_to_all/dp"]["quantized"] \
+        == "int8"
+    os.unlink(art)  # tiny-iter artifacts are not trajectory evidence
+
+
+@pytest.mark.slow
+def test_bench_embedding_meets_acceptance_floor():
+    payload, _ = _run_bench(iters=20)
+    assert payload["value"] >= 3.5, payload
+    assert payload["quant_max_err"] < 0.1, payload

@@ -63,6 +63,16 @@ MATRIX = {
     ("io.worker_batch", "delay:30"):  ("typed", "DataLoaderTimeout"),
     ("io.worker_batch", "error"):     ("typed", "RuntimeError"),
     ("io.worker_batch", "drop"):      ("typed", "RuntimeError"),
+    # streaming ingestion (io/streaming.py): the fetch worker carries the
+    # same liveness law — SIGKILL surfaces as the typed worker error (the
+    # parent survives and can recover() from the cursor), a stalled fetch
+    # becomes the typed timeout under timeout=, an in-worker exception
+    # (error AND the drop-mode ConnectionError: there is no wire to
+    # retry, the sample is poisoned) propagates typed
+    ("io.stream_fetch", "crash"):     ("typed", "DataLoaderWorkerError"),
+    ("io.stream_fetch", "delay:30"):  ("typed", "DataLoaderTimeout"),
+    ("io.stream_fetch", "error"):     ("typed", "RuntimeError"),
+    ("io.stream_fetch", "drop"):      ("typed", "RuntimeError"),
     # live resharding: all three blocking edges (plan exchange, shard
     # transfer, commit barrier) are deadline-bounded; a dropped wire is
     # absorbed by the executor's idempotent retry-once

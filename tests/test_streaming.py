@@ -1,0 +1,309 @@
+"""Streaming sharded ingestion (io/streaming.py).
+
+Three layers:
+
+1. determinism + resumability known answers — the per-epoch order is a
+   pure function of (seed, epoch, rank), the cursor is exact-resume
+   state, and a mid-epoch restore replays nothing and loses nothing;
+2. liveness — a SIGKILLed fetch worker surfaces as the typed
+   DataLoaderWorkerError, a stalled fetch as DataLoaderTimeout, and
+   recover() continues exactly-once from the cursor (the io.stream_fetch
+   site also rides the tests/test_no_hang.py matrix);
+3. durability chaos — a writer child is SIGKILLed at every
+   cursor-checkpoint crash site (the new stream.cursor_* sites plus the
+   checkpoint manager's own commit-path sites); restoring from the
+   surviving committed generation resumes with ZERO duplicate and ZERO
+   lost samples relative to that generation's cursor.
+"""
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+from paddle_tpu.io import (ShardedSampleStream, StreamLoader,
+                           restore_stream_checkpoint, save_stream_checkpoint)
+from paddle_tpu.io.dataloader import DataLoaderWorkerError
+from paddle_tpu.utils.deadline import DataLoaderTimeout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WRITER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "dist_workers", "stream_chaos_writer.py")
+
+
+def _shards(n_shards=4, per=5):
+    return [[np.asarray([10.0 * s + i], np.float32) for i in range(per)]
+            for s in range(n_shards)]
+
+
+def _values(batches):
+    out = []
+    for b in batches:
+        arr = b._value if hasattr(b, "_value") else b
+        out.extend(np.asarray(arr)[:, 0].tolist())
+    return out
+
+
+def _epoch_values(stream, epoch):
+    return [float(stream.sample_at(i, epoch=epoch)[0])
+            for i in range(stream.epoch_len(epoch))]
+
+
+@pytest.fixture
+def arm(monkeypatch):
+    def _arm(site, mode, hits="1", skip="0"):
+        monkeypatch.setenv("PT_FAULTPOINT", site)
+        monkeypatch.setenv("PT_FAULTPOINT_MODE", mode)
+        monkeypatch.setenv("PT_FAULTPOINT_HITS", hits)
+        monkeypatch.setenv("PT_FAULTPOINT_SKIP", skip)
+        chaos.reset_hits()
+    yield _arm
+    chaos.reset_hits()
+
+
+# ---------------- determinism + resumability ----------------
+
+def test_deterministic_sharded_order():
+    a = ShardedSampleStream(_shards(), seed=1)
+    b = ShardedSampleStream(_shards(), seed=1)
+    assert _epoch_values(a, 0) == _epoch_values(b, 0)
+    # epochs reshuffle (seed-derived), same multiset
+    e0, e1 = _epoch_values(a, 0), _epoch_values(a, 1)
+    assert e0 != e1 and sorted(e0) == sorted(e1)
+    # a different seed is a different order
+    c = ShardedSampleStream(_shards(), seed=2)
+    assert _epoch_values(c, 0) != e0
+
+
+def test_rank_striping_partitions_the_shard_set():
+    world = [ShardedSampleStream(_shards(5, 3), world_size=2, rank=r, seed=4)
+             for r in range(2)]
+    vals = [set(_epoch_values(s, 0)) for s in world]
+    assert vals[0].isdisjoint(vals[1])
+    assert len(vals[0] | vals[1]) == 15
+    with pytest.raises(ValueError, match="rank"):
+        ShardedSampleStream(_shards(), world_size=2, rank=2)
+
+
+def test_loader_delivers_epoch_exactly_once_and_rolls():
+    st = ShardedSampleStream(_shards(), seed=3)
+    got = _values(StreamLoader(st, batch_size=4))
+    assert got == _epoch_values(st, 0)
+    assert st.exhausted() and st.pos == 20
+    # next iteration rolls the epoch
+    got1 = _values(StreamLoader(st, batch_size=4))
+    assert st.epoch == 1 and got1 == _epoch_values(st, 1)
+
+
+def test_partial_final_batch_counts_exactly():
+    st = ShardedSampleStream(_shards(3, 3), seed=0)  # 9 samples
+    batches = list(StreamLoader(st, batch_size=4, to_tensors=False))
+    assert [len(b) for b in batches] == [4, 4, 1]
+    assert st.pos == 9
+
+
+def test_mid_epoch_cursor_resume_no_dup_no_loss():
+    st = ShardedSampleStream(_shards(), seed=3)
+    st.roll_epoch()   # epoch 1: a shuffled mid-stream case
+    it = iter(StreamLoader(st, batch_size=4))
+    pre = _values([next(it), next(it)])
+    cursor = st.state_dict()
+    it.close()        # the consumer dies mid-epoch
+
+    fresh = ShardedSampleStream(_shards(), seed=3)
+    fresh.load_state_dict(cursor)
+    post = _values(StreamLoader(fresh, batch_size=4))
+    assert pre + post == _epoch_values(fresh, 1)
+
+
+def test_cursor_refuses_incompatible_stream():
+    st = ShardedSampleStream(_shards(), seed=3)
+    cur = st.state_dict()
+    other = ShardedSampleStream(_shards(), seed=4)
+    with pytest.raises(ValueError, match="seed"):
+        other.load_state_dict(cur)
+    # a cursor written by another RANK repositions inside the wrong
+    # stripe — silent duplicate/lost coverage, so it must refuse typed
+    peer = ShardedSampleStream(_shards(), world_size=2, rank=1, seed=3)
+    with pytest.raises(ValueError, match="rank"):
+        peer.load_state_dict(
+            ShardedSampleStream(_shards(), world_size=2, rank=0,
+                                seed=3).state_dict())
+    with pytest.raises(ValueError, match="not a stream cursor"):
+        st.load_state_dict({"pos": 3})
+
+
+def test_tuple_samples_advance_cursor_by_batch_size():
+    """Supervised (x, y) pairs collate into a 2-tuple of stacked arrays;
+    the cursor must advance by the delivered SAMPLE count, not the
+    container arity (the exactly-once accounting regression)."""
+    shards = [[(np.asarray([10.0 * s + i], np.float32),
+                np.asarray([float(i % 2)], np.float32))
+               for i in range(5)] for s in range(2)]
+    st = ShardedSampleStream(shards, seed=1)
+    xs = []
+    for bx, _by in StreamLoader(st, batch_size=4, to_tensors=False):
+        xs.extend(np.asarray(bx)[:, 0].tolist())
+    assert st.pos == 10 and st.exhausted()
+    assert xs == [float(st.sample_at(i, epoch=0)[0][0]) for i in range(10)]
+
+
+def test_custom_collate_fn_cursor_stays_exact():
+    """A collate_fn may reshape the batch arbitrarily (here: identity,
+    whose 'leading dim' is the SAMPLE's own shape) — the cursor must
+    advance by the worker's true packed count regardless."""
+    st = ShardedSampleStream(_shards(3, 4), seed=2)  # 12 samples
+    n = 0
+    for batch in StreamLoader(st, batch_size=4, collate_fn=lambda b: b,
+                              to_tensors=False):
+        n += len(batch)
+    assert n == 12 and st.pos == 12 and st.exhausted()
+
+
+def test_cursor_refuses_changed_shard_set():
+    """Object-storage drift: a shard landing (or growing) between save
+    and restore re-permutes the epoch — the cursor must refuse typed."""
+    st = ShardedSampleStream(_shards(4), seed=3)
+    cur = st.state_dict()
+    grown = ShardedSampleStream(_shards(5), seed=3)
+    with pytest.raises(ValueError, match="shard_lens"):
+        grown.load_state_dict(cur)
+    fatter = ShardedSampleStream(_shards(4, per=6), seed=3)
+    with pytest.raises(ValueError, match="shard_lens"):
+        fatter.load_state_dict(cur)
+
+
+# ---------------- liveness (the PR 4 law) ----------------
+
+def test_worker_sigkill_typed_then_recover_exactly_once(arm):
+    st = ShardedSampleStream(_shards(), seed=3)
+    loader = StreamLoader(st, batch_size=4, timeout=5.0)
+    arm("io.stream_fetch", "crash", skip="2")
+    seen = []
+    with pytest.raises(DataLoaderWorkerError) as ei:
+        for b in loader:
+            seen.extend(_values([b]))
+    assert ei.value.exitcode == -signal.SIGKILL
+    # the kill races the queue's feeder thread: 0..2 of the clean batches
+    # may be lost in the pipe — they were never DELIVERED, so the cursor
+    # never moved for them and recovery re-fetches them (the law below)
+    assert len(seen) <= 8 and len(seen) % 4 == 0
+    chaos.reset_hits()
+    os_env_clear = ("PT_FAULTPOINT", "PT_FAULTPOINT_MODE",
+                    "PT_FAULTPOINT_HITS", "PT_FAULTPOINT_SKIP")
+    for k in os_env_clear:
+        os.environ.pop(k, None)
+    loader.recover()
+    for b in loader:
+        seen.extend(_values([b]))
+    assert seen == _epoch_values(st, 0)   # zero duplicate, zero lost
+
+
+def test_stalled_fetch_raises_typed_timeout(arm):
+    st = ShardedSampleStream(_shards(), seed=3)
+    arm("io.stream_fetch", "delay:30", hits="inf")
+    with pytest.raises(DataLoaderTimeout):
+        list(StreamLoader(st, batch_size=4, timeout=0.7))
+
+
+def test_poisoned_shard_raises_typed_runtime_error(arm):
+    st = ShardedSampleStream(_shards(), seed=3)
+    arm("io.stream_fetch", "error")
+    with pytest.raises(RuntimeError, match="stream fetch worker failed"):
+        list(StreamLoader(st, batch_size=4, timeout=5.0))
+
+
+# ---------------- cursor durability on CheckpointManager ----------------
+
+def test_cursor_rides_checkpoint_generations(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    st = ShardedSampleStream(_shards(), seed=3)
+    it = iter(StreamLoader(st, batch_size=4))
+    consumed = _values([next(it), next(it)])
+    state = {"w": np.ones((2, 2), np.float32)}
+    save_stream_checkpoint(mgr, state, 1, st, user_data={"note": "mid"})
+    it.close()
+
+    fresh_state = {"w": np.zeros((2, 2), np.float32)}
+    fresh = ShardedSampleStream(_shards(), seed=3)
+    step = restore_stream_checkpoint(mgr, fresh_state, fresh)
+    assert step == 1
+    np.testing.assert_array_equal(fresh_state["w"], state["w"])
+    assert fresh.state_dict() == st.state_dict()
+    rest = _values(StreamLoader(fresh, batch_size=4))
+    assert consumed + rest == _epoch_values(fresh, 0)
+    # a generation without a cursor is a typed refusal, not a guess
+    mgr.save({"w": state["w"]}, 2)
+    with pytest.raises(KeyError, match="stream_cursor"):
+        restore_stream_checkpoint(mgr, dict(fresh_state),
+                                  ShardedSampleStream(_shards(), seed=3))
+
+
+# ---------------- the kill matrix at the cursor-checkpoint sites ----------------
+
+# expected surviving generation per kill site: the COMMIT rename inside
+# save_stream_checkpoint's manager.save is the durability point, exactly
+# as in the ckpt chaos matrix — the stream.cursor_* brackets land before
+# (staged) and after (committed) the whole protocol
+EXPECTED_SURVIVOR = {
+    "stream.cursor_staged": 1,
+    "stream.cursor_committed": 2,
+    "ckpt.manifest_written": 1,
+    "ckpt.commit_written": 2,
+}
+
+
+def test_matrix_covers_every_stream_crash_site():
+    assert set(chaos.registered_sites("stream.")) <= set(EXPECTED_SURVIVOR)
+
+
+def test_writer_kill_matrix_resumes_no_dup_no_loss(tmp_path):
+    """SIGKILL the writer at each cursor-checkpoint site; restore from
+    the surviving committed generation and finish the epoch: committed
+    prefix + resumed remainder must equal the deterministic epoch order
+    exactly — zero duplicates, zero losses."""
+    env_base = dict(os.environ,
+                    PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""),
+                    JAX_PLATFORMS="cpu", PT_CRASHPOINT_HITS="2")
+    for k in ("PT_FAULTPOINT", "PT_FAULTPOINT_MODE"):
+        env_base.pop(k, None)
+    children = {}
+    for site in sorted(EXPECTED_SURVIVOR):
+        out_dir = tmp_path / site.replace(".", "_")
+        out_dir.mkdir()
+        env = dict(env_base, PT_CRASHPOINT=site)
+        children[site] = (out_dir, subprocess.Popen(
+            [sys.executable, WRITER, str(out_dir)], cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True))
+
+    from tests.dist_workers.stream_chaos_writer import BATCH, build_stream
+    for site, (out_dir, proc) in children.items():
+        _, err = proc.communicate(timeout=240)
+        assert proc.returncode == -signal.SIGKILL, (
+            f"{site}: writer should die by SIGKILL at the armed site, got "
+            f"rc={proc.returncode}\n{err[-2000:]}")
+        assert not (out_dir / "survived").exists(), site
+
+        want_gen = EXPECTED_SURVIVOR[site]
+        mgr = CheckpointManager(str(out_dir / "ckpt"))
+        assert mgr.latest() == want_gen, (
+            f"{site}: latest() -> {mgr.latest()}, want {want_gen}")
+
+        stream = build_stream()
+        state = {"w": np.zeros((4, 4), np.float32)}
+        got = restore_stream_checkpoint(mgr, state, stream)
+        assert got == want_gen
+        np.testing.assert_array_equal(
+            state["w"], np.full((4, 4), float(want_gen), np.float32),
+            err_msg=f"{site}: torn state restored")
+        # the committed cursor sits exactly at the generation's batch edge
+        assert stream.pos == want_gen * 2 * BATCH, (site, stream.pos)
+        resumed = _values(StreamLoader(stream, batch_size=BATCH))
+        full = _epoch_values(stream, 0)
+        committed_prefix = full[:want_gen * 2 * BATCH]
+        assert committed_prefix + resumed == full, (
+            f"{site}: duplicate or lost samples on resume")

@@ -189,6 +189,70 @@ def _wrapped_result(name: str, wrapper, root: str, anchor,
                       churn=info.get("lowerings", 1) != 1)
 
 
+def _deepfm_step(root: str) -> StepResult:
+    """The recommendation workload: DeepFM training through the sharded
+    embedding tables (distributed/embedding) — on a dp2 mesh when this
+    host has >= 2 devices (the exchange path: unique -> id all_to_all ->
+    gather -> wire return must be fully comm-pass tagged), dense dp1
+    otherwise. The lint gate is the 'zero new naked collectives' half of
+    the subsystem's acceptance."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as P
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import capture
+    from paddle_tpu.models import deepfm as deepfm_mod
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.parallel import trainer as trainer_mod
+
+    path, line = _anchor(deepfm_mod.DeepFM, root)
+    prev_mesh = mesh_mod.get_mesh()
+    try:
+        mesh = None
+        if len(jax.devices()) >= 2:
+            mesh = mesh_mod.init_mesh({"dp": 2}, devices=jax.devices()[:2])
+        else:
+            mesh_mod.set_mesh(None)
+        P.seed(1234)
+        model = deepfm_mod.DeepFM(
+            sparse_feature_number=32, sparse_feature_dim=4,
+            dense_feature_dim=4, sparse_field_num=4, layer_sizes=(16,))
+        opt = P.optimizer.SGD(learning_rate=0.05,
+                              parameters=model.parameters())
+        step = trainer_mod.compile_train_step(
+            model,
+            lambda m, b: nn.functional.binary_cross_entropy_with_logits(
+                m(b["sparse"], b["dense"]), b["y"]),
+            opt, mesh=mesh)
+        rng = np.random.RandomState(0)
+        raw = {"sparse": rng.randint(0, 32, (8, 4)),
+               "dense": rng.randn(8, 4).astype(np.float32),
+               "y": (rng.rand(8, 1) > 0.5).astype(np.float32)}
+
+        def batch():
+            return {k: P.to_tensor(v.copy()) for k, v in raw.items()}
+
+        step(batch())
+        before = capture.capture_info()
+        step(batch())  # equivalent avals: must ride the captured executable
+        after = capture.capture_info()
+    except Exception as e:  # noqa: BLE001 — a build failure is a bailout
+        return StepResult("trainstep/deepfm-sharded-embedding", path, line,
+                          error=f"{type(e).__name__}: {e}"[:200])
+    finally:
+        mesh_mod.set_mesh(prev_mesh)
+    prog = step.captured_program
+    if prog is None:
+        return StepResult("trainstep/deepfm-sharded-embedding", path, line,
+                          error=capture.capture_info()["last_bailout"]
+                          or "lower_step fell back to plain jit")
+    churn = after["fallback_calls"] > before["fallback_calls"] \
+        or after["lowerings"] > before["lowerings"]
+    return StepResult("trainstep/deepfm-sharded-embedding", path, line,
+                      program=prog, churn=churn)
+
+
 def _to_static_step(root: str) -> StepResult:
     """A to_static-compiled layer — the jit.api lower_step path."""
     import numpy as np
@@ -238,6 +302,7 @@ def canonical_steps(root: str) -> List[StepResult]:
     results = [_train_step(root)]
     results += _serving_steps(root)
     results.append(_to_static_step(root))
+    results.append(_deepfm_step(root))
     return results
 
 
