@@ -32,6 +32,8 @@ from .embedding import ShardedEmbedding  # noqa: F401
 # placements)` and `dist.reshard.plan_reshard` both work no matter which
 # import runs last
 from . import reshard  # noqa: F401
+from . import supervisor  # noqa: F401
+from .supervisor import Supervisor, SupervisedParam  # noqa: F401
 from .ckpt_manager import CheckpointManager  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from . import rpc  # noqa: F401

@@ -613,6 +613,33 @@ def execute(plan: ReshardPlan, owner: str, state: Dict[str, np.ndarray],
     return out
 
 
+def _full_restore_state(plan: ReshardPlan, owner: str,
+                        ckpt) -> Dict[str, np.ndarray]:
+    """The bottom rung: cut this owner's DESTINATION shards from the last
+    committed generation. A departing pure-sender owns no dst shards — its
+    "restore" is the empty state, not a dst_index lookup on a mesh it
+    left."""
+    out: Dict[str, np.ndarray] = {}
+    if owner in plan.dst_mesh.owners:
+        restored = ckpt.read_params(sorted(plan.params))
+        for name in plan.params:
+            full = np.asarray(restored[name])
+            sls = tuple(slice(lo, hi)
+                        for lo, hi in plan.dst_index(name, owner))
+            out[name] = full[sls].astype(plan.params[name].dtype)
+    return out
+
+
+def _publish_rung(transport, session: str, owner: str, how: str) -> None:
+    """Best-effort rung publication for rung_agreement(): if the transport
+    itself is dead the peers see this owner ABSENT and restore — the same
+    converging outcome."""
+    try:
+        transport.put(f"{session}/how/{owner}", how.encode())
+    except Exception:  # noqa: BLE001 — absence IS the disagreement signal
+        pass
+
+
 def reshard_or_restore(plan: ReshardPlan, owner: str,
                        state: Dict[str, np.ndarray], transport, *,
                        session: str, ckpt=None,
@@ -656,16 +683,7 @@ def reshard_or_restore(plan: ReshardPlan, owner: str,
         if ckpt is None:
             raise
         t0 = time.perf_counter()
-        out = {}
-        # a departing pure-sender owns no dst shards: its "restore" is the
-        # empty state, not a dst_index lookup on a mesh it left
-        if owner in plan.dst_mesh.owners:
-            restored = ckpt.read_params(sorted(plan.params))
-            for name in plan.params:
-                full = np.asarray(restored[name])
-                sls = tuple(slice(lo, hi)
-                            for lo, hi in plan.dst_index(name, owner))
-                out[name] = full[sls].astype(plan.params[name].dtype)
+        out = _full_restore_state(plan, owner, ckpt)
         _register_report({
             "session": session, "owner": owner, "how": "full-restore",
             "bytes_moved": 0, "bytes_local": 0,
@@ -677,14 +695,127 @@ def reshard_or_restore(plan: ReshardPlan, owner: str,
             "fallback_cause": type(e).__name__,
         })
         how = "full-restore"
-    # publish the rung (best-effort: if the transport itself is dead the
-    # peers' rung_agreement() sees this owner ABSENT and restores — the
-    # same converging outcome)
-    try:
-        transport.put(f"{session}/how/{owner}", how.encode())
-    except Exception:  # noqa: BLE001 — absence IS the disagreement signal
-        pass
+    _publish_rung(transport, session, owner, how)
     return out, how
+
+
+def _avail_digest(avail) -> str:
+    """Deterministic tag of a survivor view. Churn-aware retries derive
+    their per-attempt session from it, so every owner that observes the
+    SAME survivor set lands on the SAME transport keys without any
+    cross-process attempt counter — the store's lease expiry is the one
+    clock all observers already agree on."""
+    h = hashlib.sha256(repr(tuple(sorted(avail))).encode())
+    return h.hexdigest()[:8]
+
+
+def reshard_or_restore_churn(src_mesh: MeshSpec, dst_mesh: MeshSpec,
+                             params: Dict[str, ParamSpec], owner: str,
+                             state: Dict[str, np.ndarray], transport, *,
+                             session: str, alive_fn,
+                             ckpt=None, budget: Optional[float] = None,
+                             probe: float = 3.0, dst_alive_fn=None):
+    """`reshard_or_restore` that survives membership CHURN mid-reshard.
+
+    The plain ladder plans once: a source owner whose lease lapses while
+    its payload is in flight stalls every receiver until the WHOLE budget
+    burns, then surfaces as a generic `ReshardTimeout` and forces the
+    full-restore rung — even though the shrunken roster could have served
+    the same bricks live. This variant executes in `probe`-second slices;
+    when a slice expires it re-polls `alive_fn()` (the ElasticManager's
+    store-side lease truth) and, if the planned `available` set shrank,
+    RE-PLANS against the survivors immediately instead of waiting out the
+    deadline. Lost bricks come from `ckpt` (partial restore); only an
+    exhausted cumulative budget (or an unrecoverable plan without a
+    checkpoint) falls to the full-restore rung / typed error.
+
+    Each attempt's session is derived from the observed survivor set
+    (`_avail_digest`), so peers re-planning after the same eviction
+    converge on identical transport keys and an identical plan digest with
+    no extra coordination; a retry under an UNCHANGED roster reuses the
+    same session — every key it re-puts is idempotent (same bytes).
+
+    Returns (new_state, how) exactly like `reshard_or_restore`, publishes
+    the rung for `rung_agreement()` under the BASE session, and raises
+    `ReshardTimeout` only when the cumulative budget is truly gone.
+    """
+    bound = (budget if budget is not None
+             else env_timeout("PT_RESHARD_TIMEOUT", 120.0))
+    dl = Deadline(bound, what=f"churn-aware reshard[{session}] @ {owner}")
+    last_err: Optional[BaseException] = None
+    # (avail-digest, reader) of the last attempt: retries under an
+    # UNCHANGED roster must not re-read the lost params from the
+    # checkpoint every probe slice — with a large sharded table that
+    # would turn one slow transfer into dozens of redundant full reads
+    reader_cache: Tuple[Optional[str], Optional[Callable]] = (None, None)
+    while True:
+        try:
+            dl.check(exc=ReshardTimeout,
+                     detail=f"last attempt failed with "
+                            f"{type(last_err).__name__}: {last_err}"
+                     if last_err is not None else "")
+        except ReshardTimeout:
+            if ckpt is None:
+                raise
+            # budget exhausted: the full-restore rung, against the plan of
+            # the CURRENT survivor view (dst shards don't depend on it)
+            avail = set(alive_fn()) & set(src_mesh.owners)
+            plan = plan_reshard(src_mesh, dst_mesh, params, available=avail)
+            t0 = time.perf_counter()
+            out = _full_restore_state(plan, owner, ckpt)
+            _register_report({
+                "session": session, "owner": owner, "how": "full-restore",
+                "bytes_moved": 0, "bytes_local": 0,
+                "bytes_from_ckpt": sum(v.nbytes for v in out.values()),
+                "naive_bytes": plan.naive_bytes,
+                "src_owners": len(plan.src_mesh.owners),
+                "dst_owners": len(plan.dst_mesh.owners),
+                "downtime_s": time.perf_counter() - t0,
+                "fallback_cause": type(last_err).__name__
+                if last_err is not None else "BudgetExhausted",
+            })
+            _publish_rung(transport, session, owner, "full-restore")
+            return out, "full-restore"
+        avail = set(alive_fn()) & set(src_mesh.owners)
+        plan = plan_reshard(src_mesh, dst_mesh, params, available=avail)
+        tag = _avail_digest(avail)
+        sess = f"{session}-r{tag}"
+        if reader_cache[0] == tag:
+            reader = reader_cache[1]
+        else:
+            reader = None
+            if ckpt is not None:
+                lost_names = sorted({p.param for p in plan.lost_for(owner)})
+                reader = (ckpt.read_params(lost_names).__getitem__
+                          if lost_names else None)
+            reader_cache = (tag, reader)
+        rem = dl.remaining(floor=0.05)
+        slice_budget = rem if rem is None else min(max(probe, 0.1), rem)
+        try:
+            out = execute(plan, owner, state, transport, session=sess,
+                          budget=slice_budget, ckpt_reader=reader)
+            how = "partial-restore" if plan.lost_for(owner) else "reshard"
+            _publish_rung(transport, session, owner, how)
+            return out, how
+        except ShardLost:
+            raise
+        except (DeadlineExceeded, ConnectionError, ReshardError) as e:
+            # a DEAD DESTINATION owner can never reach the commit barrier
+            # and no source re-plan fixes that — the destination MESH
+            # itself must be re-planned (the supervisor's next epoch does
+            # exactly that), so fail fast instead of burning the budget
+            if dst_alive_fn is not None:
+                gone = set(dst_mesh.owners) - set(dst_alive_fn())
+                if gone:
+                    raise ReshardError(
+                        f"churn-aware reshard[{session}]: destination "
+                        f"owner(s) {sorted(gone)} lapsed mid-reshard — "
+                        f"the destination mesh must be re-planned") from e
+            # a slice expiring under an UNCHANGED roster is just a slow
+            # transfer: loop and retry the SAME session (idempotent keys,
+            # published payloads persist, so progress accumulates); a
+            # SHRUNKEN roster re-plans next iteration under a new session
+            last_err = e
 
 
 def rung_agreement(plan: ReshardPlan, transport, *, session: str,

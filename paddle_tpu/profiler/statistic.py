@@ -226,6 +226,35 @@ def reshard_summary() -> str:
     return "\n".join(lines)
 
 
+def supervisor_summary() -> str:
+    """Elastic-supervisor scale events (distributed/supervisor.py) as
+    text: per event the supervision epoch, the failure cause (lease
+    lapse, a typed timeout escaping a step, a missed barrier, a join),
+    the mesh transition, the ladder rung the swap landed on, the
+    generation it committed/rolled to, detect latency, total downtime and
+    wire bytes moved. A healthy elastic fleet shows `reshard` rungs whose
+    downtime sits near the detect latency plus the transfer time;
+    recurring `full-restore` rungs mean live bytes keep dying with their
+    exclusive owner — shard the state wider or commit more often."""
+    from ..distributed.supervisor import supervisor_events
+
+    events = supervisor_events()
+    if not events:
+        return "supervisor: no scale events"
+    head = (f"{'Epoch':>5} {'Cause':<18} {'Mesh':<10} {'Rung':<16} "
+            f"{'Gen':>5} {'Detect':>8} {'Downtime':>9} {'Moved':>12}")
+    lines = [f"supervisor: {len(events)} scale event(s)", head,
+             "-" * len(head)]
+    for e in events:
+        mesh = f"{e['old_size']}->{e['new_size']}"
+        lines.append(
+            f"{e['epoch']:>5} {str(e['cause'])[:18]:<18} {mesh:<10} "
+            f"{e['how']:<16} {str(e['generation']):>5} "
+            f"{e['detect_latency_s']:>7.3f}s {e['downtime_s']:>8.3f}s "
+            f"{e['bytes_moved']:>12}")
+    return "\n".join(lines)
+
+
 def summary(events: List[dict], sorted_by: str = "total",
             time_unit: str = "ms") -> str:
     stats = aggregate(events)

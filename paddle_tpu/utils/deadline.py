@@ -71,6 +71,16 @@ class CommTimeout(DeadlineExceeded):
     site so the stuck collective is identifiable from the error alone."""
 
 
+class SupervisorTimeout(DeadlineExceeded):
+    """A supervised scale event (failure detection, survivor rendezvous,
+    state swap, or loop resume) ran out of its PT_SUPERVISOR_TIMEOUT
+    budget — the elastic training supervisor could not converge the
+    survivors within the bound (distributed/supervisor.py). The event's
+    cumulative Deadline spans all four supervisor.* sites, so a stall
+    anywhere in the closed loop fails typed instead of wedging the
+    surviving fleet."""
+
+
 class MembershipTimeout(DeadlineExceeded):
     """The elastic membership never reached the required size within the
     budget (ElasticManager.require_np) — the typed form of wait_for_np's

@@ -62,6 +62,52 @@ def main(site: str) -> None:
                 jnp.ones((2048,), jnp.float32), owner="no-hang-child",
                 budget=BUDGET)
         assert out.shape == (2048,)
+    elif site.startswith("supervisor."):
+        import numpy as np
+        from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+        from paddle_tpu.distributed.launch.elastic import ElasticManager
+        from paddle_tpu.distributed.store import create_master_store
+        from paddle_tpu.distributed.supervisor import (Supervisor,
+                                                       SupervisedParam)
+        from paddle_tpu.io import ShardedSampleStream
+
+        # ONE supervised scale event traverses all four supervisor.*
+        # sites: a second (manager-only) member joins, then leaves after
+        # step 2 — the supervisor detects the shrink, rendezvouses alone,
+        # swaps and resumes. The state is REPLICATED so the event never
+        # waits on the departed member's bytes (barrier off: b runs no
+        # supervisor of its own).
+        store = create_master_store()
+        a = ElasticManager(store, node_id="a", np_range=(1, 2),
+                           heartbeat_interval=0.1, timeout=0.5)
+        b = ElasticManager(store, node_id="b", np_range=(1, 2),
+                           heartbeat_interval=0.1, timeout=0.5)
+        shards = [[np.full((2,), 10 * s + i, np.float32) for i in range(4)]
+                  for s in range(3)]
+        sup = Supervisor(
+            store=store, elastic=a,
+            ckpt=CheckpointManager(os.path.join(os.getcwd(), "ckpt")),
+            params={"w": SupervisedParam((4,), np.float32, (None,))},
+            state={"w": np.ones((4,), np.float32)},
+            stream=ShardedSampleStream(shards, seed=0),
+            batch_size=2, budget=BUDGET, watch_budget=BUDGET,
+            barrier=False, ckpt_every=1, churn_probe=0.3)
+        try:
+            sup.bind(2, timeout=10.0)
+
+            def fn(state, batch, s):
+                if s.steps_done == 1:
+                    b.leave()
+                return {"w": state["w"] + 1.0}
+
+            sup.run(fn, 4)
+            assert sup.roster == ["a"], sup.roster
+            assert sup.events, "no scale event ran"
+        finally:
+            sup.close()
+            a.stop()
+            b.stop()
+            store.stop()
     elif site == "io.stream_fetch":
         import numpy as np
         from paddle_tpu.io import ShardedSampleStream, StreamLoader

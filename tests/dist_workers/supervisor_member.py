@@ -1,0 +1,136 @@
+"""Chaos member for the elastic-supervisor kill matrix
+(tests/test_supervisor.py, tests/test_streaming.py dp-shrink matrix).
+
+argv: store_port node_id out_dir n_steps n_members
+
+One worker of a real multi-process supervised dp run over the parent's
+master TCPStore: a dp-row-sharded "table", a replicated "w", a
+GLOBAL-ORDER sample stream, commit-every-step generations in the SHARED
+checkpoint dir under out_dir. The parent arms chaos through the
+environment:
+
+    PT_FAULTPOINT=supervisor.<site> PT_FAULTPOINT_MODE=crash
+        this member SIGKILLs itself at the armed supervisor transition
+        (the kill matrix);
+    PT_CRASHPOINT=stream.cursor_staged|stream.cursor_committed
+        this member (made the COMMITTER by giving it the lowest node id)
+        dies inside save_stream_checkpoint mid-generation (the streaming
+        dp-shrink writer-kill matrix);
+    PT_SUP_LEAVE_STEP=<k>
+        graceful scale-down: request_stop(leave=True) once steps_done
+        reaches k (the scripted event that puts the OTHER armed member
+        inside a scale event when its faultpoint fires).
+
+On a clean exit writes ``done_{node_id}.json`` with the final state, the
+step/cursor position and every scale event this member resumed from —
+the parent replays the deterministic schedule segment-by-segment from
+those records and asserts the survivor state bitwise, which proves
+exactly-once delivery and zero committed-progress loss in one equality.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed.ckpt_manager import CheckpointManager  # noqa: E402
+from paddle_tpu.distributed.launch.elastic import ElasticManager  # noqa: E402
+from paddle_tpu.distributed.store import TCPStore  # noqa: E402
+from paddle_tpu.distributed.supervisor import (Supervisor,  # noqa: E402
+                                               SupervisedParam)
+from paddle_tpu.io.streaming import ShardedSampleStream  # noqa: E402
+
+# keep in sync with tests/test_supervisor.py's oracle
+ROWS, DIM, WVEC = 12, 4, 4
+N_SHARDS, PER_SHARD = 4, 16      # 64 samples per stream epoch
+BATCH = 2                        # per-rank batch size
+HB, LEASE_TIMEOUT = 0.1, 0.6
+
+
+def build_stream() -> ShardedSampleStream:
+    shards = [[np.asarray([100.0 * s + i], np.float32)
+               for i in range(PER_SHARD)] for s in range(N_SHARDS)]
+    return ShardedSampleStream(shards, seed=0)
+
+
+def full_state():
+    return {"table": np.arange(ROWS * DIM,
+                               dtype=np.float32).reshape(ROWS, DIM),
+            "w": np.zeros((WVEC,), np.float32)}
+
+
+PARAMS = {
+    "table": SupervisedParam((ROWS, DIM), np.float32, ("dp", None)),
+    "w": SupervisedParam((WVEC,), np.float32, (None,)),
+}
+
+
+def shard_state(members, nid):
+    """This member's dp shards of the deterministic full state."""
+    full = full_state()
+    n = len(members)
+    r = sorted(members).index(nid)
+    rows = ROWS // n
+    return {"table": full["table"][r * rows:(r + 1) * rows].copy(),
+            "w": full["w"].copy()}
+
+
+def apply_rank_step(table_rows, w, stripe):
+    """The per-rank update — ONE implementation shared by the members and
+    the parent's oracle so the bitwise comparison can never drift: each
+    owned table row += 1e-3 * sum(stripe values), w += 1."""
+    inc = np.float32(sum(float(b[0]) for b in stripe)) if stripe \
+        else np.float32(0.0)
+    return (table_rows + np.float32(1e-3) * inc,
+            w + np.float32(1.0))
+
+
+def step_fn(state, batch, sup):
+    table, w = apply_rank_step(state["table"], state["w"], batch)
+    return {"table": table, "w": w}
+
+
+def main() -> None:
+    port, node_id, out_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    n_steps, n_members = int(sys.argv[4]), int(sys.argv[5])
+    budget = float(os.environ.get("PT_TEST_BUDGET", "20.0"))
+    leave_step = int(os.environ.get("PT_SUP_LEAVE_STEP", "-1"))
+
+    store = TCPStore("127.0.0.1", port, is_master=False)
+    elastic = ElasticManager(store, node_id=node_id,
+                             np_range=(1, n_members),
+                             heartbeat_interval=HB, timeout=LEASE_TIMEOUT)
+    mgr = CheckpointManager(os.path.join(out_dir, "ckpt"), keep_last_k=16)
+    sup = Supervisor(
+        store=store, elastic=elastic, ckpt=mgr, params=PARAMS,
+        state={}, stream=build_stream(), batch_size=BATCH,
+        budget=budget, watch_budget=budget, ckpt_every=1,
+        churn_probe=1.0)
+    members = sup.bind(n_members, timeout=30.0)
+    sup.state = shard_state(members, node_id)
+
+    def fn(state, batch, s):
+        if leave_step >= 0 and s.steps_done == leave_step:
+            s.request_stop(leave=True)
+        return step_fn(state, batch, s)
+
+    final = sup.run(fn, n_steps)
+    with open(os.path.join(out_dir, f"done_{node_id}.json"), "w") as f:
+        json.dump({
+            "node": node_id,
+            "steps": sup.steps_done,
+            "roster": sup.roster,
+            "cursor": sup.stream.state_dict(),
+            "events": sup.events,
+            "state": {k: np.asarray(v).tolist() for k, v in final.items()},
+        }, f)
+    sup.close()
+    elastic.stop()
+    store.stop()
+
+
+if __name__ == "__main__":
+    main()
+    print("DONE", flush=True)

@@ -261,6 +261,118 @@ def test_matrix_covers_every_stream_crash_site():
     assert set(chaos.registered_sites("stream.")) <= set(EXPECTED_SURVIVOR)
 
 
+# ---------------- writer-kill ACROSS a dp-shrink (supervisor swap) ----------------
+
+# the supervisor's gather-commit runs save_stream_checkpoint, so the
+# stream.cursor_* crash sites fire inside a LIVE supervised fleet; killing
+# the committer there forces a dp2 -> dp1 shrink whose rollback generation
+# depends on where the kill landed (staged: the generation never committed)
+SHRINK_SURVIVOR = {
+    # committer dies on its 3rd save (init gen 0, gen 1, gen 2):
+    "stream.cursor_staged": 1,     # gen 2 never committed -> roll to 1
+    "stream.cursor_committed": 2,  # gen 2 committed before the kill
+}
+
+
+@pytest.mark.parametrize("site", sorted(SHRINK_SURVIVOR))
+def test_writer_kill_across_dp_shrink_exactly_once(tmp_path, site):
+    """SIGKILL the COMMITTER inside save_stream_checkpoint during a real
+    dp2 supervised run: the survivor detects the lapse, rolls the fleet
+    onto the surviving committed generation (params AND cursor from the
+    same commit point) and finishes on dp1 — the full run replayed from
+    the recorded event boundaries matches the survivor bitwise, i.e. the
+    global sample prefix was delivered exactly once across the shrink."""
+    import threading
+
+    from paddle_tpu.distributed import supervisor as sv
+    from paddle_tpu.distributed.launch.elastic import ElasticManager
+    from paddle_tpu.distributed.store import create_master_store
+
+    member_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "dist_workers")
+    sys.path.insert(0, member_dir)
+    try:
+        from supervisor_member import (BATCH as SBATCH, PARAMS,
+                                       build_stream as sup_stream,
+                                       shard_state, step_fn)
+        import tests.test_supervisor as ts
+    finally:
+        sys.path.pop(0)
+
+    sv.reset_events()
+    chaos.reset_hits()
+    n_steps = 5
+    store = create_master_store()
+    proc = None
+    el = sup = None
+    try:
+        # child 'a' is the LOWEST id -> the committer -> the writer we kill
+        env = dict(os.environ,
+                   PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""),
+                   JAX_PLATFORMS="cpu", PT_TEST_BUDGET="20.0",
+                   PT_CRASHPOINT=site, PT_CRASHPOINT_HITS="3")
+        for k in ("PT_FAULTPOINT", "PT_FAULTPOINT_MODE"):
+            env.pop(k, None)
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(member_dir, "supervisor_member.py"),
+             str(store.port), "a", str(tmp_path), str(n_steps), "2"],
+            cwd=str(tmp_path), env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+        el = ElasticManager(store, node_id="b", np_range=(1, 2),
+                            heartbeat_interval=0.1, timeout=0.6)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_k=16)
+        sup = sv.Supervisor(store=store, elastic=el, ckpt=mgr,
+                            params=PARAMS, state={}, stream=sup_stream(),
+                            batch_size=SBATCH, ckpt_every=1, budget=20.0,
+                            watch_budget=20.0, churn_probe=1.0)
+        outcome = {}
+
+        def run():
+            try:
+                members = sup.bind(2, timeout=30.0)
+                sup.state = shard_state(members, "b")
+                outcome["state"] = sup.run(step_fn, n_steps)
+            except BaseException as e:  # noqa: BLE001
+                outcome["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(120.0)
+        assert not t.is_alive(), f"{site}: survivor hung"
+        assert "error" not in outcome, (site, outcome.get("error"))
+
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == -signal.SIGKILL, (
+            f"{site}: committer should die by SIGKILL, got "
+            f"rc={proc.returncode}\n{out}\n{err[-2000:]}")
+
+        want_gen = SHRINK_SURVIVOR[site]
+        evs = [e for e in sup.events]
+        assert evs, "no scale event recorded"
+        assert evs[0]["generation"] == want_gen, (site, evs[0])
+        assert evs[0]["how"] == "full-restore"
+        # the rollback cursor sits exactly at the committed generation's
+        # global-sample boundary: gen N == N dp2 steps == N * 2 ranks *
+        # BATCH samples
+        assert evs[0]["cursor_pos"] == want_gen * 2 * SBATCH, evs[0]
+        # one bitwise equality proves exactly-once + zero committed loss
+        full, members = ts._replay(evs, n_steps, ["a", "b"], mgr=mgr)
+        assert members == ["b"]
+        want = ts._owner_shards(full, members, "b")
+        for k in want:
+            assert np.array_equal(outcome["state"][k], want[k]), (site, k)
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        if sup is not None:
+            sup.close()
+        if el is not None:
+            el.stop()
+        store.stop()
+
+
 def test_writer_kill_matrix_resumes_no_dup_no_loss(tmp_path):
     """SIGKILL the writer at each cursor-checkpoint site; restore from
     the surviving committed generation and finish the epoch: committed

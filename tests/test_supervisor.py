@@ -1,0 +1,649 @@
+"""Elastic training supervisor (distributed/supervisor.py).
+
+Four legs:
+
+1. closed-loop units — a graceful leave shrinks the mesh and the
+   surviving state matches the deterministic oracle bitwise; a joiner
+   grows the mesh and receives its shards via the planner; epoch fencing
+   rejects a worker that missed an epoch; a typed failure under a FULL
+   roster propagates instead of being eaten as churn;
+2. churn-aware reshard (the PR's fix) — a lease lapsing MID-reshard
+   re-plans against survivors within a probe slice instead of burning the
+   whole deadline into a generic ReshardTimeout;
+3. chaos — a real multi-process supervised dp run with a member
+   SIGKILLed at each `supervisor.*` faultpoint site: survivors resume on
+   the shrunken mesh within the supervisor deadline, every resumed
+   state is bitwise a fresh restore of the SAME committed generation,
+   and the stream's global sample prefix replays exactly-once (one
+   oracle equality proves both). Quick dp2 -> dp1 representative in
+   tier-1; the dp4 -> dp2 site matrix is `slow`;
+4. observability — profiler.supervisor_summary() renders the events.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed import reshard as rs
+from paddle_tpu.distributed import supervisor as sv
+from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+from paddle_tpu.distributed.launch.elastic import ElasticManager
+from paddle_tpu.distributed.store import create_master_store
+from paddle_tpu.distributed.supervisor import (Supervisor, SupervisedParam,
+                                               StaleEpoch)
+from paddle_tpu.utils.deadline import Deadline, StoreTimeout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MEMBER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "dist_workers", "supervisor_member.py")
+
+sys.path.insert(0, os.path.dirname(MEMBER))
+from supervisor_member import (BATCH, PARAMS, ROWS,  # noqa: E402
+                               apply_rank_step, build_stream, full_state,
+                               shard_state, step_fn)
+sys.path.pop(0)
+
+
+def _mk_elastic(store, nid, n=4):
+    return ElasticManager(store, node_id=nid, np_range=(1, n),
+                          heartbeat_interval=0.1, timeout=0.6)
+
+
+def _mk_sup(store, elastic, mgr, members, nid, **kw):
+    kw.setdefault("budget", 20.0)
+    kw.setdefault("watch_budget", 20.0)
+    kw.setdefault("churn_probe", 1.0)
+    state = shard_state(members, nid) if members else {}
+    sup = Supervisor(store=store, elastic=elastic, ckpt=mgr, params=PARAMS,
+                     state=state, stream=build_stream(),
+                     batch_size=BATCH, ckpt_every=1, **kw)
+    return sup
+
+
+# ---------------------------------------------------------------------------
+# the deterministic oracle: replay the schedule segment-by-segment from the
+# recorded scale events; ONE bitwise equality then proves zero
+# committed-progress loss AND exactly-once sample delivery
+# ---------------------------------------------------------------------------
+
+def _replay(events, n_steps, initial_members, mgr=None):
+    """Returns (full_state, members) after replaying `n_steps` with the
+    membership/step/cursor boundaries the events recorded. When `mgr` is
+    given, each event's committed generation is restored and asserted
+    bitwise against the replayed state at that boundary."""
+    full = full_state()
+    stream = build_stream()
+    members = sorted(initial_members)
+    i = 0
+    for e in sorted(events, key=lambda ev: ev["epoch"]):
+        # run the committed segment up to the event's resume point
+        assert e["steps"] >= i or e["how"] == "full-restore", e
+        target = int(e["steps"])
+        while i < target:
+            _sim_step(full, stream, members)
+            i += 1
+        if e["cursor_pos"] is not None:
+            assert stream.pos == e["cursor_pos"], (
+                f"epoch {e['epoch']}: resumed cursor {e['cursor_pos']} != "
+                f"oracle prefix {stream.pos} — a sample was duplicated or "
+                f"lost")
+        if mgr is not None and e["generation"] is not None:
+            got = {"table": np.zeros((ROWS, 4), np.float32),
+                   "w": np.zeros((4,), np.float32)}
+            step = mgr.restore(got, int(e["generation"]))
+            assert step == int(e["generation"])
+            for k in full:
+                assert np.array_equal(got[k], full[k]), (
+                    f"epoch {e['epoch']}: generation {e['generation']} "
+                    f"param {k!r} not bitwise the oracle state")
+        members = sorted(e["roster"])
+    while i < n_steps:
+        _sim_step(full, stream, members)
+        i += 1
+    return full, members
+
+
+def _sim_step(full, stream, members):
+    n = len(members)
+    if stream.pos >= stream.epoch_len():
+        stream.roll_epoch()
+    take = min(BATCH * n, stream.epoch_len() - stream.pos)
+    window = [stream.sample_at(stream.pos + j) for j in range(take)]
+    stream.advance(take)
+    rows = ROWS // n
+    w_new = None
+    for r in range(n):
+        t, w_new = apply_rank_step(
+            full["table"][r * rows:(r + 1) * rows], full["w"],
+            window[r::n])
+        full["table"][r * rows:(r + 1) * rows] = t
+    full["w"] = w_new
+
+
+def _owner_shards(full, members, nid):
+    n = len(members)
+    r = sorted(members).index(nid)
+    rows = ROWS // n
+    return {"table": full["table"][r * rows:(r + 1) * rows],
+            "w": full["w"]}
+
+
+# ---------------------------------------------------------------------------
+# closed-loop units (in-process members over one master store)
+# ---------------------------------------------------------------------------
+
+def _run_fleet(tmp_path, node_ids, n_steps, fns, joiners=(), budget=20.0):
+    """Run one in-process supervised fleet (threads). Returns
+    (sups, results, errors)."""
+    store = create_master_store()
+    els = {nid: _mk_elastic(store, nid) for nid in node_ids}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_k=16)
+    members = sorted(node_ids)
+    sups = {nid: _mk_sup(store, els[nid], mgr, members, nid, budget=budget,
+                         watch_budget=budget)
+            for nid in node_ids}
+    results, errors = {}, {}
+
+    def run(nid):
+        try:
+            sups[nid].bind(len(node_ids), timeout=15.0)
+            results[nid] = sups[nid].run(fns[nid], n_steps)
+        except BaseException as e:  # noqa: BLE001 — asserted by callers
+            errors[nid] = e
+
+    threads = {nid: threading.Thread(target=run, args=(nid,), daemon=True)
+               for nid in node_ids}
+    for t in threads.values():
+        t.start()
+    for t in threads.values():
+        t.join(90.0)
+    assert not any(t.is_alive() for t in threads.values()), \
+        "supervised fleet hung"
+    return sups, results, errors, mgr, store, els
+
+
+def _stop_fleet(sups, store, els):
+    for s in sups.values():
+        s.close()
+    for e in els.values():
+        e.stop()
+    store.stop()
+
+
+def test_graceful_leave_shrinks_and_matches_oracle(tmp_path):
+    """dp2 -> dp1: member b leaves after step 3; a detects, commits,
+    swaps, resumes, finishes — final state bitwise the deterministic
+    oracle, event recorded with the exactly-once cursor."""
+    sv.reset_events()
+
+    def fn_a(state, batch, sup):
+        return step_fn(state, batch, sup)
+
+    def fn_b(state, batch, sup):
+        if sup.steps_done == 2:
+            sup.request_stop(leave=True)
+        return step_fn(state, batch, sup)
+
+    sups, results, errors, mgr, store, els = _run_fleet(
+        tmp_path, ["a", "b"], 6, {"a": fn_a, "b": fn_b})
+    try:
+        assert not errors, errors
+        a = sups["a"]
+        assert a.steps_done == 6 and a.roster == ["a"]
+        assert len(a.events) == 1
+        e = a.events[0]
+        assert e["old_size"] == 2 and e["new_size"] == 1
+        assert e["generation"] == 3 and e["steps"] == 3
+        full, members = _replay(a.events, 6, ["a", "b"], mgr=mgr)
+        assert members == ["a"]
+        for k in full:
+            assert np.array_equal(results["a"][k], full[k]), k
+        # the module-level record feeds profiler.supervisor_summary()
+        assert any(ev["epoch"] == e["epoch"] for ev in sv.supervisor_events())
+    finally:
+        _stop_fleet(sups, store, els)
+
+
+def test_grow_joiner_receives_shards_via_planner(tmp_path):
+    """dp1 -> dp2 grow: a runs alone; j joins with joining=True and NO
+    state — its shards arrive via the planner; both finish on dp2 with
+    the oracle state."""
+    store = create_master_store()
+    els = {"a": _mk_elastic(store, "a")}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_k=16)
+    sup_a = _mk_sup(store, els["a"], mgr, ["a"], "a")
+    results, errors = {}, {}
+
+    def slow_step(state, batch, sup):
+        time.sleep(0.4)  # keep a mid-run while the joiner arrives
+        return step_fn(state, batch, sup)
+
+    def run_a():
+        try:
+            sup_a.bind(1, timeout=10.0)
+            results["a"] = sup_a.run(slow_step, 6)
+        except BaseException as e:  # noqa: BLE001
+            errors["a"] = e
+
+    ta = threading.Thread(target=run_a, daemon=True)
+    ta.start()
+    time.sleep(1.0)  # let a complete a couple of dp1 steps
+    els["j"] = _mk_elastic(store, "j")
+    sup_j = Supervisor(store=store, elastic=els["j"], ckpt=mgr,
+                       params=PARAMS, state={}, stream=build_stream(),
+                       batch_size=BATCH, ckpt_every=1, budget=20.0,
+                       watch_budget=20.0, churn_probe=1.0, joining=True)
+
+    def run_j():
+        try:
+            results["j"] = sup_j.run(step_fn, 6)
+        except BaseException as e:  # noqa: BLE001
+            errors["j"] = e
+
+    tj = threading.Thread(target=run_j, daemon=True)
+    tj.start()
+    ta.join(60.0)
+    tj.join(60.0)
+    try:
+        assert not ta.is_alive() and not tj.is_alive(), "grow fleet hung"
+        assert not errors, errors
+        assert sup_a.roster == ["a", "j"] and sup_j.roster == ["a", "j"]
+        assert sup_a.events and sup_a.events[0]["new_size"] == 2
+        full, members = _replay(sup_a.events, 6, ["a"], mgr=mgr)
+        assert members == ["a", "j"]
+        for nid in ("a", "j"):
+            want = _owner_shards(full, members, nid)
+            for k in want:
+                assert np.array_equal(results[nid][k], want[k]), (nid, k)
+    finally:
+        sup_a.close()
+        sup_j.close()
+        for e in els.values():
+            e.stop()
+        store.stop()
+
+
+def test_epoch_fencing_rejects_stale_worker(tmp_path):
+    """A worker whose supervision epoch is behind the committed counter
+    (it missed events while suspended) gets the typed StaleEpoch from the
+    rendezvous — it may not rejoin mid-swap."""
+    store = create_master_store()
+    el = _mk_elastic(store, "a")
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    sup = _mk_sup(store, el, mgr, ["a"], "a")
+    try:
+        sup.bind(1, timeout=10.0)
+        # the fleet moved two epochs past this worker
+        store.add(f"{sup.ns}/epoch", 2)
+        with pytest.raises(StaleEpoch, match="may not rejoin mid-swap"):
+            sup._rendezvous(Deadline(5.0, what="test"))
+    finally:
+        sup.close()
+        el.stop()
+        store.stop()
+
+
+def test_typed_failure_with_full_roster_propagates(tmp_path):
+    """The classifier law: a typed timeout escaping a step while the
+    lease roster is INTACT is a real infrastructure failure — it must
+    propagate, never be eaten as churn."""
+    store = create_master_store()
+    el = _mk_elastic(store, "a")
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    sup = _mk_sup(store, el, mgr, ["a"], "a")
+    try:
+        sup.bind(1, timeout=10.0)
+
+        def bad_step(state, batch, s):
+            raise StoreTimeout("a wedged dependency", 1.0)
+
+        with pytest.raises(StoreTimeout, match="wedged"):
+            sup.run(bad_step, 2)
+        assert sup.events == []
+    finally:
+        sup.close()
+        el.stop()
+        store.stop()
+
+
+def test_stream_must_be_global_order():
+    class _FakeStream:
+        world_size = 2
+
+    with pytest.raises(ValueError, match="world_size=1"):
+        Supervisor(store=None, elastic=type(
+            "E", (), {"node_id": "a", "_ttl_ms": 1000})(), ckpt=None,
+            stream=_FakeStream())
+
+
+def test_supervisor_summary_renders():
+    import paddle_tpu.profiler as profiler
+
+    sv.reset_events()
+    assert "no scale events" in profiler.supervisor_summary()
+    sv._register_event({
+        "node": "a", "epoch": 3, "cause": "lease-lapse", "how": "reshard",
+        "generation": 7, "steps": 7, "roster": ["a", "b"], "old_size": 3,
+        "new_size": 2, "bytes_moved": 4096, "detect_latency_s": 0.01,
+        "downtime_s": 0.5, "state_sha": "ff", "cursor_pos": 28})
+    text = profiler.supervisor_summary()
+    assert "lease-lapse" in text and "3->2" in text and "reshard" in text
+    sv.reset_events()
+
+
+def test_attached_train_step_reshards_at_resume(tmp_path):
+    """The single-controller leg: with a TrainStep attached, every resume
+    calls TrainStep.reshard(train_mesh(n)) FIRST — device state moves
+    placement-only (bitwise) and the step re-lowers at the new shape."""
+    import paddle_tpu as P
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.parallel.trainer import compile_train_step
+
+    store = create_master_store()
+    els = {nid: _mk_elastic(store, nid) for nid in ("a", "b")}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_k=16)
+    prev_mesh = mesh_mod.get_mesh()
+    try:
+        P.seed(0)
+        jmesh = mesh_mod.init_mesh({"dp": 2})
+        model = P.nn.Linear(8, 4)
+        opt = P.optimizer.SGD(learning_rate=0.1,
+                              parameters=model.parameters())
+
+        def loss_fn(m, b):
+            x, y = b
+            return P.nn.functional.mse_loss(m(P.to_tensor(x)),
+                                            P.to_tensor(y))
+
+        tstep = compile_train_step(model, loss_fn, opt, mesh=jmesh)
+        rng = np.random.RandomState(0)
+        dbatch = (rng.randn(8, 8).astype(np.float32),
+                  rng.randn(8, 4).astype(np.float32))
+        tstep(dbatch)
+        before = [np.asarray(p._value).tobytes() for p in tstep._params]
+
+        sups = {}
+        for nid in ("a", "b"):
+            sups[nid] = Supervisor(
+                store=store, elastic=els[nid], ckpt=mgr, params=PARAMS,
+                state=shard_state(["a", "b"], nid), stream=build_stream(),
+                batch_size=BATCH, ckpt_every=1, budget=20.0,
+                watch_budget=20.0, churn_probe=1.0,
+                train_step=tstep if nid == "a" else None,
+                train_mesh=lambda n: mesh_mod.init_mesh({"dp": n}))
+        results, errors = {}, {}
+
+        def fleet_fn(nid):
+            def fn(state, batch, sup):
+                if nid == "b" and sup.steps_done == 2:
+                    sup.request_stop(leave=True)
+                return step_fn(state, batch, sup)
+            return fn
+
+        threads = {}
+        for nid in ("a", "b"):
+            def run(nid=nid):
+                try:
+                    sups[nid].bind(2, timeout=15.0)
+                    results[nid] = sups[nid].run(fleet_fn(nid), 5)
+                except BaseException as e:  # noqa: BLE001
+                    errors[nid] = e
+            threads[nid] = threading.Thread(target=run, daemon=True)
+            threads[nid].start()
+        for t in threads.values():
+            t.join(90.0)
+        assert not any(t.is_alive() for t in threads.values())
+        assert not errors, errors
+        assert sups["a"].events, "no scale event recorded"
+        # the attached step moved to the dp1 mesh, values bitwise
+        assert dict(tstep.mesh.shape) == {"dp": 1}
+        after = [np.asarray(p._value).tobytes() for p in tstep._params]
+        assert before == after, "TrainStep.reshard changed param bytes"
+        # and it still trains at the new shape
+        loss = tstep(dbatch)
+        assert np.isfinite(float(loss.numpy()))
+    finally:
+        for s in sups.values():
+            s.close()
+        for e in els.values():
+            e.stop()
+        store.stop()
+        mesh_mod.set_mesh(prev_mesh)
+
+
+# ---------------------------------------------------------------------------
+# churn-aware reshard (the in-flight lease-lapse fix)
+# ---------------------------------------------------------------------------
+
+def test_churn_replan_beats_the_deadline(tmp_path):
+    """Three owners plan a relayout; c's lease lapses mid-reshard (it
+    never serves its payloads). The OLD ladder burned the whole budget
+    into a generic ReshardTimeout; the churn-aware ladder re-plans
+    against survivors within ~a probe slice and completes with c's
+    bricks from the committed generation."""
+    full = full_state()
+    src = rs.MeshSpec.from_members(["a", "b", "c"])
+    dst = rs.MeshSpec.from_members(["a", "b"])
+    params = {n: p.param_spec() for n, p in PARAMS.items()}
+    states = {}
+    for o in src.owners:
+        states[o] = {n: np.ascontiguousarray(
+            full[n][tuple(slice(lo, hi) for lo, hi in rs.shard_index(
+                p.shape, p.src, src, o))])
+            for n, p in params.items()}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(dict(full), 1)
+    transport = rs.LocalTransport()
+
+    t_lapse = time.monotonic() + 0.8
+
+    def alive_fn():
+        # the store-side lease truth: c lapses 0.8s into the reshard
+        return ["a", "b"] if time.monotonic() > t_lapse \
+            else ["a", "b", "c"]
+
+    BUDGET = 30.0
+    results, errors = {}, {}
+
+    def run(owner):
+        try:
+            results[owner] = rs.reshard_or_restore_churn(
+                src, dst, params, owner, states[owner], transport,
+                session="churn-test", alive_fn=alive_fn, ckpt=mgr,
+                budget=BUDGET, probe=1.0)
+        except BaseException as e:  # noqa: BLE001
+            errors[owner] = e
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=run, args=(o,), daemon=True)
+               for o in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(25.0)
+    elapsed = time.monotonic() - t0
+    assert not any(t.is_alive() for t in threads), "churn reshard hung"
+    assert not errors, errors
+    # completed in ~one probe slice + replan, NOT the whole budget
+    assert elapsed < BUDGET / 2, f"burned the deadline: {elapsed:.1f}s"
+    # b's destination rows include the dead c's shard -> partial restore
+    # from the committed generation; a's come entirely from survivors
+    assert results["a"][1] == "reshard"
+    assert results["b"][1] == "partial-restore"
+    for owner in ("a", "b"):
+        out, how = results[owner]
+        want = {n: full[n][tuple(slice(lo, hi) for lo, hi in
+                                 rs.shard_index(p.shape, p.dst, dst, owner))]
+                for n, p in params.items()}
+        for k in want:
+            assert np.array_equal(out[k], want[k]), (owner, k)
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL a member at every supervisor.* site, mid-run
+# ---------------------------------------------------------------------------
+
+def _spawn_member(port, nid, out_dir, n_steps, n_members, extra_env=None):
+    env = dict(os.environ,
+               PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu",
+               PT_TEST_BUDGET="20.0")
+    for k in ("PT_FAULTPOINT", "PT_FAULTPOINT_MODE", "PT_CRASHPOINT",
+              "PT_SUP_LEAVE_STEP"):
+        env.pop(k, None)
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, MEMBER, str(port), nid, str(out_dir),
+         str(n_steps), str(n_members)],
+        cwd=str(out_dir), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _run_parent_member(store, out_dir, n_steps, n_members, budget=20.0):
+    """The surviving member 'a', run in-process (like the reshard chaos
+    parent). Returns (sup, result_dict_or_error)."""
+    el = _mk_elastic(store, "a", n=n_members)
+    mgr = CheckpointManager(os.path.join(str(out_dir), "ckpt"),
+                            keep_last_k=16)
+    sup = _mk_sup(store, el, mgr, None, "a", budget=budget,
+                  watch_budget=budget)
+    outcome = {}
+
+    def run():
+        try:
+            members = sup.bind(n_members, timeout=30.0)
+            sup.state = shard_state(members, "a")
+            outcome["state"] = sup.run(step_fn, n_steps)
+        except BaseException as e:  # noqa: BLE001
+            outcome["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return sup, el, mgr, t, outcome
+
+
+def _chaos_case(tmp_path, site, n_members, n_steps=6, leave=None,
+                armed=("c",)):
+    """Parent = survivor 'a' in-process; children = the other members.
+    `armed` children SIGKILL at `site`; `leave` maps a child id to its
+    scripted graceful-leave step (the event that puts the armed child
+    INSIDE a scale event when the site is not supervisor.detect)."""
+    sv.reset_events()
+    chaos.reset_hits()
+    ids = ["a", "b", "c", "d"][:n_members]
+    store = create_master_store()
+    procs = {}
+    sup = el = None
+    try:
+        for nid in ids[1:]:
+            extra = {}
+            if nid in armed:
+                extra = {"PT_FAULTPOINT": site, "PT_FAULTPOINT_MODE": "crash",
+                         "PT_FAULTPOINT_HITS": "1", "PT_FAULTPOINT_SKIP": "0"}
+            if leave and nid in leave:
+                extra["PT_SUP_LEAVE_STEP"] = str(leave[nid])
+            procs[nid] = _spawn_member(store.port, nid, tmp_path, n_steps,
+                                       n_members, extra)
+        sup, el, mgr, t, outcome = _run_parent_member(
+            store, tmp_path, n_steps, n_members)
+        t0 = time.monotonic()
+        t.join(120.0)
+        elapsed = time.monotonic() - t0
+        assert not t.is_alive(), f"{site}: survivor hung after 120s"
+        assert "error" not in outcome, (site, outcome.get("error"))
+        assert sup.steps_done == n_steps
+
+        # the armed children died by SIGKILL at the armed site
+        for nid in armed:
+            out, err = procs[nid].communicate(timeout=60)
+            assert procs[nid].returncode == -signal.SIGKILL, (
+                f"{site}: {nid} expected SIGKILL, got "
+                f"rc={procs[nid].returncode}\n{out}\n{err[-2000:]}")
+            assert "DONE" not in out, f"{site}: {nid} ran past the site"
+        # scripted leavers AND uninvolved members exit clean
+        for nid in ids[1:]:
+            if nid in armed:
+                continue
+            out, err = procs[nid].communicate(timeout=90)
+            assert procs[nid].returncode == 0 and "DONE" in out, (
+                f"{site}: member {nid} rc={procs[nid].returncode}"
+                f"\n{out}\n{err[-2000:]}")
+
+        # every event's resumed state is bitwise a fresh restore of the
+        # SAME committed generation, cut to this owner's new shards
+        for e in sup.events:
+            got = {"table": np.zeros((ROWS, 4), np.float32),
+                   "w": np.zeros((4,), np.float32)}
+            mgr.restore(got, int(e["generation"]))
+            mesh = rs.MeshSpec.from_members(e["roster"])
+            shards = {
+                n: got[n][tuple(slice(lo, hi) for lo, hi in rs.shard_index(
+                    p.param_spec().shape, p.param_spec().dst, mesh, "a"))]
+                for n, p in PARAMS.items()}
+            assert sv._state_sha(shards) == e["state_sha"], (
+                f"{site}: epoch {e['epoch']} resumed state is NOT bitwise "
+                f"the fresh restore of generation {e['generation']}")
+
+        # the oracle replay: zero committed-progress loss + exactly-once
+        # delivery, one bitwise equality (includes per-event cursor and
+        # generation-content checks)
+        full, members = _replay(sup.events, n_steps, ids, mgr=mgr)
+        assert sorted(sup.roster) == members
+        want = _owner_shards(full, members, "a")
+        for k in want:
+            assert np.array_equal(outcome["state"][k], want[k]), (site, k)
+        assert elapsed < 120.0
+        return sup
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        try:
+            if sup is not None:
+                sup.close()
+            if el is not None:
+                el.stop()
+        except Exception:  # noqa: BLE001 — teardown
+            pass
+        store.stop()
+
+
+def test_sites_registered_for_fault_matrix():
+    """The supervisor.* sites are enumerable via fault_sites(): the site
+    x mode matrix (test_no_hang.MATRIX) widens automatically."""
+    assert {"supervisor.detect", "supervisor.rendezvous",
+            "supervisor.swap", "supervisor.resume"} <= \
+        set(chaos.fault_sites("supervisor."))
+
+
+def test_member_sigkilled_at_detect_survivor_resumes_dp1(tmp_path):
+    """Quick tier-1 representative: dp2 -> dp1. Child b dies by SIGKILL
+    at its first supervisor.detect poll; a detects the lapse, commits,
+    swaps to dp1 and finishes bitwise the oracle."""
+    sup = _chaos_case(tmp_path, "supervisor.detect", n_members=2,
+                      armed=("b",))
+    assert sup.roster == ["a"]
+    assert sup.events and sup.events[-1]["new_size"] == 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", ["supervisor.detect",
+                                  "supervisor.rendezvous",
+                                  "supervisor.swap", "supervisor.resume"])
+def test_kill_matrix_dp4_to_dp2_every_supervisor_site(tmp_path, site):
+    """The acceptance matrix: a real dp4 run; b leaves gracefully at step
+    2 (the scale event), c SIGKILLs at the armed supervisor site (for
+    detect: at its first poll, before any event). Survivors a+d converge
+    on dp2 within the supervisor deadline; resumed params bitwise a fresh
+    restore of the same committed generation; the stream's global prefix
+    replays exactly-once (oracle equality)."""
+    sup = _chaos_case(tmp_path, site, n_members=4, n_steps=6,
+                      leave={"b": 2}, armed=("c",))
+    assert sorted(sup.roster) == ["a", "d"], sup.roster

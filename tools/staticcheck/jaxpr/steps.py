@@ -253,6 +253,78 @@ def _deepfm_step(root: str) -> StepResult:
                       program=prog, churn=churn)
 
 
+def _supervised_steps(root: str) -> List[StepResult]:
+    """The elastic supervisor's TrainStep swap leg
+    (distributed/supervisor.swap_train_step): capture the step at the
+    PRE-swap mesh shape, drive the single-controller reshard the
+    supervisor runs at every resume, and re-capture at the POST-swap
+    shape — both programs must lint clean, or a scale event would trade a
+    healthy step for a hazardous one mid-run. dp2 -> dp1 when this host
+    has >= 2 devices, dp1 -> dp1 (still a full drop + re-lower) otherwise."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as P
+    from paddle_tpu.distributed import supervisor as sv_mod
+    from paddle_tpu.jit import capture
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.parallel import trainer as trainer_mod
+
+    path, line = _anchor(sv_mod.swap_train_step, root)
+    prev_mesh = mesh_mod.get_mesh()
+    names = ("supervisor/trainstep-pre-swap",
+             "supervisor/trainstep-post-swap")
+    try:
+        n_pre = 2 if len(jax.devices()) >= 2 else 1
+        P.seed(1234)
+        mesh_pre = mesh_mod.init_mesh({"dp": n_pre},
+                                      devices=jax.devices()[:n_pre])
+        model = P.nn.Linear(8, 4)
+        opt = P.optimizer.SGD(learning_rate=0.1,
+                              parameters=model.parameters())
+
+        def loss_fn(m, b):
+            x, y = b
+            return P.nn.functional.mse_loss(m(P.to_tensor(x)),
+                                            P.to_tensor(y))
+
+        step = trainer_mod.compile_train_step(model, loss_fn, opt,
+                                              mesh=mesh_pre)
+        rng = np.random.RandomState(0)
+        batch = (rng.randn(8, 8).astype(np.float32),
+                 rng.randn(8, 4).astype(np.float32))
+
+        results = []
+        for name in names:
+            if name == names[1]:
+                # build the post-swap mesh HERE, not before the loop:
+                # init_mesh installs the global mesh, and the pre-swap
+                # capture must run with the dp{n_pre} mesh current
+                sv_mod.swap_train_step(step, mesh_mod.init_mesh(
+                    {"dp": 1}, devices=jax.devices()[:1]))
+            step(batch)
+            before = capture.capture_info()
+            step(batch)  # equivalent avals: must ride the captured step
+            after = capture.capture_info()
+            prog = step.captured_program
+            if prog is None:
+                results.append(StepResult(
+                    name, path, line,
+                    error=capture.capture_info()["last_bailout"]
+                    or "lower_step fell back to plain jit"))
+                continue
+            churn = after["fallback_calls"] > before["fallback_calls"] \
+                or after["lowerings"] > before["lowerings"]
+            results.append(StepResult(name, path, line, program=prog,
+                                      churn=churn))
+        return results
+    except Exception as e:  # noqa: BLE001 — a build failure is a bailout
+        err = f"{type(e).__name__}: {e}"[:200]
+        return [StepResult(n, path, line, error=err) for n in names]
+    finally:
+        mesh_mod.set_mesh(prev_mesh)
+
+
 def _to_static_step(root: str) -> StepResult:
     """A to_static-compiled layer — the jit.api lower_step path."""
     import numpy as np
@@ -303,6 +375,7 @@ def canonical_steps(root: str) -> List[StepResult]:
     results += _serving_steps(root)
     results.append(_to_static_step(root))
     results.append(_deepfm_step(root))
+    results += _supervised_steps(root)
     return results
 
 
