@@ -269,6 +269,17 @@ class Supervisor:
         self._transport = rs.StoreTransport(self._sup_store,
                                             prefix=f"{ns}/x")
         self.steps_done = 0
+        # rendezvous-key GC bookkeeping (ROADMAP supervisor-depth debt:
+        # the store used to accumulate {ns}/rdv/* and per-step barrier
+        # keys for the life of a run). Every rdv/rdvwin key this worker
+        # publishes OR reads is recorded with its epoch and deleted once a
+        # LATER epoch converges (the monotone counter fences every reader
+        # of older epochs, so the keys are dead); its own barrier keys are
+        # deleted rolling, one barrier behind (a member passing barrier S
+        # has observed every peer INSIDE barrier S, so no one can still be
+        # waiting on any step <= S-1 key).
+        self._rdv_keys: List[Tuple[int, str]] = []
+        self._bar_keys: List[str] = []
         self.epoch = int(self._sup_store.add(f"{ns}/epoch", 0))
         self._has_state = not joining
         self._joining = bool(joining)
@@ -426,6 +437,7 @@ class Supervisor:
         roster into a scale event."""
         key = f"{self.ns}/bar/{self.epoch}/{self.steps_done}"
         self._sup_store.set(f"{key}/{self.node_id}", b"1")
+        self._bar_keys.append(f"{key}/{self.node_id}")
         for peer in self.roster:
             if peer == self.node_id:
                 continue
@@ -446,6 +458,42 @@ class Supervisor:
                     dl.check(f"step barrier {self.steps_done}",
                              exc=SupervisorTimeout,
                              detail=f"peer {peer!r} alive but absent")
+        # rolling GC: everyone is inside barrier `steps_done` now, so our
+        # own keys from barriers <= steps_done - 1 can never be waited on
+        # again (each member deletes its own — collectively complete)
+        while len(self._bar_keys) > 1:
+            self._try_delete(self._bar_keys.pop(0))
+
+    def _try_delete(self, key: str) -> None:
+        """Best-effort housekeeping delete: a failed delete must never
+        fail the loop (the key is retried at the next GC point only if
+        still recorded — delete_key is idempotent either way)."""
+        try:
+            self._sup_store.delete_key(key)
+        except Exception:  # noqa: BLE001 — GC is advisory, never fatal
+            pass
+
+    def _gc_rendezvous_keys(self) -> None:
+        """Delete every recorded rdv/rdvwin key of epochs BEFORE the one
+        just converged (the monotone epoch counter fences all readers of
+        older epochs: a stale worker sees committed > target and gets the
+        typed StaleEpoch without touching those keys), plus the outgoing
+        roster's last barrier keys (older ones were rolled away live;
+        reconstructed by name because a dead peer cannot delete its own)."""
+        keep: List[Tuple[int, str]] = []
+        for epoch, key in self._rdv_keys:
+            if epoch < self.epoch:
+                self._try_delete(key)
+            else:
+                keep.append((epoch, key))
+        self._rdv_keys = keep
+
+    def _gc_barrier_window(self, old_epoch: int, old_roster: List[str],
+                           around_step: int) -> None:
+        for s in range(max(0, around_step - 2), around_step + 2):
+            for m in old_roster:
+                self._try_delete(f"{self.ns}/bar/{old_epoch}/{s}/{m}")
+        self._bar_keys = []
 
     # ---- data ----
     def _next_batch(self):
@@ -552,6 +600,7 @@ class Supervisor:
             }).encode()
             base = f"{self.ns}/rdv/{target}/{digest}"
             self._sup_store.set(f"{base}/{self.node_id}", payload)
+            self._rdv_keys.append((target, f"{base}/{self.node_id}"))
             infos, converged = {}, True
             for m in alive:
                 try:
@@ -561,6 +610,7 @@ class Supervisor:
                         timeout=min(1.0, rem if rem is not None else 1.0))
                     infos[m] = json.loads(
                         bytes(self._sup_store.get(f"{base}/{m}")).decode())
+                    self._rdv_keys.append((target, f"{base}/{m}"))
                 except (StoreTimeout, DeadlineExceeded):
                     converged = False
                     break
@@ -583,6 +633,8 @@ class Supervisor:
                     # me" instead of false-fencing itself
                     self._sup_store.set(f"{self.ns}/rdvwin/{target}",
                                         ",".join(alive).encode())
+                    self._rdv_keys.append(
+                        (target, f"{self.ns}/rdvwin/{target}"))
                     self._sup_store.add(epoch_key, 1)
                 else:
                     while int(self._sup_store.add(epoch_key, 0)) < target:
@@ -616,6 +668,7 @@ class Supervisor:
                        "recorded") from e
         view = bytes(self._sup_store.get(
             f"{self.ns}/rdvwin/{target}")).decode().split(",")
+        self._rdv_keys.append((target, f"{self.ns}/rdvwin/{target}"))
         if self.node_id not in view:
             raise StaleEpoch(
                 f"{self.node_id}: epoch {target} committed with view "
@@ -634,6 +687,7 @@ class Supervisor:
                            f"published") from e
             infos[m] = json.loads(
                 bytes(self._sup_store.get(f"{base}/{m}")).decode())
+            self._rdv_keys.append((target, f"{base}/{m}"))
         self.epoch = target
         return list(view), infos
 
@@ -833,7 +887,15 @@ class Supervisor:
                 dl: Deadline) -> None:
         self._site(FP_RESUME, dl, "supervised loop resume")
         old_size = len(self.roster) if self.roster else 0
+        old_roster = list(self.roster)
         self._adopt_roster(list(new_mesh.owners))
+        # the rendezvous converged and every participant read what it
+        # needed: prior-epoch rdv/rdvwin keys and the outgoing roster's
+        # barrier window are dead — delete them (satellite: the store no
+        # longer accumulates per-epoch/per-step keys for the life of a run)
+        self._gc_rendezvous_keys()
+        self._gc_barrier_window(self.epoch - 1, old_roster or self.roster,
+                                int(self.steps_done))
         self.state = out
         self.steps_done = int(steps)
         self._has_state = True

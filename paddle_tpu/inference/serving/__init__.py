@@ -5,18 +5,26 @@ pool with capacity-based admission (`kv_pool`), a scheduler that joins and
 evicts requests strictly between decode steps (`scheduler`), the request
 lifecycle with typed per-request TTLs (`request`), the engine that drives
 prefill/decode through one whole-step-captured executable per aval
-signature (`engine`), and speculative decoding drafters (`speculative`:
+signature (`engine`), speculative decoding drafters (`speculative`:
 n-gram prompt-lookup default, shrunk-model alternative) feeding the
-fixed-signature [max_batch, k+1] verify step. See README "Serving engine".
+fixed-signature [max_batch, k+1] verify step, prefix sharing over the
+pool's ref-counted committed pages (`prefix`: radix tree, O(suffix)
+prefill), chunked prefill (PT_SERVE_PREFILL_CHUNK — a mega-prompt can
+never stall the decode batch), and the socket front-end (`gateway`:
+ServingGateway + GatewayClient, typed deadlines on the wire). See README
+"Serving engine" and "Serving gateway".
 """
 from .engine import SamplingUnsupported, ServingEngine, serving_info  # noqa: F401
-from .kv_pool import KVPagePool, Page, PoolExhausted  # noqa: F401
+from .kv_pool import (  # noqa: F401
+    KVPagePool, Page, PageUncommitted, PoolExhausted)
+from .prefix import PrefixCache  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
 from .scheduler import ContinuousBatchingScheduler  # noqa: F401
 from .speculative import (  # noqa: F401
     Drafter, DraftModelDrafter, NGramDrafter, build_drafter)
 
 __all__ = ["SamplingUnsupported", "ServingEngine", "serving_info",
-           "KVPagePool", "Page", "PoolExhausted", "Request", "RequestState",
+           "KVPagePool", "Page", "PageUncommitted", "PoolExhausted",
+           "PrefixCache", "Request", "RequestState",
            "ContinuousBatchingScheduler", "Drafter", "NGramDrafter",
            "DraftModelDrafter", "build_drafter"]

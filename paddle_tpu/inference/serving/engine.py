@@ -41,6 +41,13 @@ Env knobs (all read at engine construction):
 - ``PT_SERVE_PREFILL_BUCKETS`` comma list (default: powers of two)
 - ``PT_SERVE_SPEC_K``      (default 0)   draft tokens per verify (0 = off)
 - ``PT_SERVE_DRAFTER``     (default "ngram") ngram | model
+- ``PT_SERVE_PREFILL_CHUNK`` (default 0 = off) chunked prefill: a prompt
+  longer than the chunk prefills in fixed [1, chunk] windows interleaved
+  with decode steps (the scheduler budget knob — a mega-prompt can never
+  stall the decode batch; at most ONE added lowering)
+- ``PT_SERVE_PREFIX_SHARE`` (default 0 = off) radix-tree prefix sharing
+  over committed KV pages: a request walks the tree, takes refs on the
+  shared chain, and prefills only its O(suffix) tail (see prefix.py)
 """
 from __future__ import annotations
 
@@ -57,6 +64,7 @@ import numpy as np
 
 from ...utils.deadline import env_int
 from .kv_pool import KVPagePool
+from .prefix import PrefixCache
 from .request import Request, RequestState
 from .scheduler import ContinuousBatchingScheduler
 from .speculative import build_drafter
@@ -80,6 +88,26 @@ def _write_slot_impl(batch_caches, pref_caches, slot):
 # compile instead of paying a fresh ~50ms lowering per ServingEngine — the
 # difference between a TTFT and a compile benchmark for short-lived engines
 _write_slot = jax.jit(_write_slot_impl, donate_argnums=(0,))
+
+
+def _write_scratch_impl(batch_caches, scratch_caches, slot):
+    """Slot write for the scratch-prefill path: the per-request scratch is
+    [1, S_max + W] (window writes may legally spill past S_max into the
+    pad, so dynamic_update_slice never clamps a chunk into valid rows);
+    only the [0, S_max) prefix lands in the batch row."""
+    z = jnp.asarray(0, jnp.int32)
+    out = []
+    for (bk, bv), (sk, sv) in zip(batch_caches, scratch_caches):
+        s_max = bk.shape[1]
+        out.append(
+            (jax.lax.dynamic_update_slice(
+                bk, sk[:, :s_max].astype(bk.dtype), (slot, z, z, z)),
+             jax.lax.dynamic_update_slice(
+                 bv, sv[:, :s_max].astype(bv.dtype), (slot, z, z, z))))
+    return out
+
+
+_write_scratch = jax.jit(_write_scratch_impl, donate_argnums=(0,))
 
 
 class SamplingUnsupported(NotImplementedError):
@@ -152,7 +180,9 @@ class ServingEngine:
                  eos_token_id: Optional[int] = None,
                  default_ttl: Optional[float] = None,
                  spec_k: Optional[int] = None,
-                 drafter=None, draft_model=None):
+                 drafter=None, draft_model=None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_sharing: Optional[bool] = None):
         self.model = model
         cfg = model.config
         self.max_batch = max_batch or env_int("PT_SERVE_MAX_BATCH", 8)
@@ -176,6 +206,31 @@ class ServingEngine:
         # those positions must be capacity the request already owns
         self.scheduler = ContinuousBatchingScheduler(
             self.pool, self.max_batch, reserve_extra_tokens=self.spec_k)
+        # chunked prefill: a prompt longer than the chunk prefills in
+        # fixed-size [1, chunk] windows interleaved with decode steps (one
+        # chunk per engine step), so a mega-prompt can never stall the
+        # decode batch. 0 = off (whole-prompt bucketed prefill, as before).
+        self.prefill_chunk = env_int("PT_SERVE_PREFILL_CHUNK", 0) \
+            if prefill_chunk is None else int(prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {self.prefill_chunk}")
+        # prefix sharing: radix-tree index over committed KV pages
+        if prefix_sharing is None:
+            prefix_sharing = os.environ.get(
+                "PT_SERVE_PREFIX_SHARE", "0").strip().lower() not in (
+                "0", "", "false", "off")
+        self.prefix_cache = PrefixCache(self.pool) if prefix_sharing \
+            else None
+        if self.prefix_cache is not None:
+            # admission pressure evicts tree-only pages instead of wedging
+            self.scheduler.reclaim = self.prefix_cache.evict
+        # the one window signature both scratch-prefill paths use (chunked
+        # mega-prompts AND O(suffix) tails after a prefix share): chunking
+        # adds AT MOST this one prefill signature to the lowering count
+        self._window = self.prefill_chunk or page
+        self._scratch_len = self.max_seq_len + self._window
+        self._window_fn = None
         if prefill_buckets:
             if not any(int(b) > 0 for b in prefill_buckets):
                 raise ValueError(
@@ -219,7 +274,9 @@ class ServingEngine:
         self._counters = {"prefills": 0, "decode_steps": 0,
                           "tokens_generated": 0, "rejected": 0,
                           "verify_steps": 0, "draft_tokens_proposed": 0,
-                          "draft_tokens_accepted": 0, "sampled_tokens": 0}
+                          "draft_tokens_accepted": 0, "sampled_tokens": 0,
+                          "prefill_chunks": 0, "chunked_prefills": 0,
+                          "shared_prefix_joins": 0, "prefill_pages_saved": 0}
         # tokens-per-verify histogram: index i = verifies that emitted i
         # tokens for a slot (1..k+1)
         self._accept_hist = [0] * (self.spec_k + 2)
@@ -304,6 +361,14 @@ class ServingEngine:
                 f"engine's static layout holds max_seq_len="
                 f"{self.max_seq_len} — shorten the prompt/max_new_tokens "
                 f"or size the engine up")
+        if self.prefix_cache is not None and not req.is_sampling:
+            # walk the radix tree and take refs on the committed chain NOW
+            # (the refs ride the request's lifetime; the scheduler reserves
+            # only the pages it must own beyond the shared prefix). Sampled
+            # requests take the classic logits-returning prefill and skip
+            # sharing — the window step returns argmaxes, not logits rows.
+            req.shared_pages, req.shared_kv, req.shared_len = \
+                self.prefix_cache.share(req.prompt)
         self.scheduler.submit(req)
         return req
 
@@ -316,15 +381,24 @@ class ServingEngine:
         slot. Returns the number of tokens produced."""
         with self._lock:
             joined, evicted = self.scheduler.schedule()
-            if self.drafter is not None:
-                for req in evicted:
+            for req in evicted:
+                # a TTL eviction mid-chunked-prefill drops its scratch
+                # caches here, strictly between steps (pages went back via
+                # the scheduler; uncommitted ones never entered the tree)
+                req.scratch = None
+                req.shared_kv = []
+                if self.drafter is not None:
                     # a slot holding in-flight draft state gives it back
                     # here, strictly between steps — the verify signature
                     # and everyone else's tokens never notice
                     self.drafter.on_evict(req)
             produced = 0
             for req in joined:
-                produced += self._prefill(req)
+                produced += self._begin_prefill(req)
+            # one chunk per in-flight scratch prefill per step: the decode
+            # batch below runs every step regardless, so a mega-prompt's
+            # prefill cost is amortized one bounded chunk at a time
+            produced += self._advance_prefills()
             produced += self._decode_speculative() if self.spec_k \
                 else self._decode()
             return produced
@@ -367,6 +441,140 @@ class ServingEngine:
             self._logits_step = step
         return self._logits_step
 
+    def _ensure_window_fn(self):
+        """The [B, W] window step (shared per model with the speculative
+        verify step — same builder, same stash): scores every window
+        position at a per-row offset with exact causal masking, which is
+        precisely a chunk of prefill. Built on first need, so engines that
+        never chunk or share never add its lowering."""
+        if self._window_fn is None:
+            fn = self.model.__dict__.get("_verify_step")
+            if fn is None:
+                fn = self.model._build_verify_step()
+                self.model.__dict__["_verify_step"] = fn
+            self._window_fn = fn
+        return self._window_fn
+
+    def _begin_prefill(self, req: Request) -> int:
+        """Route a joiner: the scratch path (per-request [1, S_max + W]
+        caches filled by window steps across engine steps) serves shared-
+        prefix joins and chunked mega-prompts; everything else takes the
+        classic single-shot bucketed prefill."""
+        plen = int(req.prompt.size)
+        if self.prefix_cache is not None and not req.is_sampling \
+                and req.shared_len == 0:
+            # second walk at JOIN time: a request submitted alongside its
+            # donor missed the tree at submit (the donor had not committed
+            # yet) — by the join pass it has. The refs replace an equal
+            # count of already-reserved own pages, which go back to the
+            # pool, so the accounting saving is as real as the compute one.
+            pages, kvs, slen = self.prefix_cache.share(req.prompt)
+            if slen:
+                req.shared_pages, req.shared_kv, req.shared_len = \
+                    pages, kvs, slen
+                surplus = req.pages[:len(pages)]
+                req.pages = req.pages[len(pages):]
+                self.pool.release(surplus)
+        chunked = bool(self.prefill_chunk) and plen > self.prefill_chunk
+        if req.is_sampling or not (chunked or req.shared_len):
+            return self._prefill(req)
+        # assemble the scratch caches on the host: zeros, with the shared
+        # chain's committed page rows in place — the windows then compute
+        # only the O(suffix) tail (positions shared_len..plen)
+        ps = self.pool.page_size
+        shape = (1, self._scratch_len) + self._cache_shape[1:]
+        scratch = []
+        for li in range(len(self._caches)):
+            k = np.zeros(shape, self._cache_dtype)
+            v = np.zeros(shape, self._cache_dtype)
+            for pi, page_kv in enumerate(req.shared_kv):
+                k[0, pi * ps:(pi + 1) * ps] = page_kv[li][0]
+                v[0, pi * ps:(pi + 1) * ps] = page_kv[li][1]
+            scratch.append((jnp.asarray(k), jnp.asarray(v)))
+        req.scratch = scratch
+        req.prefill_pos = req.shared_len
+        if req.shared_len:
+            self._counters["shared_prefix_joins"] += 1
+            self._counters["prefill_pages_saved"] += len(req.shared_pages)
+        if plen - req.shared_len > self._window:
+            self._counters["chunked_prefills"] += 1
+        return 0  # the first chunk runs in this same step's advance pass
+
+    def _advance_prefills(self) -> int:
+        produced = 0
+        for _, req in sorted(self.scheduler.running().items()):
+            if req.state is RequestState.PREFILL and req.scratch is not None:
+                produced += self._advance_one(req)
+        return produced
+
+    def _advance_one(self, req: Request) -> int:
+        """One [1, W] window of prefill for one scratch request: positions
+        prefill_pos..prefill_pos+n land in its scratch caches (the window
+        may spill into the S_pad tail — sliced off at the slot write). The
+        final window's argmax at the last REAL token is the request's
+        first generated token, bitwise the bucketed path's (the verify
+        step's sequential-equivalence contract)."""
+        t0 = time.perf_counter()
+        w = self._window
+        plen = int(req.prompt.size)
+        pos = req.prefill_pos
+        n = min(w, plen - pos)
+        tok = np.zeros((1, w), np.int64)
+        tok[0, :n] = req.prompt[pos:pos + n]
+        nxt, req.scratch = self._ensure_window_fn()(
+            self._params, jnp.asarray(tok), req.scratch,
+            jnp.asarray([pos], jnp.int32))
+        self._counters["prefill_chunks"] += 1
+        req.prefill_pos = pos + n
+        made = 0
+        if req.prefill_pos >= plen:
+            made = self._finish_scratch_prefill(
+                req, int(np.asarray(nxt)[0, n - 1]))
+        self._prefill_time += time.perf_counter() - t0
+        return made
+
+    def _finish_scratch_prefill(self, req: Request, first: int) -> int:
+        """Scratch prefill complete: commit the prompt's full pages into
+        the prefix tree (host copies from scratch, which the slot write
+        below does not donate), write the slot row, start decoding."""
+        plen = int(req.prompt.size)
+        if self.prefix_cache is not None:
+            scratch = req.scratch
+            ps = self.pool.page_size
+
+            def kv_of_page(i):
+                return [(np.asarray(sk[0, i * ps:(i + 1) * ps]),
+                         np.asarray(sv[0, i * ps:(i + 1) * ps]))
+                        for sk, sv in scratch]
+
+            self._commit_prefix(req, kv_of_page)
+        self._caches = _write_scratch(self._caches, req.scratch,
+                                      jnp.asarray(req.slot, jnp.int32))
+        req.scratch = None
+        req.shared_kv = []
+        req.cache_len = plen
+        req.state = RequestState.DECODING
+        if not req.append_token(first):
+            req.next_token = first
+        if self.drafter is not None:
+            self.drafter.on_join(req)
+        self._counters["prefills"] += 1
+        self._counters["tokens_generated"] += 1
+        return 1
+
+    def _commit_prefix(self, req: Request, kv_of_page) -> None:
+        """Mark the request's own pages covering full-prompt chunks as
+        committed (share()-able from here on — the pool-level guard that
+        an in-flight prefill's pages never enter the tree) and insert the
+        chunks into the radix tree, which takes its own refs."""
+        ps = self.pool.page_size
+        n_full = int(req.prompt.size) // ps
+        base = req.shared_len // ps
+        own = req.pages[:max(0, n_full - base)]
+        if own:
+            self.pool.commit(own)
+        self.prefix_cache.insert(req.prompt, req.shared_len, own, kv_of_page)
+
     def _prefill(self, req: Request) -> int:
         """Run the joiner's prompt through the captured step at its bucket
         length (batch 1, fresh zero caches), write the KV rows into its
@@ -394,6 +602,20 @@ class ServingEngine:
             first = int(np.asarray(nxt)[0])
         self._caches = _write_slot(self._caches, pref_out,
                                    jnp.asarray(req.slot, jnp.int32))
+        if self.prefix_cache is not None:
+            # donor commit: the prompt's full pages enter the radix tree
+            # (host copies from pref_out, which the slot write above did
+            # not donate) so the NEXT request over this prefix prefills
+            # only its tail. KV rows are sampling-independent, so sampled
+            # requests donate too.
+            ps = self.pool.page_size
+
+            def kv_of_page(i):
+                return [(np.asarray(pk[0, i * ps:(i + 1) * ps]),
+                         np.asarray(pv[0, i * ps:(i + 1) * ps]))
+                        for pk, pv in pref_out]
+
+            self._commit_prefix(req, kv_of_page)
         req.cache_len = plen
         req.state = RequestState.DECODING
         if not req.append_token(first):
@@ -551,9 +773,20 @@ class ServingEngine:
             "avg_occupancy": self._occupancy_sum / steps if steps else 0.0,
             "tokens_per_sec": c["tokens_generated"] / gen_time
             if gen_time else 0.0,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunks": c["prefill_chunks"],
+            "chunked_prefills": c["chunked_prefills"],
+            "shared_prefix_joins": c["shared_prefix_joins"],
+            "prefill_pages_saved": c["prefill_pages_saved"],
             "pool": self.pool.info(),
             "step": step_info,
         }
+        if self.prefix_cache is not None:
+            out["prefix"] = self.prefix_cache.info()
+        if self._window_fn is not None:
+            out["window"] = {
+                "size": self._window,
+                **getattr(self._window_fn, "cache_info", dict)()}
         if self.spec_k:
             proposed = c["draft_tokens_proposed"]
             verifies = c["verify_steps"]

@@ -1,0 +1,15 @@
+"""paddle_tpu.inference.serving.gateway — the engine behind a socket.
+
+A deployable serving front-end riding the typed-deadline layer: the PTSG/1
+line protocol (`protocol`), the threaded gateway server with graceful
+drain and per-connection read deadlines (`server`), and the typed client
+(`client`). Requests arrive with TTLs that map straight onto the engine's
+per-request `Deadline` — the typed `RequestTimeout` travels the wire as a
+408 frame and re-raises client-side. See README "Serving gateway".
+"""
+from .client import GatewayClient, GatewayConnectionError  # noqa: F401
+from .protocol import GatewayDraining, ProtocolError  # noqa: F401
+from .server import ServingGateway, gateway_info  # noqa: F401
+
+__all__ = ["GatewayClient", "GatewayConnectionError", "GatewayDraining",
+           "ProtocolError", "ServingGateway", "gateway_info"]

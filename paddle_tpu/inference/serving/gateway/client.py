@@ -1,0 +1,200 @@
+"""GatewayClient: typed-deadline client for the PTSG/1 serving gateway.
+
+One connection, one in-flight request at a time (a lock serializes —
+clone clients for parallel streams, they are cheap). Connects with the
+same jittered backoff as the store client, verifies the server with a
+PING handshake, and carries every exchange under ONE `Deadline`:
+
+- the response wait is bounded by the request's TTL plus a grace (the
+  server enforces the TTL engine-side and answers a typed 408; the client
+  budget only fences a wedged/partitioned server) or by an explicit
+  ``timeout=`` — never unbounded;
+- a timeout mid-exchange poisons the connection (the stream is desynced)
+  and raises the typed `RequestTimeout` at once;
+- a CONNECTION loss (peer reset, a dropped accept) reconnects and retries
+  exactly once — generation is deterministic per request (greedy, or
+  seeded sampling), so a replayed GENERATE returns the same tokens;
+- error frames re-raise the engine's typed exception class
+  (`RequestTimeout`, `PoolExhausted`, `SamplingUnsupported`, ...): the
+  socket is invisible in the caller's except clauses.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ....utils.deadline import Deadline, RequestTimeout, env_timeout
+from . import protocol as proto
+
+
+class GatewayConnectionError(ConnectionError):
+    """Terminal client failure: the gateway connection died (or desynced)
+    and reconnect-plus-retry did not recover it."""
+
+
+def _typed_error(status: int, name: str, msg: str,
+                 budget: Optional[float]) -> BaseException:
+    if status == proto.STATUS_TIMEOUT:
+        return RequestTimeout(f"gateway request ({name})", budget,
+                              detail=msg)
+    if status == proto.STATUS_EXHAUSTED:
+        # reconstructed with the SERVER's message; the class attrs exist
+        # (None = unknown over the wire) so in-process except clauses that
+        # read them keep working, they just can't see the peer's numbers
+        from ..kv_pool import PoolExhausted
+        e = PoolExhausted.__new__(PoolExhausted)
+        RuntimeError.__init__(e, msg)
+        e.need = e.free = e.total = None
+        e.permanent = True
+        return e
+    if status == proto.STATUS_DRAINING:
+        return proto.GatewayDraining(msg)
+    if status == proto.STATUS_BAD_REQUEST and name == "SamplingUnsupported":
+        from ..engine import SamplingUnsupported
+        e = SamplingUnsupported.__new__(SamplingUnsupported)
+        NotImplementedError.__init__(e, msg)
+        e.param = e.value = None
+        return e
+    if status in (proto.STATUS_BAD_REQUEST, proto.STATUS_TOO_LARGE):
+        return ValueError(msg)
+    if name == "FaultInjected":
+        from ....distributed.chaos import FaultInjected
+        return FaultInjected("gateway.remote")
+    return RuntimeError(f"gateway error {status} {name}: {msg}")
+
+
+class GatewayClient:
+    def __init__(self, host: str, port: int,
+                 connect_timeout: Optional[float] = None):
+        self.host, self.port = host, int(port)
+        self._connect_timeout = (connect_timeout if connect_timeout
+                                 is not None else
+                                 env_timeout("PT_GATEWAY_CONNECT_TIMEOUT",
+                                             10.0))
+        # REENTRANT: the mid-exchange reconnect path re-enters _exchange
+        # through the ping handshake while the outer exchange holds it
+        self._lock = threading.RLock()
+        self._sock: Optional[socket.socket] = None
+        self._buf = bytearray()
+        self._connect(self._connect_timeout)
+
+    # ------------------------------------------------------------------
+    def _connect(self, timeout: float) -> None:
+        from ....distributed.store import _backoff_delay
+        deadline = time.monotonic() + timeout
+        last: Optional[BaseException] = None
+        attempt = 0
+        while time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=5)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
+                self._buf.clear()
+                self.ping(timeout=5.0)
+                return
+            except (OSError, ConnectionError) as e:
+                last = e
+                self._teardown()
+            time.sleep(min(_backoff_delay(attempt),
+                           max(0.0, deadline - time.monotonic())))
+            attempt += 1
+        raise GatewayConnectionError(
+            f"gateway: cannot connect {self.host}:{self.port}: {last}")
+
+    def _teardown(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self._buf.clear()
+
+    @property
+    def alive(self) -> bool:
+        return self._sock is not None
+
+    def close(self) -> None:
+        with self._lock:
+            self._teardown()
+
+    # ------------------------------------------------------------------
+    def _exchange(self, frame: bytes, dl: Deadline, budget,
+                  retry: bool = True):
+        """Send one frame, read one frame, typed errors throughout. A
+        connection loss reconnects and retries EXACTLY once (idempotent:
+        generation is deterministic per request); a deadline expiry is
+        typed immediately with the connection poisoned."""
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    # dead at entry (earlier exchange poisoned it):
+                    # reconnect before anything is sent
+                    self._connect(min(self._connect_timeout,
+                                      dl.remaining(floor=0.1) or
+                                      self._connect_timeout))
+                try:
+                    self._sock.settimeout(dl.remaining(floor=0.01))
+                    self._sock.sendall(frame)
+                    return proto.read_frame(self._sock, dl, self._buf)
+                except socket.timeout as e:
+                    self._teardown()  # mid-message: stream desynced
+                    raise RequestTimeout(
+                        f"gateway {self.host}:{self.port}", budget,
+                        detail="no response within the budget; connection "
+                               "closed to prevent desync") from e
+                except (ConnectionError, OSError) as e:
+                    self._teardown()
+                    if not retry or attempt:
+                        raise GatewayConnectionError(
+                            f"gateway connection lost: {e}") from e
+                    # fall through: reconnect + single retry
+
+    # ------------------------------------------------------------------
+    def ping(self, timeout: float = 5.0) -> None:
+        dl = Deadline(timeout, what="gateway ping")
+        head, _, _ = self._exchange(proto.ping_frame(), dl, timeout,
+                                    retry=False)
+        if not head.startswith(str(proto.STATUS_OK)):
+            raise GatewayConnectionError(f"gateway ping rejected: {head!r}")
+
+    def generate(self, prompt_ids, max_new_tokens: int = 16,
+                 ttl: Optional[float] = None,
+                 timeout: Optional[float] = None,
+                 temperature: Optional[float] = None,
+                 top_p: Optional[float] = None,
+                 seed: Optional[int] = None,
+                 eos_token_id: Optional[int] = None) -> np.ndarray:
+        """Round-trip one request; returns prompt+generated tokens exactly
+        as the in-process `Request.result()` would (bitwise — the gateway
+        adds transport, never math). Raises the engine's typed errors."""
+        if ttl is not None:
+            budget = float(ttl) + env_timeout("PT_GATEWAY_TTL_GRACE", 10.0)
+        else:
+            budget = env_timeout("PT_GATEWAY_CLIENT_TIMEOUT", 300.0)
+        if timeout is not None:
+            budget = float(timeout)
+        dl = Deadline(budget, what=f"gateway generate "
+                                   f"{self.host}:{self.port}")
+        frame = proto.request_frame(prompt_ids, max_new_tokens, ttl,
+                                    temperature, top_p, seed, eos_token_id)
+        # retry-once is sound only when a replay provably regenerates the
+        # SAME stream: greedy always, sampled only with an explicit seed
+        # (the server defaults an omitted seed to the request id, which
+        # differs per submission — and the orphaned original would keep
+        # decoding, so an unseeded duplicate is a correctness bug twice)
+        retryable = temperature is None or seed is not None
+        head, headers, body = self._exchange(frame, dl, budget,
+                                             retry=retryable)
+        parts = head.split(None, 1)
+        status = int(parts[0])
+        name = parts[1] if len(parts) > 1 else ""
+        if status != proto.STATUS_OK:
+            raise _typed_error(status, name,
+                               headers.get("error", head), budget)
+        return proto.unpack_tokens(body)

@@ -1,0 +1,208 @@
+"""PTSG/1 — the serving gateway's wire protocol.
+
+HTTP/1.1-style line protocol over TCP, idiomatic with the TCPStore server
+(`distributed/store.py`): ASCII header lines terminated by ``\\n``, a blank
+line, then a fixed-length binary body of little-endian int64 token ids.
+One request/response exchange per round; connections are keep-alive until
+either side closes.
+
+Request::
+
+    PTSG/1 GENERATE            (or PING, no headers/body)
+    prompt-len: 12             body token count
+    max-new-tokens: 16
+    ttl: 2.5                   optional; maps onto the engine's per-request
+                               Deadline -> typed RequestTimeout on the wire
+    temperature: 0.8           optional sampling knobs
+    top-p: 0.9
+    seed: 7
+    eos: 2
+    <blank line>
+    <prompt-len * 8 bytes>
+
+Response::
+
+    PTSG/1 200 OK
+    tokens: 28                 body token count (prompt + generated)
+    finish-reason: length
+    <blank line>
+    <tokens * 8 bytes>
+
+Errors carry the TYPED class name and message instead of a body::
+
+    PTSG/1 408 RequestTimeout
+    error: deadline exceeded: serving request 3 ...
+    <blank line>
+
+The client re-raises the matching typed error (`RequestTimeout`,
+`PoolExhausted`, `SamplingUnsupported`, ...) so a caller over the socket
+sees exactly the exceptions the in-process engine raises.
+"""
+from __future__ import annotations
+
+import socket as _socket
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ....utils.deadline import Deadline, RequestTimeout, recv_exact
+
+MAGIC = "PTSG/1"
+MAX_LINE = 4096          # a header line longer than this is a protocol error
+MAX_TOKENS = 1 << 20     # sanity cap on either direction's token payload
+
+# status codes -> the typed error the client re-raises (the server sends
+# type(exc).__name__ beside the code; the CLASS mapping is by code so an
+# unknown subclass still surfaces as its base type)
+STATUS_OK = 200
+STATUS_BAD_REQUEST = 400      # malformed frame / invalid sampling ask
+STATUS_TIMEOUT = 408          # typed RequestTimeout (TTL ran out)
+STATUS_TOO_LARGE = 413        # sizing error: can never fit the engine
+STATUS_EXHAUSTED = 429        # PoolExhausted (permanent=True)
+STATUS_INTERNAL = 500         # anything else (incl. injected faults)
+STATUS_DRAINING = 503         # gateway is draining: submit rejected
+
+
+class ProtocolError(ConnectionError):
+    """The peer sent bytes that are not a PTSG/1 frame — the stream is
+    unparseable from here, so the connection must be closed."""
+
+
+class GatewayDraining(RuntimeError):
+    """Typed submit rejection while the gateway drains for shutdown."""
+
+
+def pack_tokens(tokens) -> bytes:
+    arr = np.asarray(tokens, np.int64).reshape(-1)
+    return struct.pack(f"<{arr.size}q", *(int(t) for t in arr))
+
+
+def unpack_tokens(payload: bytes) -> np.ndarray:
+    if len(payload) % 8:
+        raise ProtocolError("token payload is not a multiple of 8 bytes")
+    return np.frombuffer(payload, "<i8").astype(np.int64)
+
+
+def read_line(sock, dl: Optional[Deadline], buf: bytearray) -> str:
+    """One ``\\n``-terminated ASCII line. `buf` carries bytes read past
+    earlier lines (the reader owns one buffer per connection). The
+    Deadline bounds the whole read, chunk by chunk, exactly like
+    recv_exact — a peer trickling bytes cannot stretch it."""
+    while True:
+        nl = buf.find(b"\n")
+        if nl >= 0:
+            line = bytes(buf[:nl])
+            del buf[:nl + 1]
+            if len(line) > MAX_LINE:
+                raise ProtocolError("header line too long")
+            return line.decode("ascii", "replace").rstrip("\r")
+        if len(buf) > MAX_LINE:
+            raise ProtocolError("header line too long")
+        if dl is not None:
+            if dl.expired:
+                raise _socket.timeout("read deadline exhausted")
+            sock.settimeout(dl.remaining(floor=0.01))
+        chunk = sock.recv(4096)  # staticcheck: ok[unbounded-blocking] — bounded by the Deadline when one is given (client + server request reads both pass one)
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+
+
+def read_body(sock, dl: Optional[Deadline], buf: bytearray,
+              nbytes: int) -> bytes:
+    """The fixed-length binary body following the blank line."""
+    take = min(len(buf), nbytes)
+    head = bytes(buf[:take])
+    del buf[:take]
+    if take == nbytes:
+        return head
+    return head + recv_exact(sock, nbytes - take, dl,
+                             what="peer closed mid-body")
+
+
+def read_frame(sock, dl: Optional[Deadline],
+               buf: bytearray) -> Tuple[str, Dict[str, str], bytes]:
+    """-> (verb_or_status_line_tail, headers, body). The first line must
+    start with the PTSG/1 magic; `tokens`/`prompt-len` headers size the
+    body."""
+    first = read_line(sock, dl, buf)
+    if not first.startswith(MAGIC + " "):
+        raise ProtocolError(f"not a {MAGIC} frame: {first[:60]!r}")
+    head = first[len(MAGIC) + 1:]
+    headers: Dict[str, str] = {}
+    while True:
+        line = read_line(sock, dl, buf)
+        if not line:
+            break
+        key, sep, val = line.partition(":")
+        if not sep:
+            raise ProtocolError(f"malformed header line {line[:60]!r}")
+        headers[key.strip().lower()] = val.strip()
+    try:
+        n = int(headers.get("tokens", headers.get("prompt-len", 0)) or 0)
+    except ValueError as e:
+        # a malformed size leaves the (unsized) body unconsumed — the
+        # stream is desynced from here, so this MUST be the typed
+        # connection-closing error, never an answer-and-continue
+        raise ProtocolError(f"malformed token count: {e}") from e
+    if n < 0 or n > MAX_TOKENS:
+        raise ProtocolError(f"token payload count {n} out of range")
+    body = read_body(sock, dl, buf, n * 8) if n else b""
+    return head, headers, body
+
+
+def request_frame(prompt, max_new_tokens: int, ttl: Optional[float],
+                  temperature: Optional[float], top_p: Optional[float],
+                  seed: Optional[int], eos: Optional[int]) -> bytes:
+    arr = np.asarray(prompt, np.int64).reshape(-1)
+    lines = [f"{MAGIC} GENERATE", f"prompt-len: {arr.size}",
+             f"max-new-tokens: {int(max_new_tokens)}"]
+    if ttl is not None:
+        lines.append(f"ttl: {float(ttl)!r}")
+    if temperature is not None:
+        lines.append(f"temperature: {float(temperature)!r}")
+    if top_p is not None:
+        lines.append(f"top-p: {float(top_p)!r}")
+    if seed is not None:
+        lines.append(f"seed: {int(seed)}")
+    if eos is not None:
+        lines.append(f"eos: {int(eos)}")
+    return ("\n".join(lines) + "\n\n").encode("ascii") + pack_tokens(arr)
+
+
+def ping_frame() -> bytes:
+    return f"{MAGIC} PING\n\n".encode("ascii")
+
+
+def response_frame(tokens, finish_reason: Optional[str]) -> bytes:
+    arr = np.asarray(tokens, np.int64).reshape(-1)
+    lines = [f"{MAGIC} {STATUS_OK} OK", f"tokens: {arr.size}"]
+    if finish_reason:
+        lines.append(f"finish-reason: {finish_reason}")
+    return ("\n".join(lines) + "\n\n").encode("ascii") + pack_tokens(arr)
+
+
+def error_frame(status: int, exc: BaseException) -> bytes:
+    name = type(exc).__name__
+    msg = str(exc).replace("\n", " ")[:1024]
+    return (f"{MAGIC} {status} {name}\nerror: {msg}\n\n").encode(
+        "ascii", "replace")
+
+
+def status_of(exc: BaseException) -> int:
+    """Map an engine-side exception to its wire status."""
+    from ..kv_pool import PoolExhausted
+    from ..engine import SamplingUnsupported
+    if isinstance(exc, RequestTimeout):
+        return STATUS_TIMEOUT
+    if isinstance(exc, GatewayDraining):
+        return STATUS_DRAINING
+    if isinstance(exc, PoolExhausted):
+        return STATUS_EXHAUSTED
+    if isinstance(exc, SamplingUnsupported):
+        return STATUS_BAD_REQUEST
+    if isinstance(exc, (ValueError, ProtocolError)):
+        return STATUS_TOO_LARGE if "max_seq_len" in str(exc) \
+            else STATUS_BAD_REQUEST
+    return STATUS_INTERNAL

@@ -10,16 +10,34 @@ admission without a gather kernel: admission is all-or-nothing, so an
 admitted request can never stall mid-decode waiting for memory, and the
 no-preemption invariant keeps the decode path retrace-free.
 
-Pages are ref-counted (retain/release): the substrate for prefix sharing
-(two requests pinning one prompt's pages) even though the v1 engine holds
-every page at refcount 1. A page returns to the free list only when its
-last holder releases it; `info()` exposes the counters the deadline tests
-assert on (an expired request's pages must land back in `free_pages`).
+Pages are ref-counted (retain/release): the substrate prefix sharing now
+spends (`prefix.py`) — a borrower takes refs on a donor's prompt pages
+via `share()`, which accepts only pages `commit()`ed by a COMPLETED
+prefill (the typed `PageUncommitted` guards the fork-during-prefill
+race). A page returns to the free list only when its last holder
+releases it — and loses its committed mark there, so a recycled page is
+never shareable before its new prefill commits. `info()` exposes the
+counters the deadline tests assert on (an expired request's pages must
+land back in `free_pages`).
 """
 from __future__ import annotations
 
 import threading
 from typing import List
+
+
+class PageUncommitted(RuntimeError):
+    """Typed rejection of `share()` on a page whose KV rows are still being
+    written (an in-flight bucketed or chunked prefill owns it). Only
+    COMMITTED full pages may enter the prefix-sharing radix tree: a fork
+    taken mid-prefill would hand the borrower rows the donor has not
+    finished computing (the fork-during-prefill race)."""
+
+    def __init__(self, page: "Page"):
+        self.page = page
+        super().__init__(
+            f"page {page.pid} is not committed (an in-flight prefill is "
+            f"still writing it) — only committed full pages are shareable")
 
 
 class PoolExhausted(RuntimeError):
@@ -47,14 +65,18 @@ class Page:
     accounting; the engine maps (slot, position) to pages implicitly
     through the dense layout."""
 
-    __slots__ = ("pid", "refs")
+    __slots__ = ("pid", "refs", "committed")
 
     def __init__(self, pid: int):
         self.pid = pid
         self.refs = 0
+        # a page is committed once the prefill that filled its KV rows has
+        # completed; only then may share() hand it to another request
+        self.committed = False
 
     def __repr__(self):
-        return f"Page({self.pid}, refs={self.refs})"
+        return (f"Page({self.pid}, refs={self.refs}"
+                f"{', committed' if self.committed else ''})")
 
 
 class KVPagePool:
@@ -69,6 +91,7 @@ class KVPagePool:
         self._lock = threading.Lock()
         self._allocs = 0
         self._releases = 0
+        self._shared = 0
         self._peak_active = 0
 
     def pages_for(self, n_tokens: int) -> int:
@@ -97,14 +120,43 @@ class KVPagePool:
                     raise ValueError(f"retain of a free page: {p!r}")
                 p.refs += 1
 
+    def share(self, pages: List[Page]):
+        """retain() restricted to COMMITTED pages — the prefix-sharing
+        entry point. Raises the typed `PageUncommitted` (taking no refs)
+        when any page is still being written by an in-flight prefill: a
+        borrower must never fork onto half-written KV rows, so only pages
+        `commit()`ed by a completed prefill are shareable. All-or-nothing,
+        like alloc()."""
+        with self._lock:
+            for p in pages:
+                if p.refs < 1:
+                    raise ValueError(f"share of a free page: {p!r}")
+                if not p.committed:
+                    raise PageUncommitted(p)
+            for p in pages:
+                p.refs += 1
+            self._shared += len(pages)
+
+    def commit(self, pages: List[Page]):
+        """Mark pages' KV rows durable (their prefill completed): from here
+        on share() accepts them. Idempotent."""
+        with self._lock:
+            for p in pages:
+                if p.refs < 1:
+                    raise ValueError(f"commit of a free page: {p!r}")
+                p.committed = True
+
     def release(self, pages: List[Page]):
-        """Drop one holder; pages return to the free list at refcount 0."""
+        """Drop one holder; pages return to the free list at refcount 0
+        (and lose their committed mark — the rows they accounted for are
+        no longer anyone's)."""
         with self._lock:
             for p in pages:
                 if p.refs < 1:
                     raise ValueError(f"double release: {p!r}")
                 p.refs -= 1
                 if p.refs == 0:
+                    p.committed = False
                     self._free.append(p)
                     self._releases += 1
 
@@ -124,4 +176,5 @@ class KVPagePool:
                     "active_pages": self.total_pages - free,
                     "allocs": self._allocs,
                     "releases": self._releases,
+                    "shared": self._shared,
                     "peak_active": self._peak_active}

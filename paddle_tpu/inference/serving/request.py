@@ -72,6 +72,16 @@ class Request:
         # engine-owned placement (None until admitted)
         self.slot: Optional[int] = None
         self.pages: list = []           # KVPagePool pages reserved for us
+        # prefix sharing (engine-owned): refs taken on a committed page
+        # chain at submit; shared_len prompt positions whose prefill we
+        # skip. Chunked prefill state: prefill_pos = prompt positions
+        # already computed into the scratch caches, scratch = the per-
+        # request [1, S_pad] KV caches a multi-step prefill accumulates in
+        self.shared_pages: list = []
+        self.shared_kv: list = []       # per shared page: per-layer (k, v)
+        self.shared_len = 0
+        self.prefill_pos = 0
+        self.scratch = None
         self.cache_len = 0              # valid KV positions in our slot
         self.next_token: Optional[int] = None   # sampled, not yet fed back
         self.submit_time = time.perf_counter()

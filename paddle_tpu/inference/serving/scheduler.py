@@ -39,12 +39,44 @@ class ContinuousBatchingScheduler:
         self._running: dict[int, Request] = {}   # slot -> request
         self._free_slots = list(range(self.max_batch - 1, -1, -1))
         self._lock = threading.Lock()
+        # optional reclaim hook (engine wires the prefix cache's evict):
+        # called with the page shortfall when an alloc fails, returns pages
+        # freed; a positive return earns exactly one alloc retry, so cached
+        # prefixes yield to admission pressure instead of wedging the queue
+        self.reclaim = None
         self.counters = {"submitted": 0, "admitted": 0, "finished": 0,
                          "timed_out": 0, "evicted": 0, "rejected": 0}
 
     def _pages_needed(self, req: Request) -> int:
+        """Pages the request must OWN: its whole lifetime minus the shared
+        prefix chain it already holds refs on (prefix sharing — the saved
+        pages are exactly the prefill it skips)."""
         return self.pool.pages_for(
-            req.prompt.size + req.max_new_tokens + self.reserve_extra)
+            req.prompt.size + req.max_new_tokens + self.reserve_extra) \
+            - len(req.shared_pages)
+
+    def _alloc(self, need: int):
+        """pool.alloc with one reclaim-assisted retry (see `reclaim`)."""
+        try:
+            return self.pool.alloc(need)
+        except PoolExhausted:
+            if self.reclaim is None:
+                raise
+            if self.reclaim(need - self.pool.free_pages) <= 0:
+                raise
+            return self.pool.alloc(need)
+
+    def _release_all(self, req: Request) -> None:
+        """Give back everything the request holds: its own reservation AND
+        its refs on the shared prefix chain (the tree's own refs keep the
+        cached pages alive; a chain page a peer still decodes against
+        never reaches the free list — refcount law)."""
+        if req.pages:
+            self.pool.release(req.pages)
+            req.pages = []
+        if req.shared_pages:
+            self.pool.release(req.shared_pages)
+            req.shared_pages = []
 
     # ---- intake ----
     def submit(self, req: Request):
@@ -68,7 +100,7 @@ class ContinuousBatchingScheduler:
             self.counters["submitted"] += 1
             if all(r.pages for r in self._queue):
                 try:
-                    req.pages = self.pool.alloc(need)
+                    req.pages = self._alloc(need)
                 except PoolExhausted:
                     pass  # stays queued unreserved; retried at join passes
             self._queue.append(req)
@@ -94,17 +126,14 @@ class ContinuousBatchingScheduler:
                     continue
                 del self._running[slot]
                 self._free_slots.append(slot)
-                self.pool.release(req.pages)
-                req.pages = []
+                self._release_all(req)
                 self.counters["evicted"] += 1
                 evicted.append(req)
             # 2. expire queued requests (typed rejection, pages returned)
             still = deque()
             for req in self._queue:
                 if req.deadline.expired:
-                    if req.pages:
-                        self.pool.release(req.pages)
-                        req.pages = []
+                    self._release_all(req)
                     req.finish_reason = "ttl"
                     req.finish(RequestState.TIMED_OUT)
                     self.counters["timed_out"] += 1
@@ -118,7 +147,7 @@ class ContinuousBatchingScheduler:
                 if not head.pages:
                     need = self._pages_needed(head)
                     try:
-                        head.pages = self.pool.alloc(need)
+                        head.pages = self._alloc(need)
                     except PoolExhausted:
                         break
                 self._queue.popleft()

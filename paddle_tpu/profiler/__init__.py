@@ -13,7 +13,8 @@ from .profiler import (  # noqa: F401
     load_profiler_result, make_scheduler,
 )
 from .statistic import (  # noqa: F401
-    comm_summary, lint_summary, op_cache_summary, reshard_summary,
-    serving_summary, step_capture_summary, supervisor_summary,
+    comm_summary, gateway_summary, lint_summary, op_cache_summary,
+    reshard_summary, serving_summary, step_capture_summary,
+    supervisor_summary,
 )
 from .timer import benchmark  # noqa: F401

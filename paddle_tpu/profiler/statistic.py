@@ -148,6 +148,21 @@ def serving_summary() -> str:
             f"{pool['page_size']}, allocs={pool['allocs']} "
             f"releases={pool['releases']})",
         ]
+        prefix = e.get("prefix")
+        if prefix is not None:
+            lines.append(
+                f"  prefix: nodes={prefix['nodes']} "
+                f"pages_held={prefix['pages_held']} "
+                f"hits={prefix['hits']}/{prefix['lookups']} "
+                f"shared_joins={e['shared_prefix_joins']} "
+                f"pages_saved={e['prefill_pages_saved']} "
+                f"evicted={prefix['pages_evicted']}")
+        if e.get("prefill_chunks") or e.get("prefill_chunk"):
+            lines.append(
+                f"  chunked prefill: chunk={e['prefill_chunk'] or '-'} "
+                f"chunks={e['prefill_chunks']} "
+                f"chunked_prefills={e['chunked_prefills']} "
+                f"window={e.get('window', {}).get('size', '-')}")
         spec = e.get("spec")
         if spec:
             drafter = spec.get("drafter") or {}
@@ -163,6 +178,39 @@ def serving_summary() -> str:
                 f"  step capture: lowerings={step.get('lowerings')} "
                 f"hits={step.get('hits')} bailouts={step.get('bailouts')} "
                 f"fallback_calls={step.get('fallback_calls')}")
+    return "\n".join(lines)
+
+
+def gateway_summary() -> str:
+    """Live serving-gateway counters (inference/serving/gateway) as text:
+    per gateway the bind address, connection/request/response funnel, the
+    per-status response mix, and the drain state — the wire-side view
+    that pairs with serving_summary()'s engine-side one. A healthy
+    gateway shows responses tracking requests with errors ~0; climbing
+    408s mean TTLs are outrunning engine capacity (shed load or grow the
+    engine), climbing read_timeouts mean idle/stalled peers are being
+    reaped by the per-connection read deadline (normal under churn)."""
+    from ..inference.serving.gateway import gateway_info
+
+    infos = gateway_info()
+    if not infos:
+        return "gateway: no live gateways"
+    lines = []
+    for i, g in enumerate(infos):
+        state = ("stopped" if g["stopped"] else
+                 "draining" if g["draining"] else "serving")
+        codes = " ".join(f"{k}:{v}" for k, v in
+                         sorted(g["status_counts"].items())) or "-"
+        lines += [
+            f"gateway[{i}]: {g['host']}:port={g['port']} {state} "
+            f"read_timeout={g['read_timeout']:g}s",
+            f"  wire: connections={g['connections']} "
+            f"open={g['open_connections']} requests={g['requests']} "
+            f"responses={g['responses']} errors={g['errors']} "
+            f"read_timeouts={g['read_timeouts']} "
+            f"protocol_errors={g['protocol_errors']}",
+            f"  status: {codes}",
+        ]
     return "\n".join(lines)
 
 

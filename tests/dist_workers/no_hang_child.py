@@ -108,6 +108,37 @@ def main(site: str) -> None:
             a.stop()
             b.stop()
             store.stop()
+    elif site.startswith("gateway."):
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import paddle_tpu as P
+        from paddle_tpu.distributed import chaos
+        from paddle_tpu.inference.serving import ServingEngine
+        from paddle_tpu.inference.serving.gateway import (GatewayClient,
+                                                          ServingGateway)
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        P.seed(0)
+        cfg = LlamaConfig.tiny(vocab=32, hidden=16, layers=1, heads=2,
+                               inter=32, seq=32)
+        model = LlamaForCausalLM(cfg)
+        eng = ServingEngine(model, max_batch=2, max_seq_len=32)
+        prompt = np.random.RandomState(0).randint(0, 32, (6,))
+        # warm the lowerings OFF the wire so the round-trip below measures
+        # the armed fault, not compile latency
+        eng.generate([prompt], max_new_tokens=4)
+        gw = ServingGateway(eng)
+        # the connect handshake (PING) traverses both armed sites once —
+        # crash dies here; error/drop/delay are absorbed by the client's
+        # backoff+retry connect. Re-arm so the GENERATE exchange hits the
+        # fault deterministically with its own small budget.
+        cli = GatewayClient("127.0.0.1", gw.port, connect_timeout=15.0)
+        chaos.reset_hits()
+        out = cli.generate(prompt, max_new_tokens=4, timeout=BUDGET)
+        assert out.size == 10
+        cli.close()
+        gw.stop(drain=True, timeout=5.0)
     elif site == "io.stream_fetch":
         import numpy as np
         from paddle_tpu.io import ShardedSampleStream, StreamLoader

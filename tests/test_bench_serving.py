@@ -94,11 +94,73 @@ def test_bench_serving_spec_smoke_json_contract():
     os.unlink(art)  # tiny-workload artifacts are not trajectory evidence
 
 
+@pytest.mark.skipif(os.environ.get("PT_TIGHT_BUDGET") == "1",
+                    reason="wall-clock budget is tight; perf smoke skipped")
+def test_bench_serving_shared_prefix_smoke():
+    """--shared-prefix smoke: JSON contract, the bitwise gate across
+    shared/unshared engines, the >= 2x prefill-pages-saved floor (page
+    ACCOUNTING — deterministic at any scale, unlike throughput), and the
+    chunked-prefill gap bound (each inter-decode-step gap under the
+    single-chunk bound, measured with a 3x margin on the same box)."""
+    env = dict(os.environ, PT_SERVE_BENCH_REQUESTS="6",
+               PT_SERVE_BENCH_PREFIX="48")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_serving.py"),
+         "--shared-prefix"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    payload = json.loads(lines[0])
+    assert payload["metric"] == "serving_shared_prefix_pages_saved"
+    assert payload["backend"] == "cpu-proxy"
+    # the ISSUE acceptance floor: >= 2x prefill pages saved, tokens
+    # bitwise the unshared engine's
+    assert payload["value"] >= 2.0, payload
+    assert payload["token_mismatches"] == 0, payload
+    assert payload["pages_saved"] > 0
+    assert payload["ttft_p50_ms_shared"] > 0
+    # chunked prefill really bounded the inter-decode-step gap: the
+    # DIRECTIONAL claim (chunked gap well under the whole-prefill stall,
+    # ~7x here) is what tier-1 asserts — the strict single-chunk bound
+    # (chunked_gap_ok) rides the payload but its 3x margin can flake on
+    # a loaded CI box, so only the slow acceptance battery pins it
+    assert payload["chunked_max_gap_ms"] < payload["unchunked_max_gap_ms"], \
+        payload
+    assert payload["single_chunk_bound_ms"] > 0
+    art = r.stderr.split("artifact ->", 1)[1].strip().splitlines()[0]
+    with open(art) as f:
+        detail = json.load(f)["detail"]
+    sinfo = detail["shared_engine_info"]
+    assert sinfo["shared_prefix_joins"] >= 5   # every follower shared
+    assert sinfo["prefix"]["pages_held"] > 0
+    cinfo = detail["chunked_engine_info"]
+    assert cinfo["chunked_prefills"] >= 1 and cinfo["prefill_chunks"] > 1
+    os.unlink(art)  # tiny-workload artifacts are not trajectory evidence
+
+
 @pytest.mark.slow
 def test_bench_serving_meets_acceptance_floor():
     payload, _ = _run_bench(requests=24, batch=8, reps=3)
     assert payload["value"] >= 1.5, payload
     assert payload["token_mismatches"] == 0, payload
+
+
+@pytest.mark.slow
+def test_bench_serving_shared_prefix_meets_floors():
+    """Full-scale --shared-prefix acceptance: >= 2x pages saved, bitwise,
+    and every inter-decode-step gap under the single-chunk bound."""
+    env = dict(os.environ)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_serving.py"),
+         "--shared-prefix"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([ln for ln in r.stdout.splitlines()
+                          if ln.startswith("{")][0])
+    assert payload["value"] >= 2.0, payload
+    assert payload["token_mismatches"] == 0, payload
+    assert payload["chunked_gap_ok"] is True, payload
 
 
 @pytest.mark.slow

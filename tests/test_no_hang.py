@@ -33,6 +33,7 @@ from paddle_tpu.distributed import reshard as reshard_mod  # noqa: F401 — regi
 from paddle_tpu.distributed import supervisor as supervisor_mod  # noqa: F401 — registers supervisor.* sites
 from paddle_tpu.distributed import rpc as rpc_mod
 from paddle_tpu.distributed import store as store_mod
+from paddle_tpu.inference.serving.gateway import server as gateway_mod  # noqa: F401 — registers gateway.* sites
 from paddle_tpu.distributed.store import _GET, _PyStoreServer
 from paddle_tpu.io.dataloader import DataLoaderWorkerError
 from paddle_tpu.utils.deadline import (CommTimeout, DataLoaderTimeout,
@@ -127,6 +128,23 @@ MATRIX = {
     ("supervisor.resume", "delay:2.0"): ("typed", "SupervisorTimeout"),
     ("supervisor.resume", "error"):     ("typed", "FaultInjected"),
     ("supervisor.resume", "drop"):      ("clean", None),
+    # serving gateway (inference/serving/gateway): the accept loop and the
+    # per-connection request read. An accept-side fault costs one
+    # connection — the client's reconnect-and-retry absorbs error/drop
+    # like a dead load-balancer hop, a delayed accept is latency the
+    # connect budget rides out. A read-side stall trips the CLIENT's
+    # request deadline into the typed RequestTimeout (the server's
+    # per-connection read deadline reaps the stalled handler); an injected
+    # read error answers a typed 500 frame the client re-raises; a dropped
+    # read closes the connection and the client's retry-once absorbs it.
+    ("gateway.accept", "crash"):     ("sigkill", None),
+    ("gateway.accept", "delay:1.5"): ("clean", None),
+    ("gateway.accept", "error"):     ("clean", None),
+    ("gateway.accept", "drop"):      ("clean", None),
+    ("gateway.read", "crash"):       ("sigkill", None),
+    ("gateway.read", "delay:2.0"):   ("typed", "RequestTimeout"),
+    ("gateway.read", "error"):       ("typed", "FaultInjected"),
+    ("gateway.read", "drop"):        ("clean", None),
 }
 
 
@@ -610,6 +628,14 @@ def test_supervisor_delay_becomes_typed_timeout_in_child(tmp_path):
     into the typed SupervisorTimeout, never a hang."""
     proc = _spawn_case("supervisor.rendezvous", "delay:2.0", tmp_path)
     _assert_case("supervisor.rendezvous", "delay:2.0", proc)
+
+
+def test_gateway_read_delay_becomes_typed_timeout_in_child(tmp_path):
+    """Quick tier-1 representative of the gateway rows: a stalled request
+    read server-side becomes the client's typed RequestTimeout at ~its
+    budget — the no-hang law holds end to end over a real socket."""
+    proc = _spawn_case("gateway.read", "delay:2.0", tmp_path)
+    _assert_case("gateway.read", "delay:2.0", proc)
 
 
 @pytest.mark.slow

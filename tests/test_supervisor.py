@@ -211,6 +211,68 @@ def test_graceful_leave_shrinks_and_matches_oracle(tmp_path):
         _stop_fleet(sups, store, els)
 
 
+def test_rendezvous_key_gc_across_epochs(tmp_path, monkeypatch):
+    """Satellite (ISSUE 14): the store must NOT accumulate per-epoch
+    rendezvous keys and per-step barrier keys for the life of a run.
+    Two scale events (three -> two -> one members, epochs 1 and 2) over
+    the inspectable py-fallback store: after the second converged
+    rendezvous every epoch-1 {ns}/rdv/* key and the rdvwin record are
+    deleted, barrier keys are held to the rolling window, and only the
+    CURRENT epoch's rendezvous record remains."""
+    from paddle_tpu.distributed import store as store_mod
+
+    class _NoNative:
+        @staticmethod
+        def get_lib():
+            return None
+
+    monkeypatch.setattr(store_mod, "native", _NoNative)
+    sv.reset_events()
+
+    def fn_a(state, batch, sup):
+        return step_fn(state, batch, sup)
+
+    def fn_b(state, batch, sup):
+        if sup.steps_done == 1:
+            sup.request_stop(leave=True)
+        return step_fn(state, batch, sup)
+
+    def fn_c(state, batch, sup):
+        if sup.steps_done == 3:
+            sup.request_stop(leave=True)
+        return step_fn(state, batch, sup)
+
+    sups, results, errors, mgr, store, els = _run_fleet(
+        tmp_path, ["a", "b", "c"], 6,
+        {"a": fn_a, "b": fn_b, "c": fn_c})
+    try:
+        assert not errors, errors
+        a = sups["a"]
+        assert a.steps_done == 6 and a.roster == ["a"]
+        assert a.epoch == 2 and len(a.events) == 2
+        kv = store._py_server._kv
+        keys = sorted(kv)
+        rdv1 = [k for k in keys if k.startswith("sup/rdv/1/")]
+        assert rdv1 == [], f"epoch-1 rendezvous keys leaked: {rdv1}"
+        assert "sup/rdvwin/1" not in keys
+        bar = [k for k in keys if k.startswith("sup/bar/")]
+        # rolling + window GC: nothing of the multi-member epochs survives
+        # (epoch 2 runs a one-member roster — no barrier at all)
+        assert len(bar) == 0, f"barrier keys leaked: {bar}"
+        # the CURRENT epoch's record stays (fencing/adoption still needs
+        # it); that is a constant, not life-of-run growth
+        rdv2 = [k for k in keys if k.startswith("sup/rdv/2/")
+                or k == "sup/rdvwin/2"]
+        assert len(rdv2) <= 3, rdv2
+        # and the run still ends bitwise the oracle — GC changed nothing
+        full, members = _replay(a.events, 6, ["a", "b", "c"], mgr=mgr)
+        assert members == ["a"]
+        for k in full:
+            assert np.array_equal(results["a"][k], full[k]), k
+    finally:
+        _stop_fleet(sups, store, els)
+
+
 def test_grow_joiner_receives_shards_via_planner(tmp_path):
     """dp1 -> dp2 grow: a runs alone; j joins with joining=True and NO
     state — its shards arrive via the planner; both finish on dp2 with
