@@ -184,6 +184,23 @@ def main() -> dict:
         "pass_report": progs[0].pass_report.as_dict() if progs else None,
         "donated": list(progs[0].donate) if progs else None}
 
+    # --- captured tier with tracing ON (the observability cost gate) ---
+    # same executable, same workload, PT_TRACE flipped: the only delta is
+    # the capture.execute span per step, so the ratio IS the span cost.
+    # Documented ceiling: <= 1.25x (slow battery; smoke allows 1.5x for
+    # tiny-iteration noise on the shared single-core box).
+    from paddle_tpu.observability import trace as obs_trace
+
+    obs_trace.enable(True)
+    try:
+        ips_cap_traced, _, _ = _time_tier(captured_one, fresh_vals(),
+                                          iters, warmup)
+    finally:
+        obs_trace.enable(False)
+        obs_trace.trace_clear()
+    detail["tiers"]["captured_traced"] = {
+        "iters_per_sec": round(ips_cap_traced, 2)}
+
     # --- hand-written single-jit tier ---
     hand = _hand_jit_step_fn(model, params)
 
@@ -211,6 +228,9 @@ def main() -> dict:
         "per_op_steps_per_sec": round(ips_perop, 1),
         "captured_steps_per_sec": round(ips_cap, 1),
         "hand_jit_steps_per_sec": round(ips_hand, 1),
+        # trace-on / trace-off cost of the captured step (>= ~1.0; the
+        # documented observability overhead ceiling is 1.25x)
+        "trace_overhead": round(ips_cap / ips_cap_traced, 4),
     }
     print(json.dumps(payload), flush=True)
 

@@ -73,6 +73,7 @@ from . import io  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from .jit.api import to_static  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
+from . import observability  # noqa: E402,F401  — arms the flight recorder
 from . import device  # noqa: E402,F401
 from .utils import flags as _flags  # noqa: E402
 from .utils.flags import set_flags, get_flags  # noqa: E402,F401

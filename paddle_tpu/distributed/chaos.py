@@ -153,6 +153,19 @@ def _fault_should_fire(site: str) -> bool:
     return hit - skip <= int(raw)
 
 
+def _trace_fault(site: str, mode: str) -> None:
+    """Record the firing fault on the trace ring (observability): the
+    flight recorder's last-K snapshot then ENDS at the faulted site, so a
+    chaos timeout's postmortem timeline names its own cause. Lazy import +
+    best-effort — the chaos layer must work before/without observability,
+    and only ARMED-and-firing sites pay for it."""
+    try:
+        from ..observability.trace import event
+        event(site, cat="chaos.fault", mode=mode)
+    except Exception:  # noqa: BLE001 — never let tracing break injection
+        pass
+
+
 def faultpoint(site: str) -> None:
     """Inject the armed fault mode here iff this site is armed via
     PT_FAULTPOINT. Unarmed sites cost one dict lookup plus one getenv."""
@@ -163,6 +176,7 @@ def faultpoint(site: str) -> None:
     if not _fault_should_fire(site):
         return
     mode = os.environ.get("PT_FAULTPOINT_MODE", "error").strip()
+    _trace_fault(site, mode)
     if mode == "crash":
         # identical contract to crashpoint(): nothing after this line runs
         os.kill(os.getpid(), signal.SIGKILL)

@@ -317,14 +317,21 @@ class Supervisor:
     # retry-once on a dropped wire (idempotent store ops, safe to reissue)
     # ------------------------------------------------------------------
     def _site(self, site: str, dl: Deadline, what: str) -> None:
-        for attempt in (0, 1):
-            try:
-                faultpoint(site)
-                dl.check(what, exc=SupervisorTimeout)
-                return
-            except ConnectionError:
-                if attempt:
-                    raise
+        # the observability span carries the supervision epoch, so a scale
+        # event's detect/rendezvous/swap/resume transitions line up on one
+        # correlated timeline (and a chaos delay here shows as the span's
+        # duration — the flight recorder's postmortem names the stall)
+        from ..observability import trace
+        with trace.span(site, epoch=self.epoch, node=self.node_id,
+                        step=self.steps_done):
+            for attempt in (0, 1):
+                try:
+                    faultpoint(site)
+                    dl.check(what, exc=SupervisorTimeout)
+                    return
+                except ConnectionError:
+                    if attempt:
+                        raise
 
     # ------------------------------------------------------------------
     # the supervised loop

@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...observability import trace
 from ...utils.deadline import env_int
 from .kv_pool import KVPagePool
 from .prefix import PrefixCache
@@ -370,6 +371,9 @@ class ServingEngine:
             req.shared_pages, req.shared_kv, req.shared_len = \
                 self.prefix_cache.share(req.prompt)
         self.scheduler.submit(req)
+        trace.event("engine.submit", rid=req.rid,
+                    prompt_len=int(req.prompt.size),
+                    max_new=req.max_new_tokens)
         return req
 
     # ------------------------------------------------------------------
@@ -519,17 +523,19 @@ class ServingEngine:
         plen = int(req.prompt.size)
         pos = req.prefill_pos
         n = min(w, plen - pos)
-        tok = np.zeros((1, w), np.int64)
-        tok[0, :n] = req.prompt[pos:pos + n]
-        nxt, req.scratch = self._ensure_window_fn()(
-            self._params, jnp.asarray(tok), req.scratch,
-            jnp.asarray([pos], jnp.int32))
-        self._counters["prefill_chunks"] += 1
-        req.prefill_pos = pos + n
-        made = 0
-        if req.prefill_pos >= plen:
-            made = self._finish_scratch_prefill(
-                req, int(np.asarray(nxt)[0, n - 1]))
+        with trace.span("engine.prefill_chunk", rid=req.rid, pos=pos,
+                        tokens=n):
+            tok = np.zeros((1, w), np.int64)
+            tok[0, :n] = req.prompt[pos:pos + n]
+            nxt, req.scratch = self._ensure_window_fn()(
+                self._params, jnp.asarray(tok), req.scratch,
+                jnp.asarray([pos], jnp.int32))
+            self._counters["prefill_chunks"] += 1
+            req.prefill_pos = pos + n
+            made = 0
+            if req.prefill_pos >= plen:
+                made = self._finish_scratch_prefill(
+                    req, int(np.asarray(nxt)[0, n - 1]))
         self._prefill_time += time.perf_counter() - t0
         return made
 
@@ -580,6 +586,16 @@ class ServingEngine:
         length (batch 1, fresh zero caches), write the KV rows into its
         slot, and sample its first token (argmax on device for greedy
         requests; host-side off the logits row for sampled ones)."""
+        # attrs built only when tracing is on (the near-zero off-cost law:
+        # a disabled span must not pay for its own correlation ids)
+        sp = trace.span("engine.prefill", rid=req.rid,
+                        bucket=self._bucket_for(int(req.prompt.size)),
+                        prompt_len=int(req.prompt.size)) \
+            if trace.enabled() else trace.span("engine.prefill")
+        with sp:
+            return self._prefill_impl(req)
+
+    def _prefill_impl(self, req: Request) -> int:
         t0 = time.perf_counter()
         plen = req.prompt.size
         bucket = self._bucket_for(plen)
@@ -648,30 +664,37 @@ class ServingEngine:
             return 0
         t0 = time.perf_counter()
         b = self.max_batch
-        tok = np.zeros((b, 1), np.int64)
-        off = np.zeros((b,), np.int32)
-        for s, r in active:
-            tok[s, 0] = r.next_token
-            off[s] = r.cache_len
-        sampling = [(s, r) for s, r in active if r.is_sampling]
-        args = (self._params, jnp.asarray(tok), self._caches,
-                jnp.asarray(off), jnp.zeros((b,), jnp.int32))
-        if sampling:
-            nxt, logits, self._caches = self._ensure_logits_step()(*args)
-            rows = np.asarray(logits)
-        else:
-            nxt, self._caches = self._step_fn(*args)
-            rows = None
-        sampled = np.asarray(nxt)   # [B] i32, not [B, vocab] logits
-        for s, r in active:
-            r.cache_len += 1
-            if r.is_sampling:
-                t = self._sample_row(r, rows[s])
-                self._counters["sampled_tokens"] += 1
+        # the decode hot path: the per-step rid list exists only when
+        # tracing is on — off, the span is the shared no-op singleton
+        sp = trace.span("engine.decode_step",
+                        step=self._counters["decode_steps"],
+                        rids=[r.rid for _, r in active]) \
+            if trace.enabled() else trace.span("engine.decode_step")
+        with sp:
+            tok = np.zeros((b, 1), np.int64)
+            off = np.zeros((b,), np.int32)
+            for s, r in active:
+                tok[s, 0] = r.next_token
+                off[s] = r.cache_len
+            sampling = [(s, r) for s, r in active if r.is_sampling]
+            args = (self._params, jnp.asarray(tok), self._caches,
+                    jnp.asarray(off), jnp.zeros((b,), jnp.int32))
+            if sampling:
+                nxt, logits, self._caches = self._ensure_logits_step()(*args)
+                rows = np.asarray(logits)
             else:
-                t = int(sampled[s])
-            if not r.append_token(t):
-                r.next_token = t
+                nxt, self._caches = self._step_fn(*args)
+                rows = None
+            sampled = np.asarray(nxt)   # [B] i32, not [B, vocab] logits
+            for s, r in active:
+                r.cache_len += 1
+                if r.is_sampling:
+                    t = self._sample_row(r, rows[s])
+                    self._counters["sampled_tokens"] += 1
+                else:
+                    t = int(sampled[s])
+                if not r.append_token(t):
+                    r.next_token = t
         self._counters["decode_steps"] += 1
         self._counters["tokens_generated"] += len(active)
         self._occupancy_sum += len(active) / float(b)
@@ -694,16 +717,27 @@ class ServingEngine:
             return 0
         t0 = time.perf_counter()
         b, k = self.max_batch, self.spec_k
-        drafts = self.drafter.propose(dict(active), k)
-        tok = np.zeros((b, k + 1), np.int64)
-        off = np.zeros((b,), np.int32)
-        for s, r in active:
-            tok[s, 0] = r.next_token
-            tok[s, 1:] = drafts[s]
-            off[s] = r.cache_len
-        nxt, self._caches = self._verify_fn(
-            self._params, jnp.asarray(tok), self._caches, jnp.asarray(off))
-        targets = np.asarray(nxt)           # [B, k+1] i32, one sync per step
+        _t = trace.enabled()
+        _rids = [r.rid for _, r in active] if _t else ()
+        sp = trace.span("engine.decode_step",
+                        step=self._counters["decode_steps"],
+                        rids=_rids, spec=True) \
+            if _t else trace.span("engine.decode_step")
+        with sp:
+            drafts = self.drafter.propose(dict(active), k)
+            tok = np.zeros((b, k + 1), np.int64)
+            off = np.zeros((b,), np.int32)
+            for s, r in active:
+                tok[s, 0] = r.next_token
+                tok[s, 1:] = drafts[s]
+                off[s] = r.cache_len
+            vsp = trace.span("engine.verify_step", k=k, rids=_rids) \
+                if _t else trace.span("engine.verify_step")
+            with vsp:
+                nxt, self._caches = self._verify_fn(
+                    self._params, jnp.asarray(tok), self._caches,
+                    jnp.asarray(off))
+                targets = np.asarray(nxt)   # [B, k+1] i32, one sync per step
         produced = 0
         for s, r in active:
             d = drafts[s]

@@ -163,6 +163,23 @@ class GatewayClient:
         if not head.startswith(str(proto.STATUS_OK)):
             raise GatewayConnectionError(f"gateway ping rejected: {head!r}")
 
+    def metrics(self, timeout: float = 10.0) -> str:
+        """Scrape the gateway process's metrics registry (the PTSG/1
+        METRICS verb): returns the Prometheus text exactly as the server
+        rendered it. Raises the typed GatewayDraining on a draining
+        gateway (503 on the wire) — a scraper must see the drain, not a
+        healthy-looking half-sample."""
+        dl = Deadline(timeout, what=f"gateway metrics "
+                                    f"{self.host}:{self.port}")
+        head, headers, body = self._exchange(proto.metrics_frame(), dl,
+                                             timeout)
+        parts = head.split(None, 1)
+        status = int(parts[0])
+        if status != proto.STATUS_OK:
+            raise _typed_error(status, parts[1] if len(parts) > 1 else "",
+                               headers.get("error", head), timeout)
+        return body.decode("utf-8")
+
     def generate(self, prompt_ids, max_new_tokens: int = 16,
                  ttl: Optional[float] = None,
                  timeout: Optional[float] = None,

@@ -8,7 +8,7 @@ either side closes.
 
 Request::
 
-    PTSG/1 GENERATE            (or PING, no headers/body)
+    PTSG/1 GENERATE            (or PING / METRICS, no headers/body)
     prompt-len: 12             body token count
     max-new-tokens: 16
     ttl: 2.5                   optional; maps onto the engine's per-request
@@ -37,6 +37,11 @@ Errors carry the TYPED class name and message instead of a body::
 The client re-raises the matching typed error (`RequestTimeout`,
 `PoolExhausted`, `SamplingUnsupported`, ...) so a caller over the socket
 sees exactly the exceptions the in-process engine raises.
+
+``METRICS`` answers the process metrics registry as Prometheus text in a
+``content-length``-sized UTF-8 body (drain-aware: a draining gateway
+answers the typed 503 so a scraper never samples a half-stopped process
+as healthy).
 """
 from __future__ import annotations
 
@@ -51,6 +56,11 @@ from ....utils.deadline import Deadline, RequestTimeout, recv_exact
 MAGIC = "PTSG/1"
 MAX_LINE = 4096          # a header line longer than this is a protocol error
 MAX_TOKENS = 1 << 20     # sanity cap on either direction's token payload
+MAX_TEXT_BODY = 1 << 26  # content-length (METRICS text) cap — wider than
+                         # the token cap so a large registry render never
+                         # wedges the scrape behind a mis-labeled
+                         # "connection" failure, still bounded vs a
+                         # garbage peer
 
 # status codes -> the typed error the client re-raises (the server sends
 # type(exc).__name__ beside the code; the CLASS mapping is by code so an
@@ -141,14 +151,18 @@ def read_frame(sock, dl: Optional[Deadline],
         headers[key.strip().lower()] = val.strip()
     try:
         n = int(headers.get("tokens", headers.get("prompt-len", 0)) or 0)
+        # a text body (the METRICS verb) is sized in raw bytes, not tokens
+        nbytes = int(headers["content-length"]) \
+            if "content-length" in headers else n * 8
     except ValueError as e:
         # a malformed size leaves the (unsized) body unconsumed — the
         # stream is desynced from here, so this MUST be the typed
         # connection-closing error, never an answer-and-continue
         raise ProtocolError(f"malformed token count: {e}") from e
-    if n < 0 or n > MAX_TOKENS:
-        raise ProtocolError(f"token payload count {n} out of range")
-    body = read_body(sock, dl, buf, n * 8) if n else b""
+    cap = MAX_TEXT_BODY if "content-length" in headers else MAX_TOKENS * 8
+    if n < 0 or n > MAX_TOKENS or nbytes < 0 or nbytes > cap:
+        raise ProtocolError(f"body size {nbytes} out of range")
+    body = read_body(sock, dl, buf, nbytes) if nbytes else b""
     return head, headers, body
 
 
@@ -173,6 +187,20 @@ def request_frame(prompt, max_new_tokens: int, ttl: Optional[float],
 
 def ping_frame() -> bytes:
     return f"{MAGIC} PING\n\n".encode("ascii")
+
+
+def metrics_frame() -> bytes:
+    """The METRICS verb: scrape the process metrics registry
+    (observability/metrics.py Prometheus text) over the wire."""
+    return f"{MAGIC} METRICS\n\n".encode("ascii")
+
+
+def text_response_frame(text: str) -> bytes:
+    """A 200 whose body is raw UTF-8 text sized by ``content-length``
+    (the METRICS response — token framing stays untouched)."""
+    payload = text.encode("utf-8")
+    return (f"{MAGIC} {STATUS_OK} OK\ncontent-length: {len(payload)}\n\n"
+            ).encode("ascii") + payload
 
 
 def response_frame(tokens, finish_reason: Optional[str]) -> bytes:

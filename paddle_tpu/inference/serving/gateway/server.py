@@ -34,6 +34,7 @@ import time
 import weakref
 from typing import Optional
 
+from ....observability import trace
 from ....utils.deadline import Deadline, env_timeout
 from ....distributed.chaos import faultpoint, register_fault
 from ..request import Request
@@ -73,7 +74,8 @@ class ServingGateway:
         self._inflight = 0
         self.counters = {"connections": 0, "requests": 0, "responses": 0,
                          "errors": 0, "read_timeouts": 0,
-                         "protocol_errors": 0, "driver_errors": 0}
+                         "protocol_errors": 0, "driver_errors": 0,
+                         "metrics_scrapes": 0}
         self._status_counts: dict = {}
         self._driver = threading.Thread(target=self._drive, daemon=True,
                                         name=f"gateway-driver:{self.port}")
@@ -158,6 +160,13 @@ class ServingGateway:
                     dl = Deadline(self.read_timeout,
                                   what=f"gateway read :{self.port}")
                     head, headers, body = proto.read_frame(fd, dl, buf)
+                    # an EVENT, not a span around the read: a span would
+                    # record every idle keep-alive poll's full read-
+                    # deadline wait and churn the bounded ring with idle
+                    # records — the event marks only served reads (the
+                    # chaos faultpoint below stamps its own record when
+                    # armed, so an incident timeline still ends here)
+                    trace.event("gateway.read", port=self.port)
                     faultpoint(FP_READ)
                 except socket.timeout:
                     with self._lock:
@@ -183,6 +192,27 @@ class ServingGateway:
                 fd.settimeout(env_timeout("PT_GATEWAY_SEND_TIMEOUT", 30.0))
                 if head.startswith("PING"):
                     fd.sendall(proto.response_frame([], None))
+                    continue
+                if head.startswith("METRICS"):
+                    # drain-aware like GENERATE: a draining gateway answers
+                    # the typed 503 (a scraper must never sample a half-
+                    # stopped process as healthy), a live one renders the
+                    # registry — engine counters included, so a wire scrape
+                    # round-trips metrics_snapshot() exactly
+                    if self._draining or self._stopping:
+                        self._count_status(proto.STATUS_DRAINING)
+                        fd.sendall(proto.error_frame(
+                            proto.STATUS_DRAINING,
+                            proto.GatewayDraining(
+                                "gateway is draining for shutdown — "
+                                "scrape elsewhere")))
+                        continue
+                    from ....observability import metrics as _metrics
+                    self._count_status(proto.STATUS_OK)
+                    with self._lock:
+                        self.counters["metrics_scrapes"] += 1
+                    fd.sendall(proto.text_response_frame(
+                        _metrics.render_prometheus()))
                     continue
                 if not head.startswith("GENERATE"):
                     self._count_status(proto.STATUS_BAD_REQUEST)
@@ -232,28 +262,33 @@ class ServingGateway:
         top_p = headers.get("top-p")
         seed = headers.get("seed")
         eos = headers.get("eos")
-        req: Request = self.engine.submit(
-            prompt,
-            max_new_tokens=int(headers.get("max-new-tokens", 16)),
-            ttl=float(ttl) if ttl is not None else None,
-            temperature=float(temp) if temp is not None else None,
-            top_p=float(top_p) if top_p is not None else None,
-            seed=int(seed) if seed is not None else None,
-            eos_token_id=int(eos) if eos is not None else None)
-        # the wait is ALWAYS bounded: the request's own TTL (+grace for the
-        # final decode step) when it has one, the gateway request budget
-        # otherwise — a wedged driver surfaces as a typed 408, not a
-        # parked handler thread
-        budget = (float(ttl) + env_timeout("PT_GATEWAY_TTL_GRACE", 10.0)
-                  if ttl is not None
-                  else env_timeout("PT_GATEWAY_REQUEST_TIMEOUT", 300.0))
-        if not req.wait(timeout=budget):
-            raise proto.RequestTimeout(
-                f"gateway request {req.rid}", budget,
-                detail="engine did not finish the request within the "
-                       "gateway budget")
-        tokens = req.result()  # raises the typed error on TTL/cancel
-        return proto.response_frame(tokens, req.finish_reason)
+        # the wire-side span of one request: the engine's request id lands
+        # on it at submit, so a Chrome-trace timeline links this span to
+        # every engine.prefill/decode/verify span that served the rid
+        with trace.span("gateway.request", port=self.port) as sp:
+            req: Request = self.engine.submit(
+                prompt,
+                max_new_tokens=int(headers.get("max-new-tokens", 16)),
+                ttl=float(ttl) if ttl is not None else None,
+                temperature=float(temp) if temp is not None else None,
+                top_p=float(top_p) if top_p is not None else None,
+                seed=int(seed) if seed is not None else None,
+                eos_token_id=int(eos) if eos is not None else None)
+            sp.set(rid=req.rid, prompt_len=int(prompt.size))
+            # the wait is ALWAYS bounded: the request's own TTL (+grace for
+            # the final decode step) when it has one, the gateway request
+            # budget otherwise — a wedged driver surfaces as a typed 408,
+            # not a parked handler thread
+            budget = (float(ttl) + env_timeout("PT_GATEWAY_TTL_GRACE", 10.0)
+                      if ttl is not None
+                      else env_timeout("PT_GATEWAY_REQUEST_TIMEOUT", 300.0))
+            if not req.wait(timeout=budget):
+                raise proto.RequestTimeout(
+                    f"gateway request {req.rid}", budget,
+                    detail="engine did not finish the request within the "
+                           "gateway budget")
+            tokens = req.result()  # raises the typed error on TTL/cancel
+            return proto.response_frame(tokens, req.finish_reason)
 
     # ------------------------------------------------------------------
     # shutdown

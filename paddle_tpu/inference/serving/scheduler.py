@@ -21,6 +21,7 @@ import threading
 from collections import deque
 from typing import List, Tuple
 
+from ...observability import trace
 from .kv_pool import KVPagePool, PoolExhausted
 from .request import Request, RequestState
 
@@ -129,6 +130,8 @@ class ContinuousBatchingScheduler:
                 self._release_all(req)
                 self.counters["evicted"] += 1
                 evicted.append(req)
+                trace.event("scheduler.evict", rid=req.rid, slot=slot,
+                            reason=req.finish_reason)
             # 2. expire queued requests (typed rejection, pages returned)
             still = deque()
             for req in self._queue:
@@ -138,6 +141,7 @@ class ContinuousBatchingScheduler:
                     req.finish(RequestState.TIMED_OUT)
                     self.counters["timed_out"] += 1
                     evicted.append(req)
+                    trace.event("scheduler.expire_queued", rid=req.rid)
                 else:
                     still.append(req)
             self._queue = still
@@ -156,6 +160,8 @@ class ContinuousBatchingScheduler:
                 self._running[head.slot] = head
                 self.counters["admitted"] += 1
                 joined.append(head)
+                trace.event("scheduler.join", rid=head.rid, slot=head.slot,
+                            pages=len(head.pages))
         return joined, evicted
 
     # ---- views ----

@@ -59,6 +59,7 @@ import numpy as np
 
 from ..core import generator as gen
 from ..core.tensor import Tensor
+from ..observability import trace as _trace
 from ..utils.memo import Lazy, LockedLRU
 from . import passes as _passes
 from .passes import lint as _lint
@@ -301,12 +302,15 @@ def lower_step(fn: Callable, example_args: Sequence[Any],
         if _contains_tracer(flat_example):
             raise _BailOut("example args contain tracers")
         sig = tuple(_leaf_sig(v) for v in flat_example)
+        step_name = name or getattr(fn, "__name__", "step")
         op_names: list = []
-        with _recording(op_names):
-            closed, out_shape = jax.make_jaxpr(
-                fn, return_shape=True)(*example_args)
+        with _trace.span("capture.trace", step=step_name):
+            with _recording(op_names):
+                closed, out_shape = jax.make_jaxpr(
+                    fn, return_shape=True)(*example_args)
         out_def = jax.tree_util.tree_structure(out_shape)
-        closed, report = _passes.run_pipeline(closed, passes=passes)
+        with _trace.span("capture.lower", step=step_name):
+            closed, report = _passes.run_pipeline(closed, passes=passes)
 
         def _pt_captured_step(*args):
             flat = jax.tree_util.tree_leaves(args)
@@ -321,7 +325,8 @@ def lower_step(fn: Callable, example_args: Sequence[Any],
         def dispatcher(*args):
             flat = jax.tree_util.tree_leaves(args)
             if tuple(_leaf_sig(v) for v in flat) == sig:
-                return jitted(*args)
+                with _trace.span("capture.execute", step=step_name):
+                    return jitted(*args)
             with _LOCK:
                 _TOTALS.fallback_calls += 1
             return plain()(*args)
@@ -352,8 +357,7 @@ def lower_step(fn: Callable, example_args: Sequence[Any],
         # a caller-supplied name keeps lint records distinct when fn is a
         # wrapper lambda (the to_static path) — '<lambda>' rows would
         # clobber each other in profiler.lint_summary()
-        _lint_step(name or getattr(fn, "__name__", "step"), closed, report,
-                   donated_flat)
+        _lint_step(step_name, closed, report, donated_flat)
         return dispatcher, prog
     except Exception as e:  # noqa: BLE001 — correctness net: plain jit
         _note_bailout(f"lower_step:{type(e).__name__}: {e}")
@@ -553,16 +557,19 @@ class CapturedStep:
 
         op_names: list = []
         rec = _recording(op_names)
-        with rec:
-            closed = jax.make_jaxpr(flat_fn)(
-                *(jnp.asarray(_unwrap(leaves[p])) for p in arr_pos))
+        with _trace.span("capture.trace", step=self.__name__):
+            with rec:
+                closed = jax.make_jaxpr(flat_fn)(
+                    *(jnp.asarray(_unwrap(leaves[p])) for p in arr_pos))
         if rec.rng_drawn() and not self._allow_baked_rng:
             raise _BailOut(
                 "step drew from the global RNG during capture; replays "
                 "would reuse baked keys — pass the key as an argument or "
                 "wrap with capture_step(allow_baked_rng=True)")
 
-        closed, report = _passes.run_pipeline(closed, passes=self._passes)
+        with _trace.span("capture.lower", step=self.__name__):
+            closed, report = _passes.run_pipeline(closed,
+                                                  passes=self._passes)
 
         donated: tuple = ()
         if self._donate == "auto":
@@ -613,7 +620,8 @@ class CapturedStep:
         return tuple(out)
 
     def _run(self, entry: _Entry, leaves):
-        arrs = entry.exec(*(_unwrap(leaves[p]) for p in entry.arr_pos))
+        with _trace.span("capture.execute", step=self.__name__):
+            arrs = entry.exec(*(_unwrap(leaves[p]) for p in entry.arr_pos))
         it = iter(arrs)
         res = []
         for m, s in zip(entry.mask, entry.statics):

@@ -1,0 +1,33 @@
+"""paddle_tpu.observability — trace spans, metrics wire, flight recorder.
+
+The shared event spine under the six production subsystems (capture,
+serving+gateway, reshard, supervisor, comms, embeddings):
+
+- ``trace`` — bounded ring of correlated spans (`span()`/`event()`),
+  Chrome trace-event export for Perfetto, near-zero cost when
+  ``PT_TRACE=0``;
+- ``metrics`` — Counter/Gauge/Histogram registry + pull collectors over
+  the existing ad-hoc counters, rendered as Prometheus text and served
+  over the wire by the gateway's PTSG/1 ``METRICS`` verb;
+- the flight recorder — every typed ``DeadlineExceeded`` construction
+  snapshots the last-K spans into ``last_incident()`` (the hook is
+  installed here, at package import), so each chaos-matrix timeout
+  produces a postmortem timeline, not just a typed error.
+
+Importing this package is cheap (stdlib only) — it is imported by
+``paddle_tpu/__init__`` so the flight recorder is armed process-wide.
+"""
+from ..utils import deadline as _deadline
+from . import metrics, trace  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, metrics_snapshot, register_collector,
+    render_prometheus,
+)
+from .trace import (  # noqa: F401
+    enable, enabled, event, export_trace, incidents, last_incident, span,
+    trace_clear, trace_info, trace_records,
+)
+
+# arm the flight recorder: every typed DeadlineExceeded raise snapshots
+# the last-K spans (see trace.record_incident)
+_deadline.set_incident_hook(trace.record_incident)

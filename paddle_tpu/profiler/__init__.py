@@ -15,6 +15,6 @@ from .profiler import (  # noqa: F401
 from .statistic import (  # noqa: F401
     comm_summary, gateway_summary, lint_summary, op_cache_summary,
     reshard_summary, serving_summary, step_capture_summary,
-    supervisor_summary,
+    supervisor_summary, trace_summary,
 )
 from .timer import benchmark  # noqa: F401

@@ -6,6 +6,22 @@ from __future__ import annotations
 from typing import Dict, List
 
 
+def _subsystem(modname: str):
+    """The subsystem module IFF it is already imported, else None — the
+    one empty-state idiom every summary shares: a summary must render
+    cleanly in a process that never touched its subsystem, and must never
+    be the thing that imports it (a profiler readout with side effects
+    would perturb exactly what it observes). Delegates to the metrics
+    scrape's guard so the two surfaces can't drift."""
+    from ..observability.metrics import loaded_module
+    return loaded_module(modname)
+
+
+def _no_data(label: str) -> str:
+    """The shared no-data rendering (subsystem never imported/exercised)."""
+    return f"{label}: no data (subsystem not loaded)"
+
+
 def aggregate(events: List[dict]) -> Dict[str, dict]:
     stats: Dict[str, dict] = {}
     for e in events:
@@ -32,7 +48,9 @@ def op_cache_summary(sorted_by: str = "hits") -> str:
     silent. A healthy steady-state loop shows retraces pinned at 1 per key
     and hits climbing; climbing retraces mean the key churns (shapes,
     statics, or fresh closures) and the op recompiles."""
-    from ..ops import dispatch
+    dispatch = _subsystem("paddle_tpu.ops.dispatch")
+    if dispatch is None:
+        return _no_data("op cache")
 
     info = dispatch.cache_info()
     key = sorted_by if sorted_by in ("hits", "misses", "retraces",
@@ -65,7 +83,9 @@ def step_capture_summary() -> str:
     climbing; climbing `bailouts` means the step keeps hitting an
     uncapturable construct and is silently riding the per-op tier — see
     README "Whole-step capture" for the bailout conditions."""
-    from ..jit import capture
+    capture = _subsystem("paddle_tpu.jit.capture")
+    if capture is None:
+        return _no_data("step capture")
 
     info = capture.capture_info()
     lines = [
@@ -92,7 +112,9 @@ def lint_summary() -> str:
     row — the same rules gate CI through the staticcheck jaxpr tier, so a
     finding here will fail `python -m tools.staticcheck --ci` once the
     step is one of the canonical traced steps."""
-    from ..jit.passes import lint
+    lint = _subsystem("paddle_tpu.jit.passes.lint")
+    if lint is None:
+        return _no_data("jaxpr lint")
 
     records = lint.lint_records()
     if not records:
@@ -124,9 +146,11 @@ def serving_summary() -> str:
     acceptance rate near 0 means the drafter never pays for its window
     (turn spec off or switch drafters), tokens/verify near k+1 means the
     workload is a speculation jackpot (consider raising k)."""
-    from ..inference.serving import serving_info
+    serving = _subsystem("paddle_tpu.inference.serving")
+    if serving is None:
+        return _no_data("serving")
 
-    infos = serving_info()
+    infos = serving.serving_info()
     if not infos:
         return "serving: no live engines"
     lines = []
@@ -190,9 +214,11 @@ def gateway_summary() -> str:
     408s mean TTLs are outrunning engine capacity (shed load or grow the
     engine), climbing read_timeouts mean idle/stalled peers are being
     reaped by the per-connection read deadline (normal under churn)."""
-    from ..inference.serving.gateway import gateway_info
+    gateway = _subsystem("paddle_tpu.inference.serving.gateway")
+    if gateway is None:
+        return _no_data("gateway")
 
-    infos = gateway_info()
+    infos = gateway.gateway_info()
     if not infos:
         return "gateway: no live gateways"
     lines = []
@@ -226,9 +252,11 @@ def comm_summary() -> str:
     the grad-sync site at ~3.9x compression (int8, block 256); 1.0x there
     means the context wasn't active when the step was BUILT — it is
     consulted at trace time, like amp.auto_cast."""
-    from ..distributed.comms import comm_info
+    comms = _subsystem("paddle_tpu.distributed.comms")
+    if comms is None:
+        return _no_data("comms")
 
-    info = comm_info()
+    info = comms.comm_info()
     if not info["sites"]:
         return "comms: no recorded collectives"
     head = (f"{'Site':<40} {'N':>5} {'Logical':>12} {'Wire':>12} "
@@ -256,9 +284,11 @@ def reshard_summary() -> str:
     rows whose moved bytes sit well under `naive`; recurring
     `full-restore` rows mean peers keep dying mid-transfer (check the
     reshard budget and the victim's logs)."""
-    from ..distributed.reshard import reshard_reports
+    reshard = _subsystem("paddle_tpu.distributed.reshard")
+    if reshard is None:
+        return _no_data("reshard")
 
-    reports = reshard_reports()
+    reports = reshard.reshard_reports()
     if not reports:
         return "reshard: no executed plans"
     head = (f"{'Owner':<14} {'How':<16} {'Moved':>12} {'Local':>12} "
@@ -284,9 +314,11 @@ def supervisor_summary() -> str:
     downtime sits near the detect latency plus the transfer time;
     recurring `full-restore` rungs mean live bytes keep dying with their
     exclusive owner — shard the state wider or commit more often."""
-    from ..distributed.supervisor import supervisor_events
+    supervisor = _subsystem("paddle_tpu.distributed.supervisor")
+    if supervisor is None:
+        return _no_data("supervisor")
 
-    events = supervisor_events()
+    events = supervisor.supervisor_events()
     if not events:
         return "supervisor: no scale events"
     head = (f"{'Epoch':>5} {'Cause':<18} {'Mesh':<10} {'Rung':<16} "
@@ -300,6 +332,45 @@ def supervisor_summary() -> str:
             f"{e['how']:<16} {str(e['generation']):>5} "
             f"{e['detect_latency_s']:>7.3f}s {e['downtime_s']:>8.3f}s "
             f"{e['bytes_moved']:>12}")
+    return "\n".join(lines)
+
+
+def trace_summary() -> str:
+    """Observability trace-ring state (observability/trace.py) as text:
+    ring occupancy, per-site span counts and total/avg/max durations, and
+    the flight-recorder incident count — the quick look before exporting
+    the full Chrome trace (``observability.export_trace``) into Perfetto.
+    A site whose avg dwarfs its peers is where the step's wall-clock goes;
+    a non-zero incident count means ``observability.last_incident()``
+    holds a postmortem timeline for the latest typed deadline error."""
+    obs = _subsystem("paddle_tpu.observability")
+    if obs is None:
+        return _no_data("trace")
+    info = obs.trace_info()
+    head_line = (f"trace: enabled={info['enabled']} "
+                 f"records={info['records']}/{info['capacity']} "
+                 f"dropped={info['dropped']} incidents={info['incidents']}")
+    sites: Dict[str, dict] = {}
+    for r in obs.trace_records():
+        s = sites.setdefault(r["name"], {"count": 0, "events": 0,
+                                         "total_ns": 0, "max_ns": 0})
+        if r["dur"] is None:
+            s["events"] += 1
+            continue
+        s["count"] += 1
+        s["total_ns"] += r["dur"]
+        s["max_ns"] = max(s["max_ns"], r["dur"])
+    if not sites:
+        return head_line
+    head = (f"{'Site':<28} {'Spans':>6} {'Events':>7} {'Total(ms)':>10} "
+            f"{'Avg(ms)':>9} {'Max(ms)':>9}")
+    lines = [head_line, head, "-" * len(head)]
+    for name, s in sorted(sites.items(), key=lambda kv: -kv[1]["total_ns"]):
+        avg = s["total_ns"] / s["count"] if s["count"] else 0.0
+        lines.append(
+            f"{name[:28]:<28} {s['count']:>6} {s['events']:>7} "
+            f"{s['total_ns'] / 1e6:>10.3f} {avg / 1e6:>9.3f} "
+            f"{s['max_ns'] / 1e6:>9.3f}")
     return "\n".join(lines)
 
 

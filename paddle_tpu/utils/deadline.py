@@ -18,6 +18,20 @@ from __future__ import annotations
 import time
 
 
+# flight-recorder hook (observability/trace.py installs it at package
+# import): every DeadlineExceeded CONSTRUCTION passes the new error to the
+# hook, which snapshots the last-K trace spans into last_incident() — a
+# chaos-matrix timeout then carries its own postmortem timeline. Kept as
+# an injected callback so this bottom-layer module never imports upward.
+_INCIDENT_HOOK = None
+
+
+def set_incident_hook(cb) -> None:
+    """Install (or clear, with None) the typed-deadline incident hook."""
+    global _INCIDENT_HOOK
+    _INCIDENT_HOOK = cb
+
+
 class DeadlineExceeded(TimeoutError):
     """A blocking primitive exceeded its time budget.
 
@@ -35,6 +49,11 @@ class DeadlineExceeded(TimeoutError):
         if detail:
             msg += f" — {detail}"
         super().__init__(msg)
+        if _INCIDENT_HOOK is not None:
+            try:
+                _INCIDENT_HOOK(self)
+            except Exception:  # noqa: BLE001 — the recorder must never
+                pass           # mask the typed error being raised
 
 
 class StoreTimeout(DeadlineExceeded):

@@ -21,6 +21,12 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# fixture PROJECTS are parse-only inputs for the staticcheck tests, never
+# test modules — keep pytest out of them (a fixture file named test_*.py,
+# like the chaos-site-coverage known-answer matrix, would otherwise
+# basename-collide with the real tests/test_no_hang.py at collection)
+collect_ignore_glob = ["fixtures/*", "staticcheck_proj/*"]
+
 
 def pytest_configure(config):
     config.addinivalue_line(

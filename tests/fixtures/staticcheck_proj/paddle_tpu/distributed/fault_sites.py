@@ -1,0 +1,21 @@
+"""chaos-site-coverage known-answer fixture: three fault-site shapes.
+
+- ``fixture.covered`` appears in this tree's tests/test_no_hang.py MATRIX
+  -> governed, no finding;
+- ``fixture.uncovered`` is registered but in no matrix row -> the one
+  finding this fixture owes;
+- ``fixture.pragma`` is uncovered but deliberately suppressed with a
+  rationale -> no finding (pragma route).
+"""
+
+
+def register_fault(site, description=""):
+    return site
+
+
+FP_COVERED = register_fault(
+    "fixture.covered", "a blocking window the matrix proves")
+FP_UNCOVERED = register_fault(
+    "fixture.uncovered", "a blocking window nobody proves — the finding")
+FP_PRAGMA = register_fault(  # staticcheck: ok[chaos-site-coverage] — fixture: deliberately unmatrixed site with a recorded rationale
+    "fixture.pragma", "a site whose coverage is deliberately waived")

@@ -1,0 +1,30 @@
+"""Namespaced-family fixture (registry-consistency route 3b): ops whose
+names qualify the public name with the module tail (`subpkg_govfoo` for
+`paddle_tpu.subpkg.govfoo`). Parse-only, like every fixture module.
+
+- ``subpkg_govfoo``: public module-level def, referenced through
+  `import paddle_tpu.subpkg as NS; NS.govfoo` in tests/battery_cases.py
+  -> governed;
+- ``subpkg_govmethod``: public method of a public module-level class
+  (the sparse.nn shape), referenced as `NS.grouped.govmethod`
+  -> governed;
+- ``subpkg_orphanbar``: dispatched by a public def nothing references
+  -> stays an orphan (the known-answer finding).
+"""
+import jax.numpy as jnp
+
+from ..ops.hazards import apply
+
+
+def govfoo(x):
+    return apply(jnp.tanh, x, op_name="subpkg_govfoo")
+
+
+class grouped:
+    @staticmethod
+    def govmethod(x):
+        return apply(jnp.cosh, x, op_name="subpkg_govmethod")
+
+
+def orphanbar(x):
+    return apply(jnp.sinh, x, op_name="subpkg_orphanbar")

@@ -3,9 +3,15 @@ registry-consistency checker resolves through public `__all__` exports.
 A cases-table string key counts only because the table's values reach
 the package (parse-only fixture: the import never executes)."""
 import paddle_tpu as P
+import paddle_tpu.subpkg as NS
 
 CASES = {
     "fixbattery": P.run_case,   # key governs; the value ties the table
                                 # to the package (a bare-config dict
                                 # would govern nothing)
 }
+
+# namespaced-family route (3b): attribute references through a module
+# alias also exercise the module-qualified op names — NS.govfoo reaches
+# `subpkg_govfoo`, NS.grouped.govmethod reaches `subpkg_govmethod`
+NAMESPACED_CASES = (NS.govfoo, NS.grouped.govmethod)

@@ -1,0 +1,7 @@
+"""Fixture no-hang matrix for the chaos-site-coverage known answers: the
+covered-site list the checker cross-references (site element of each key)."""
+
+MATRIX = {
+    ("fixture.covered", "crash"): ("sigkill", None),
+    ("fixture.covered", "delay:1.0"): ("typed", "StoreTimeout"),
+}

@@ -47,8 +47,13 @@ def test_bench_serving_smoke_json_contract():
               "p50_token_ms", "p99_token_ms"):
         assert payload[k] > 0, (k, payload)
     assert payload["p99_token_ms"] >= payload["p50_token_ms"]
-    # the engine must emit EXACTLY the sequential oracle's tokens
+    # the engine must emit EXACTLY the sequential oracle's tokens — the
+    # traced leg included (token_mismatches covers both)
     assert payload["token_mismatches"] == 0, payload
+    # the observability cost gate (smoke ceiling; the documented 1.25x
+    # ceiling is pinned in the slow battery)
+    assert payload["traced_tokens_per_sec"] > 0
+    assert 0 < payload["trace_overhead"] <= 1.5, payload
     assert "artifact ->" in stderr
     art = stderr.split("artifact ->", 1)[1].strip().splitlines()[0]
     with open(art) as f:
@@ -144,6 +149,8 @@ def test_bench_serving_meets_acceptance_floor():
     payload, _ = _run_bench(requests=24, batch=8, reps=3)
     assert payload["value"] >= 1.5, payload
     assert payload["token_mismatches"] == 0, payload
+    # the documented observability ceiling on the serving hot path
+    assert payload["trace_overhead"] <= 1.25, payload
 
 
 @pytest.mark.slow

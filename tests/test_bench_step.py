@@ -44,7 +44,13 @@ def test_bench_step_smoke_json_contract():
     with open(art) as f:
         self_json = json.load(f)
     tiers = self_json["detail"]["tiers"]
-    assert set(tiers) == {"per_op", "captured", "hand_jit"}
+    assert set(tiers) == {"per_op", "captured", "captured_traced",
+                          "hand_jit"}
+    # the observability cost gate (smoke ceiling; the documented 1.25x
+    # floor is pinned in the slow battery — tiny iteration counts on the
+    # shared box make ratios noisy)
+    assert 0 < payload["trace_overhead"] <= 1.5, payload
+    assert tiers["captured_traced"]["iters_per_sec"] > 0
     # the captured tier really captured: one lowering, served hits, and the
     # pass pipeline + donation inference ran on the llama-proxy step
     cap = tiers["captured"]
@@ -66,3 +72,6 @@ def test_bench_step_meets_acceptance_floor():
     payload, _ = _run_bench(iters=60)
     assert payload["value"] >= 2.0, payload
     assert payload["captured_vs_handjit"] <= 1.10, payload
+    # tracing the captured step costs one span per call — the documented
+    # observability ceiling
+    assert payload["trace_overhead"] <= 1.25, payload

@@ -1,0 +1,386 @@
+"""Unified trace spans, flight recorder, and the metrics wire (ISSUE 15).
+
+The contract under test:
+- span()/event() record into a thread-safe bounded ring with parent
+  linkage and correlation attrs; disabled tracing is a no-op (the ring
+  stays empty — the near-zero-cost law's observable half; the measured
+  half is bench_step/bench_serving's trace_overhead gate);
+- A GATEWAY-DRIVEN serving run exports a Chrome-trace JSON in which ONE
+  request id links the gateway request span to the engine's prefill /
+  decode-step / verify-step spans and the scheduler's join/evict events
+  (the acceptance timeline);
+- a chaos delay at an armed fault site yields a typed deadline error
+  whose flight-recorder incident timeline is non-empty and ENDS at the
+  faulted site;
+- the metrics registry (Counter/Gauge/Histogram + pull collectors)
+  renders deterministic Prometheus text; the gateway's PTSG/1 METRICS
+  verb round-trips the engine's counters byte-for-byte vs the in-process
+  snapshot, and answers the typed 503 while draining;
+- every profiler summary renders cleanly in a fresh process whose
+  subsystem was never imported (the shared no-data idiom), without
+  importing it.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.distributed import chaos
+from paddle_tpu.observability import metrics, trace
+from paddle_tpu.utils.deadline import DeadlineExceeded, RequestTimeout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model(seed=7, vocab=64, hidden=32, layers=2, heads=4, seq=64):
+    P.seed(seed)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig.tiny(vocab=vocab, hidden=hidden, layers=layers,
+                           heads=heads, inter=hidden * 2, seq=seq)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def model():
+    # ONE model for every engine test in this file: engines over the same
+    # weights share step lowerings (the model-stash idiom), so the suite
+    # pays the prefill/decode/verify compiles once
+    return _model()
+
+
+def _prompt(n, seed=0, vocab=64):
+    return np.random.RandomState(seed).randint(1, vocab, (n,))
+
+
+@pytest.fixture
+def tracing():
+    """Enable tracing around one test; restore the disabled default and
+    drain ring + incidents so tests stay order-independent."""
+    trace.trace_clear()
+    trace.clear_incidents()
+    trace.enable(True)
+    yield
+    trace.enable(False)
+    trace.trace_clear()
+    trace.clear_incidents()
+
+
+# ---------------------------------------------------------------------------
+# the trace ring
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_ring_and_export(tracing, tmp_path):
+    with trace.span("outer", rid=7) as sp:
+        sp.set(late="attr")
+        with trace.span("inner", rid=7):
+            trace.event("tick", rid=7)
+    recs = trace.trace_records()
+    assert [r["name"] for r in recs] == ["tick", "inner", "outer"]
+    outer = recs[2]
+    inner = recs[1]
+    assert inner["parent"] == outer["id"]       # nesting -> parent linkage
+    assert recs[0]["parent"] == inner["id"]     # events parent too
+    assert outer["args"] == {"rid": 7, "late": "attr"}
+    assert inner["dur"] >= 0 and recs[0]["dur"] is None
+    path = trace.export_trace(str(tmp_path / "t.json"))
+    evs = json.load(open(path))["traceEvents"]
+    assert [e["name"] for e in evs] == ["tick", "inner", "outer"]
+    assert evs[2]["ph"] == "X" and evs[2]["dur"] >= 0
+    assert evs[0]["ph"] == "i"
+    assert evs[1]["args"]["parent_id"] == evs[2]["args"]["span_id"]
+
+
+def test_ring_bound_and_dropped_counter(tracing):
+    trace.set_ring_size(4)
+    try:
+        for i in range(10):
+            trace.event(f"e{i}")
+        recs = trace.trace_records()
+        assert len(recs) == 4
+        assert [r["name"] for r in recs] == ["e6", "e7", "e8", "e9"]
+        assert trace.trace_info()["dropped"] == 6
+    finally:
+        trace.set_ring_size(4096)
+
+
+def test_disabled_tracing_is_a_noop():
+    trace.enable(False)
+    trace.trace_clear()
+    with trace.span("x", rid=1) as sp:
+        assert sp.set(a=1) is sp    # the null span keeps the API
+        trace.event("y")
+    assert trace.trace_records() == []
+    assert trace.trace_info()["enabled"] is False
+
+
+def test_trace_summary_renders(tracing):
+    import paddle_tpu.profiler as prof
+    with trace.span("site.a"):
+        trace.event("site.b")
+    out = prof.trace_summary()
+    assert "site.a" in out and "records=" in out
+
+
+# ---------------------------------------------------------------------------
+# the acceptance timeline: one rid across gateway -> engine -> verify
+# ---------------------------------------------------------------------------
+
+def test_gateway_run_exports_rid_linked_chrome_trace(tracing, tmp_path, model):
+    """A gateway-driven serving run on a SPECULATIVE engine: the exported
+    Chrome trace holds one request id linking gateway.request ->
+    engine.submit/prefill -> engine.decode_step -> engine.verify_step ->
+    scheduler join/evict — the cross-layer correlation the ISSUE names."""
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.inference.serving.gateway import (GatewayClient,
+                                                      ServingGateway)
+    eng = ServingEngine(model, max_batch=4, max_seq_len=64, spec_k=2,
+                        drafter="ngram")
+    gw = ServingGateway(eng)
+    try:
+        cli = GatewayClient("127.0.0.1", gw.port)
+        out = cli.generate(_prompt(8, seed=3), max_new_tokens=8)
+        assert out.size == 16
+        cli.close()
+    finally:
+        gw.stop(drain=True, timeout=10.0)
+    path = trace.export_trace(str(tmp_path / "serve.json"))
+    evs = json.load(open(path))["traceEvents"]
+    by_name = {}
+    for e in evs:
+        by_name.setdefault(e["name"], []).append(e)
+    # the wire-side span carries the engine's request id
+    gw_spans = by_name["gateway.request"]
+    assert len(gw_spans) == 1 and gw_spans[0]["ph"] == "X"
+    rid = gw_spans[0]["args"]["rid"]
+    # ... and that SAME id links every engine-side span of the request
+    assert any(e["args"].get("rid") == rid
+               for e in by_name["engine.submit"])
+    assert any(e["args"].get("rid") == rid
+               for e in by_name["engine.prefill"])
+    assert any(rid in e["args"].get("rids", ())
+               for e in by_name["engine.decode_step"])
+    assert any(rid in e["args"].get("rids", ())
+               for e in by_name["engine.verify_step"])
+    assert any(e["args"].get("rid") == rid
+               for e in by_name["scheduler.join"])
+    assert any(e["args"].get("rid") == rid
+               for e in by_name["scheduler.evict"])
+    # the verify span nests inside its decode step
+    verify = by_name["engine.verify_step"][0]
+    decode_ids = {e["args"]["span_id"] for e in by_name["engine.decode_step"]}
+    assert verify["args"]["parent_id"] in decode_ids
+    # gateway read spans exist on the wire side of the same timeline
+    assert by_name["gateway.read"]
+
+
+def test_engine_trace_off_records_nothing(model):
+    """The PT_TRACE=0 default: a full engine run leaves the ring empty
+    (no hidden recording on the serving hot path)."""
+    from paddle_tpu.inference.serving import ServingEngine
+    trace.enable(False)
+    trace.trace_clear()
+    eng = ServingEngine(model, max_batch=2, max_seq_len=64)
+    eng.generate([_prompt(5, seed=1)], max_new_tokens=4)
+    assert trace.trace_records() == []
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_typed_deadline_captures_incident(tracing):
+    with trace.span("some.site", step=3):
+        pass
+    try:
+        raise DeadlineExceeded("unit-test wait", 2.5)
+    except DeadlineExceeded:
+        pass
+    inc = trace.last_incident()
+    assert inc is not None
+    assert inc["error"] == "DeadlineExceeded"
+    assert inc["what"] == "unit-test wait" and inc["timeout"] == 2.5
+    assert inc["spans"][-1]["name"] == "some.site"
+
+
+def test_chaos_delay_incident_ends_at_faulted_site(tracing, monkeypatch, model):
+    """The postmortem law: a delay chaos case at gateway.read stalls the
+    exchange into the client's typed RequestTimeout, and last_incident()
+    holds a non-empty timeline ENDING at the faulted site (the chaos
+    event records before the stall, inside the read span)."""
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.inference.serving.gateway import (GatewayClient,
+                                                      ServingGateway)
+    eng = ServingEngine(model, max_batch=2, max_seq_len=64)
+    gw = ServingGateway(eng)
+    try:
+        cli = GatewayClient("127.0.0.1", gw.port)
+        chaos.reset_hits()
+        monkeypatch.setenv("PT_FAULTPOINT", "gateway.read")
+        monkeypatch.setenv("PT_FAULTPOINT_MODE", "delay:1.5")
+        monkeypatch.setenv("PT_FAULTPOINT_HITS", "inf")
+        trace.clear_incidents()
+        with pytest.raises(RequestTimeout):
+            cli.generate(_prompt(4, seed=2), max_new_tokens=4, timeout=0.4)
+        inc = trace.last_incident()
+        assert inc is not None and inc["error"] == "RequestTimeout"
+        assert inc["spans"], "incident carries no timeline"
+        last = inc["spans"][-1]
+        assert last["name"] == "gateway.read"      # ends at the faulted site
+        assert last["cat"] == "chaos.fault"
+        assert last["args"]["mode"].startswith("delay")
+        cli.close()
+    finally:
+        monkeypatch.delenv("PT_FAULTPOINT")
+        chaos.reset_hits()
+        gw.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry + the wire
+# ---------------------------------------------------------------------------
+
+def test_metric_instruments_and_render():
+    c = metrics.Counter("pt_unittest_total", "a test counter")
+    c.inc()
+    c.inc(4, kind="x")
+    g = metrics.Gauge("pt_unittest_gauge", "a gauge")
+    g.set(2.5)
+    g.inc(0.5)
+    h = metrics.Histogram("pt_unittest_seconds", "a histogram",
+                          buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(9.0)
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    snap = metrics.metrics_snapshot()
+    assert snap["pt_unittest_total"]["values"]["kind=x"] == 4.0
+    assert snap["pt_unittest_gauge"]["values"][""] == 3.0
+    text = metrics.render_prometheus()
+    assert "# TYPE pt_unittest_total counter" in text
+    assert 'pt_unittest_total{kind="x"} 4' in text
+    assert 'pt_unittest_seconds_bucket{le="0.1"} 1' in text
+    assert 'pt_unittest_seconds_bucket{le="+Inf"} 3' in text
+    assert "pt_unittest_seconds_count 3" in text
+    # deterministic: two renders over unchanged instruments are identical
+    assert metrics.render_prometheus() == text
+
+
+def test_registry_rejects_kind_conflict_and_custom_collector():
+    metrics.Counter("pt_unittest_conflict", "first")
+    with pytest.raises(ValueError):
+        metrics.Gauge("pt_unittest_conflict", "second")
+    metrics.register_collector(
+        "unittest", lambda: [("pt_unittest_pull", "gauge", "pulled", {}, 7)])
+    try:
+        assert "pt_unittest_pull 7" in metrics.render_prometheus()
+    finally:
+        metrics.unregister_collector("unittest")
+
+
+def test_gateway_metrics_verb_roundtrips_engine_counters(model):
+    """The wire scrape equals the in-process snapshot byte-for-byte on
+    the engine's counter lines, taken over a quiet engine — the gateway
+    adds transport, never resampling."""
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.inference.serving.gateway import (GatewayClient,
+                                                      ServingGateway)
+    eng = ServingEngine(model, max_batch=2, max_seq_len=64)
+    gw = ServingGateway(eng)
+    try:
+        cli = GatewayClient("127.0.0.1", gw.port)
+        cli.generate(_prompt(5, seed=1), max_new_tokens=6)
+        cli.generate(_prompt(9, seed=2), max_new_tokens=4)
+        wire = cli.metrics()
+        local = metrics.render_prometheus()
+
+        def engine_lines(text):
+            return [ln for ln in text.splitlines()
+                    if ln.startswith("pt_serving_")]
+
+        assert engine_lines(wire) == engine_lines(local)
+        assert any(ln.startswith("pt_serving_tokens_generated")
+                   for ln in engine_lines(wire))
+        # the scrape itself is visible in the gateway funnel
+        assert gw.info()["metrics_scrapes"] == 1
+        cli.close()
+    finally:
+        gw.stop(drain=True, timeout=10.0)
+
+
+def test_gateway_metrics_scrape_while_draining_is_typed_503(model):
+    """Drain-awareness: a scraper hitting a draining gateway gets the
+    typed GatewayDraining (503 frame), never a healthy-looking sample."""
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.inference.serving.gateway import (GatewayClient,
+                                                      GatewayDraining,
+                                                      ServingGateway)
+    eng = ServingEngine(model, max_batch=2, max_seq_len=64)
+    gw = ServingGateway(eng)
+    cli = None
+    try:
+        cli = GatewayClient("127.0.0.1", gw.port)
+        assert "pt_gateway_requests" in cli.metrics()  # live scrape works
+        # park one slow request so drain() has something in flight, then
+        # drain in the background and scrape on the EXISTING connection
+        req = eng.submit(_prompt(4, seed=5), max_new_tokens=48)
+        stopper = threading.Thread(target=gw.stop,
+                                   kwargs={"drain": True, "timeout": 15.0},
+                                   daemon=True)
+        stopper.start()
+        deadline = time.monotonic() + 5.0
+        while not gw.info()["draining"] and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert gw.info()["draining"]
+        with pytest.raises(GatewayDraining):
+            cli.metrics()
+        req.wait(timeout=10.0)
+        stopper.join(timeout=15.0)
+        assert not stopper.is_alive()
+    finally:
+        if cli is not None:
+            cli.close()
+        gw.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# profiler empty-state sweep (fresh process, subsystems never imported)
+# ---------------------------------------------------------------------------
+
+SUMMARIES = ("op_cache_summary", "step_capture_summary", "lint_summary",
+             "serving_summary", "gateway_summary", "comm_summary",
+             "reshard_summary", "supervisor_summary", "trace_summary")
+
+_SWEEP = """
+import sys
+import paddle_tpu.profiler as prof
+for name in {names!r}:
+    out = getattr(prof, name)()
+    assert isinstance(out, str) and out, name
+    print(name, "::", out.splitlines()[0])
+# rendering a summary must never import its subsystem
+assert "paddle_tpu.inference.serving" not in sys.modules
+assert "paddle_tpu.inference.serving.gateway" not in sys.modules
+"""
+
+
+def test_every_summary_renders_in_fresh_process():
+    """All nine profiler summaries render in a process that never
+    exercised their subsystems — empty-state guards + the one shared
+    no-data idiom, and the render itself imports nothing heavy."""
+    r = subprocess.run(
+        [sys.executable, "-c", _SWEEP.format(names=SUMMARIES)],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = dict(ln.split(" :: ", 1) for ln in r.stdout.splitlines())
+    assert set(lines) == set(SUMMARIES)
+    # the unloaded subsystems all use the ONE shared idiom
+    assert lines["serving_summary"] == "serving: no data (subsystem not loaded)"
+    assert lines["gateway_summary"] == "gateway: no data (subsystem not loaded)"

@@ -572,3 +572,100 @@ def test_vision_decode_ops_known_answers():
     np.testing.assert_allclose(_np(bx).reshape(4), [0., 0., 31., 31.],
                                rtol=1e-6)
     np.testing.assert_allclose(_np(score).reshape(1), 0.25, rtol=1e-6)
+
+
+# ---------------- sparse namespaced family (paddle_tpu.sparse) ----------------
+# These burn the `sparse_*` orphan block: each op is exercised through the
+# public module surface (`import paddle_tpu.sparse as Z` — the
+# module-qualified battery route) against a dense NumPy reference. The
+# value-wise unary family is swept from one cases table whose keys ARE the
+# namespaced op names, so the governance claim is explicit per op.
+
+def _coo(dense):
+    import paddle_tpu.sparse as sparse
+    return sparse.to_sparse_coo(P.to_tensor(np.asarray(dense, np.float32)))
+
+
+def test_sparse_elementwise_known_answers():
+    import paddle_tpu.sparse as sparse
+    a = np.array([[0.0, 1.0], [2.0, 0.0]], np.float32)
+    b = np.array([[0.0, 3.0], [4.0, 0.0]], np.float32)
+    np.testing.assert_allclose(
+        _np(sparse.subtract(_coo(a), _coo(b)).to_dense()), a - b, rtol=1e-6)
+    full = np.array([[1.0, 3.0], [4.0, 2.0]], np.float32)
+    np.testing.assert_allclose(
+        _np(sparse.divide(_coo(a), P.to_tensor(full)).to_dense()),
+        a / full, rtol=1e-6)
+    # masked matmul: dense product sampled at the mask's sparsity pattern
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    y = np.array([[5.0, 6.0], [7.0, 8.0]], np.float32)
+    mask = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    np.testing.assert_allclose(
+        _np(sparse.masked_matmul(P.to_tensor(x), P.to_tensor(y),
+                                 _coo(mask)).to_dense()),
+        (x @ y) * (mask != 0), rtol=1e-6)
+    # addmm: beta*input + alpha*(x @ y) on the input's pattern
+    inp = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    out = sparse.addmm(_coo(inp), P.to_tensor(x), P.to_tensor(y),
+                       beta=2.0, alpha=1.0)
+    np.testing.assert_allclose(_np(out.to_dense()),
+                               2.0 * inp + x @ y, rtol=1e-6)
+
+
+def test_sparse_unary_family_known_answers():
+    import paddle_tpu.sparse as sparse
+    # values inside every member's domain (atanh/asin need |v| < 1);
+    # zeros stay zero for each member, so the dense reference is exact
+    dense = np.array([[0.0, 0.5], [-0.25, 0.0]], np.float32)
+    cases = {
+        "sparse_sin": (sparse.sin, np.sin),
+        "sparse_tan": (sparse.tan, np.tan),
+        "sparse_asin": (sparse.asin, np.arcsin),
+        "sparse_atan": (sparse.atan, np.arctan),
+        "sparse_sinh": (sparse.sinh, np.sinh),
+        "sparse_tanh": (sparse.tanh, np.tanh),
+        "sparse_asinh": (sparse.asinh, np.arcsinh),
+        "sparse_atanh": (sparse.atanh, np.arctanh),
+        "sparse_square": (sparse.square, np.square),
+        "sparse_log1p": (sparse.log1p, np.log1p),
+        "sparse_abs": (sparse.abs, np.abs),
+        "sparse_neg": (sparse.neg, np.negative),
+        "sparse_expm1": (sparse.expm1, np.expm1),
+        "sparse_deg2rad": (sparse.deg2rad, np.deg2rad),
+        "sparse_rad2deg": (sparse.rad2deg, np.rad2deg),
+    }
+    for name, (op, ref) in cases.items():
+        got = _np(op(_coo(dense)).to_dense())
+        np.testing.assert_allclose(got, ref(dense), rtol=1e-5, atol=1e-7,
+                                   err_msg=name)
+    # sqrt over a non-negative pattern (domain)
+    nn = np.array([[0.0, 4.0], [9.0, 0.0]], np.float32)
+    np.testing.assert_allclose(_np(sparse.sqrt(_coo(nn)).to_dense()),
+                               np.sqrt(nn), rtol=1e-6)
+
+
+def test_sparse_shape_and_reduction_known_answers():
+    import paddle_tpu.sparse as sparse
+    dense = np.array([[0.0, 1.0, 0.0], [2.0, 0.0, 3.0]], np.float32)
+    t = _coo(dense)
+    np.testing.assert_allclose(_np(sparse.pow(t, 2).to_dense()),
+                               dense ** 2, rtol=1e-6)
+    assert float(_np(sparse.sum(t))) == dense.sum()
+    np.testing.assert_array_equal(_np(sparse.isnan(t).to_dense()),
+                                  np.isnan(dense))
+    np.testing.assert_array_equal(
+        _np(sparse.transpose(t, [1, 0]).to_dense()), dense.T)
+    np.testing.assert_array_equal(
+        _np(sparse.reshape(t, [3, 2]).to_dense()), dense.reshape(3, 2))
+    np.testing.assert_array_equal(
+        _np(sparse.slice(t, axes=[1], starts=[0], ends=[2]).to_dense()),
+        dense[:, :2])
+    c = sparse.cast(t, value_dtype="float64")
+    np.testing.assert_allclose(_np(c.to_dense()).astype(np.float64),
+                               dense.astype(np.float64))
+    # nn statics: masked softmax rows renormalize over the stored values
+    s = _np(sparse.nn.softmax(t, axis=-1).to_dense())
+    row1 = np.exp([1.0]) / np.exp([1.0]).sum()
+    row2 = np.exp([2.0, 3.0]) / np.exp([2.0, 3.0]).sum()
+    np.testing.assert_allclose(s[0, 1], row1[0], rtol=1e-6)
+    np.testing.assert_allclose(s[1, [0, 2]], row2, rtol=1e-6)

@@ -46,7 +46,7 @@ def test_all_rules_fire_on_fixtures(fixture_findings):
                      "registry-consistency", "mutable-global",
                      "dead-export", "key-reuse", "closure-capture",
                      "unbounded-blocking", "dtype-rule-coverage",
-                     "naked-collective"}, rules
+                     "naked-collective", "chaos-site-coverage"}, rules
     assert len(rules) >= 5  # the acceptance floor, trivially exceeded
 
 
@@ -66,11 +66,31 @@ def test_findings_carry_location_and_severity(fixture_findings):
 
 def test_registry_cross_check_both_directions(fixture_findings):
     rc = [f for f in fixture_findings if f.rule == "registry-consistency"]
-    assert {f.context for f in rc} == {"fixture_orphan_op", "stale_op"}
+    assert {f.context for f in rc} == {"fixture_orphan_op", "stale_op",
+                                       "subpkg_orphanbar"}
     orphan = next(f for f in rc if f.context == "fixture_orphan_op")
     assert orphan.path == "paddle_tpu/ops/hazards.py"  # at the dispatch site
     stale = next(f for f in rc if f.context == "stale_op")
     assert stale.path == "tests/op_tolerances.py"      # at the registry
+
+
+def test_registry_namespaced_family_governed(fixture_findings):
+    """Route 3b known answers (paddle_tpu/subpkg): an op whose name
+    qualifies the public name with the module tail is governed by a
+    battery reaching that module (`import paddle_tpu.subpkg as NS` +
+    `NS.govfoo` -> `subpkg_govfoo`; the public-class-method shape
+    `NS.grouped.govmethod` -> `subpkg_govmethod`), while the same
+    module's unreferenced public op stays an orphan — the route needs a
+    REAL reference through the right module, not a name coincidence."""
+    rc = {f.context for f in fixture_findings
+          if f.rule == "registry-consistency"}
+    assert not rc & {"subpkg_govfoo", "subpkg_govmethod"}, rc
+    assert "subpkg_orphanbar" in rc
+    # and the fixture module itself trips no other rule
+    others = [f for f in fixture_findings
+              if f.path.endswith("subpkg/__init__.py")
+              and f.rule != "registry-consistency"]
+    assert others == [], others
 
 
 def test_registry_dynamic_self_attr_op_names_resolved(fixture_findings):
@@ -204,6 +224,30 @@ def test_unbounded_blocking_known_answers(fixture_findings):
               if f.path.endswith("blocking_hazards.py")
               and f.rule != "unbounded-blocking"]
     assert others == [], others
+
+
+def test_chaos_site_coverage_known_answers(fixture_findings):
+    """fault_sites.py: only the registered-but-unmatrixed site fires; the
+    matrix-covered site and the pragma'd deliberate waiver stay quiet, and
+    the finding anchors at the registration (context = the site name, so
+    the baseline key survives edits above it)."""
+    cc = [f for f in fixture_findings if f.rule == "chaos-site-coverage"]
+    assert {f.context for f in cc} == {"fixture.uncovered"}, cc
+    assert all(f.path == "paddle_tpu/distributed/fault_sites.py"
+               for f in cc), cc
+    # and no OTHER rule trips over the fault-site fixture
+    others = [f for f in fixture_findings
+              if f.path.endswith("fault_sites.py")
+              and f.rule != "chaos-site-coverage"]
+    assert others == [], others
+
+
+def test_chaos_site_coverage_clean_on_repo(repo_findings):
+    """Every register_fault site in the real tree is in the real no-hang
+    MATRIX — the rule holds at ZERO baselined entries (a new fault site
+    must land together with its crash/delay/error/drop rows)."""
+    assert [f for f in repo_findings
+            if f.rule == "chaos-site-coverage"] == []
 
 
 # ---------------- pragma suppression ----------------

@@ -1,5 +1,6 @@
 """Checker registry — importing this package registers every checker."""
 from . import (  # noqa: F401
+    chaos_site_coverage,
     closure_capture,
     dead_export,
     dtype_rule_coverage,

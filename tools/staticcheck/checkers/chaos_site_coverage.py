@@ -1,0 +1,92 @@
+"""chaos-site-coverage: every fault site must be in the no-hang matrix.
+
+A ``register_fault("site", ...)`` declaration is a claim: this blocking
+window can fail, and the no-hang guarantee covers it. The claim is only
+proven by the fault matrix (tests/test_no_hang.py ``MATRIX``), which arms
+each site with crash/delay/error/drop and asserts the typed-or-absorbed
+outcome end to end. A site registered in code but absent from the matrix
+is an UNPROVEN no-hang claim — exactly the gap this rule closes: the
+matrix's own runtime assertion (``MATRIX keys == chaos.fault_sites()``)
+only fires when the matrix test RUNS, while this rule fails ``--ci`` the
+moment the uncovered site lands.
+
+Flags ``register_fault("<literal>", ...)`` calls (and their import-alias
+spellings) under ``paddle_tpu/`` whose site string never appears as the
+site element of a ``MATRIX`` key in ``tests/test_no_hang.py``. Trees
+without a matrix file (fixture projects that don't exercise this rule)
+are skipped. Zero entries are baselined; a new site must land together
+with its matrix rows.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..astutil import call_name
+from ..core import Checker, Module, Project, parse_file_cached, register
+
+MATRIX_PATH = os.path.join("tests", "test_no_hang.py")
+_REGISTER_NAMES = {"register_fault", "_register_fault"}
+
+
+def _matrix_sites(root: str) -> set[str] | None:
+    """Site elements of the MATRIX keys, or None when the tree has no
+    matrix file / no MATRIX dict (nothing to cross-check)."""
+    path = os.path.join(root, MATRIX_PATH)
+    if not os.path.exists(path):
+        return None
+    try:
+        tree = parse_file_cached(root, path).tree
+    except (SyntaxError, OSError):
+        return None
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "MATRIX"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        sites: set[str] = set()
+        for key in node.value.keys:
+            if isinstance(key, ast.Tuple) and key.elts \
+                    and isinstance(key.elts[0], ast.Constant) \
+                    and isinstance(key.elts[0].value, str):
+                sites.add(key.elts[0].value)
+        return sites
+    return None
+
+
+@register
+class ChaosSiteCoverageChecker(Checker):
+    rule = "chaos-site-coverage"
+    severity = "warning"
+
+    def __init__(self):
+        # site -> first (module, node) registration seen
+        self._sites: dict[str, tuple[Module, ast.AST]] = {}
+
+    def check_module(self, mod: Module):
+        if not mod.path.startswith("paddle_tpu/"):
+            return ()
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) in _REGISTER_NAMES
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            self._sites.setdefault(node.args[0].value, (mod, node))
+        return ()
+
+    def finalize(self, project: Project):
+        covered = _matrix_sites(project.root)
+        if covered is None:
+            return
+        for site in sorted(set(self._sites) - covered):
+            mod, node = self._sites[site]
+            yield mod.finding(
+                self.rule, self.severity, node,
+                f"fault site {site!r} is registered here but absent from "
+                f"the no-hang matrix ({MATRIX_PATH} MATRIX) — an unproven "
+                f"no-hang claim; add its crash/delay/error/drop rows (the "
+                f"matrix asserts the typed-or-absorbed outcome end to end)",
+                context=site)

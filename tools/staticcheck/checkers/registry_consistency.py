@@ -40,6 +40,15 @@ known-answer fixture):
    name somewhere under tests/ (attribute/name reference or a cases-table
    string key) is governed by that battery. Ops reachable only through
    private indirection, or exercised by no battery, stay orphans.
+   NAMESPACED families (PR 15): an op name of the form
+   ``<module-tail>_<public-name>`` (`sparse_sin` dispatched by the public
+   `paddle_tpu.sparse.sin`, including public methods of a public
+   module-level class like `sparse.nn.relu`) is governed when a battery
+   reaches that exact module — `import paddle_tpu.sparse as S; S.sin(...)`
+   resolves to BOTH `sin` and `sparse_sin`, and the public surface gains
+   the module-qualified spelling symmetrically. A same-named public op in
+   an unrelated module never governs the namespaced one: the qualified
+   name only exists on the refs side through an import of that module.
 """
 from __future__ import annotations
 
@@ -336,15 +345,32 @@ def _load_family_skip_names(root: str) -> set[str]:
 _TEST_SCAN_EXCLUDE = {"__pycache__", "fixtures", "staticcheck_proj"}
 
 
+def _module_tails(path: str) -> tuple[str, ...]:
+    """Module-tail prefixes of one source path below the package root:
+    ``paddle_tpu/sparse/__init__.py`` -> ``("sparse",)``. The public-
+    surface side of the namespaced-family route — symmetrical with the
+    alias prefixes _module_pkg_aliases derives on the refs side."""
+    parts = path[:-3].split("/") if path.endswith(".py") else path.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return _tail_prefixes(parts[1:])
+
+
 def _public_surface(project: Project) -> set[str]:
     """Names the scanned package exports: module-level `__all__` entries
     (literal assigns, `+=`, `.extend(...)`, `.append(...)` — including
     appends loop-bound over constant name collections) plus public
-    module-level defs and alias assignments (`acos = _unop("acos", ...)`)."""
+    module-level defs and alias assignments (`acos = _unop("acos", ...)`).
+    Each def/alias also contributes its module-qualified spelling
+    (`sparse_sin` for `paddle_tpu.sparse.sin`), and a public module-level
+    class contributes qualified spellings of its public methods
+    (`sparse_relu` for `paddle_tpu.sparse.nn.relu`) — the namespaced-op
+    families whose op names prefix the public name with the module tail."""
     out: set[str] = set()
     for mod in project.modules:
         if not mod.path.startswith("paddle_tpu"):
             continue
+        tails = _module_tails(mod.path)
         seqs: dict[str, list[str]] = {}
         for node in mod.tree.body:
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
@@ -352,10 +378,28 @@ def _public_surface(project: Project) -> set[str]:
                 vals = _const_str_seq(node.value, {})
                 if vals:
                     seqs[node.targets[0].id] = vals
+
+        def _public(name: str) -> None:
+            out.add(name)
+            for pref in tails:
+                out.add(f"{pref}_{name}")
+
         for node in mod.tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and not node.name.startswith("_"):
-                out.add(node.name)
+                _public(node.name)
+            elif isinstance(node, ast.ClassDef) \
+                    and not node.name.startswith("_"):
+                # qualified spellings ONLY: a public method name is not a
+                # bare public-op surface (that would let any `log_prob`
+                # reference govern every class's log_prob), but its
+                # module-qualified form is unambiguous
+                for sub in node.body:
+                    if isinstance(sub,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                            and not sub.name.startswith("_"):
+                        for pref in tails:
+                            out.add(f"{pref}_{sub.name}")
             elif isinstance(node, ast.Assign) and isinstance(
                     node.value, (ast.Call, ast.Name, ast.Attribute)):
                 # alias registrations only (`acos = _unop("acos", ...)`,
@@ -364,7 +408,7 @@ def _public_surface(project: Project) -> set[str]:
                 for t in node.targets:
                     if isinstance(t, ast.Name) and not t.id.startswith("_") \
                             and t.id != "__all__":
-                        out.add(t.id)
+                        _public(t.id)
         for node in ast.walk(mod.tree):
             lit = None
             if isinstance(node, ast.Assign) \
@@ -412,25 +456,47 @@ def _public_surface(project: Project) -> set[str]:
 _PKG = "paddle_tpu"
 
 
-def _module_pkg_aliases(tree: ast.Module) -> tuple[set[str], set[str]]:
-    """-> (attr_bases, bare_names) the module binds to the package:
-    `import paddle_tpu as P` / `import paddle_tpu.nn.functional as F`
-    give attribute bases; `from paddle_tpu.x import name [as n]` gives
-    bare names (the imported name is itself a package reference)."""
+def _tail_prefixes(parts: list[str]) -> tuple[str, ...]:
+    """Underscore-joined suffixes of a module path below the package root:
+    ``["nn", "functional"]`` -> ``("nn_functional", "functional")`` — the
+    spellings a namespaced op name may be qualified with."""
+    return tuple("_".join(parts[i:]) for i in range(len(parts)))
+
+
+def _module_pkg_aliases(
+        tree: ast.Module) -> tuple[set[str], set[str], dict]:
+    """-> (attr_bases, bare_names, prefixes) the module binds to the
+    package: `import paddle_tpu as P` / `import paddle_tpu.nn.functional
+    as F` give attribute bases; `from paddle_tpu.x import name [as n]`
+    gives bare names (the imported name is itself a package reference).
+    `prefixes` maps each attribute base to the module-tail spellings its
+    attributes may be namespaced under (`import paddle_tpu.sparse as S`
+    -> S: ("sparse",), so `S.sin` also references `sparse_sin`)."""
     bases: set[str] = set()
     bare: set[str] = set()
+    prefixes: dict[str, tuple[str, ...]] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == _PKG or a.name.startswith(_PKG + "."):
-                    bases.add(a.asname or a.name.split(".", 1)[0])
+                    alias = a.asname or a.name.split(".", 1)[0]
+                    bases.add(alias)
+                    # namespaced prefixes ONLY for an asname that names
+                    # the submodule: a bare `import paddle_tpu.sparse`
+                    # binds the ROOT package, and attaching the sparse
+                    # prefix to it would let any `paddle_tpu.X` falsely
+                    # govern `sparse_X`
+                    if a.asname is not None:
+                        tails = _tail_prefixes(a.name.split(".")[1:])
+                        if tails:
+                            prefixes[alias] = tails
         elif isinstance(node, ast.ImportFrom) and node.module \
                 and (node.module == _PKG
                      or node.module.startswith(_PKG + ".")):
             for a in node.names:
                 bare.add(a.asname or a.name)
                 bare.add(a.name)
-    return bases, bare
+    return bases, bare, prefixes
 
 
 def _battery_references(root: str) -> set[str]:
@@ -458,7 +524,7 @@ def _battery_references(root: str) -> set[str]:
                 mod = parse_file_cached(root, os.path.join(dirpath, fn))
             except (SyntaxError, OSError):
                 continue
-            bases, bare = _module_pkg_aliases(mod.tree)
+            bases, bare, prefixes = _module_pkg_aliases(mod.tree)
             refs |= bare
 
             def pkg_ref_in(node) -> bool:
@@ -472,8 +538,14 @@ def _battery_references(root: str) -> set[str]:
 
             for node in ast.walk(mod.tree):
                 if isinstance(node, ast.Attribute):
-                    if attr_root(node) in bases:
+                    base = attr_root(node)
+                    if base in bases:
                         refs.add(node.attr)
+                        # module-qualified spelling: S.sin through
+                        # `import paddle_tpu.sparse as S` also exercises
+                        # the namespaced op name `sparse_sin`
+                        for pref in prefixes.get(base, ()):
+                            refs.add(f"{pref}_{node.attr}")
                 elif isinstance(node, ast.Dict):
                     # cases-table keys count only when the table's VALUES
                     # reach the package (`"acos": Case(P.acos, ...)`) —
