@@ -14,6 +14,7 @@ from ..core.device import (  # noqa: F401
     current_jax_device, device_count, get_device, is_compiled_with_tpu,
     set_device,
 )
+from ..utils.memo import LockedLRU
 
 __all__ = [
     "get_device", "set_device", "device_count", "is_compiled_with_tpu",
@@ -135,14 +136,13 @@ class Event:
             self._stream.synchronize()
 
 
-_current = None
+# one-slot audited registry ("current" -> Stream): lazily created by
+# current_stream, pushed/popped by stream_guard (memo idiom)
+_stream_state = LockedLRU(maxsize=None)
 
 
 def current_stream(device=None):
-    global _current
-    if _current is None:
-        _current = Stream(device)
-    return _current
+    return _stream_state.get_or_create("current", lambda: Stream(device))
 
 
 class stream_guard:
@@ -150,14 +150,15 @@ class stream_guard:
         self.stream = stream
 
     def __enter__(self):
-        global _current
-        self._prev = _current
-        _current = self.stream
+        self._prev = _stream_state.get("current")
+        _stream_state.put("current", self.stream)
         return self.stream
 
     def __exit__(self, *exc):
-        global _current
-        _current = self._prev
+        if self._prev is None:
+            _stream_state.pop("current")
+        else:
+            _stream_state.put("current", self._prev)
         return False
 
 

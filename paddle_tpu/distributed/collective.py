@@ -23,6 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..core.tensor import Tensor
 from ..ops.dispatch import apply
 from ..parallel import mesh as mesh_mod
+from ..utils.memo import LockedLRU
 
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
@@ -78,13 +79,13 @@ class Group:
         return f"Group(axis={self.axis}, nranks={self.nranks})"
 
 
-_groups = {}
+# gid -> Group; audited registry (memo.LockedLRU, unbounded) instead of a
+# bare module dict so concurrent new_group/destroy stay race-free
+_groups = LockedLRU(maxsize=None)
 
 
 def _default_group() -> Group:
-    if 0 not in _groups:
-        _groups[0] = Group(None, gid=0)
-    return _groups[0]
+    return _groups.get_or_create(0, lambda: Group(None, gid=0))
 
 
 def new_group(ranks=None, backend=None, timeout=None, axis: Optional[str] = None) -> Group:
@@ -94,7 +95,7 @@ def new_group(ranks=None, backend=None, timeout=None, axis: Optional[str] = None
     if axis is None and ranks is not None:
         axis = _axis_from_ranks(list(ranks))
     g = Group(axis, ranks=ranks)
-    _groups[g.id] = g
+    _groups.put(g.id, g)
     return g
 
 

@@ -48,6 +48,25 @@ Env knobs (all read at engine construction):
 - ``PT_SERVE_PREFIX_SHARE`` (default 0 = off) radix-tree prefix sharing
   over committed KV pages: a request walks the tree, takes refs on the
   shared chain, and prefills only its O(suffix) tail (see prefix.py)
+- ``PT_SERVE_MAX_QUEUE`` (default 8 x max_batch) bounded admission: a
+  submit() past this queue depth is shed with the typed EngineOverloaded
+  (terminal; carries retry_after_ms) instead of queueing unboundedly
+- ``PT_SERVE_SHED_TTL`` (default 0 = off) enables deadline-aware
+  shedding: when the projected queue wait (backlog tokens / measured
+  token rate) exceeds a request's TTL (or this knob's value, for
+  requests without one), submit() sheds it up front — the request would
+  burn its whole deadline queued and time out anyway. Off by default so
+  a TTL'd request queues to its own deadline unless the operator opts in
+
+Overload control (the degradation ladder): under sustained queue pressure
+the engine sheds OPTIONAL work in order — trim the prefix-sharing radix
+tree (level 1), disable speculative decoding and return its verify-scratch
+pages (level 2), shrink the chunked-prefill interleave to one window per
+step (level 3). Levels are entered/exited with hysteresis (the exit
+threshold sits a band below the enter threshold, so a queue oscillating on
+a boundary cannot flap the ladder), every transition is stamped on the
+trace ring, and the level + per-level step occupancy are exported as
+gauges through the gateway's METRICS verb.
 """
 from __future__ import annotations
 
@@ -62,8 +81,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...distributed.chaos import faultpoint, register_fault
 from ...observability import trace
-from ...utils.deadline import env_int
+from ...utils.deadline import EngineOverloaded, env_int, env_timeout
 from .kv_pool import KVPagePool
 from .prefix import PrefixCache
 from .request import Request, RequestState
@@ -71,6 +91,17 @@ from .scheduler import ContinuousBatchingScheduler
 from .speculative import build_drafter
 
 _ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
+
+FP_PRESSURE = register_fault(
+    "engine.pressure", "every engine step's overload-ladder evaluation "
+    "passes here (the admission/degradation control point)")
+
+# degradation-ladder hysteresis bands over queue_depth / max_queue: level
+# L is entered at _LADDER_ENTER[L] and left below _LADDER_EXIT[L] — the
+# gap is what keeps a queue oscillating on one boundary from flapping the
+# ladder (each flap would churn the prefix tree / spec state for nothing)
+_LADDER_ENTER = (0.0, 0.50, 0.75, 0.90)
+_LADDER_EXIT = (0.0, 0.25, 0.50, 0.75)
 
 
 def _write_slot_impl(batch_caches, pref_caches, slot):
@@ -183,7 +214,9 @@ class ServingEngine:
                  spec_k: Optional[int] = None,
                  drafter=None, draft_model=None,
                  prefill_chunk: Optional[int] = None,
-                 prefix_sharing: Optional[bool] = None):
+                 prefix_sharing: Optional[bool] = None,
+                 max_queue: Optional[int] = None,
+                 shed_ttl: Optional[float] = None):
         self.model = model
         cfg = model.config
         self.max_batch = max_batch or env_int("PT_SERVE_MAX_BATCH", 8)
@@ -271,13 +304,29 @@ class ServingEngine:
                 drafter or os.environ.get("PT_SERVE_DRAFTER", "ngram"),
                 self.max_batch, self.max_seq_len, draft_model=draft_model)
 
+        # bounded admission (the overload front door): a queue past
+        # max_queue — or a projected wait past the TTL — sheds at submit
+        self.max_queue = env_int("PT_SERVE_MAX_QUEUE", 8 * self.max_batch) \
+            if max_queue is None else int(max_queue)
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        self.shed_ttl = env_timeout("PT_SERVE_SHED_TTL", 0.0) \
+            if shed_ttl is None else float(shed_ttl)
+        # degradation ladder state (driven by _update_pressure each step)
+        self._pressure = 0
+        self._level_steps = [0, 0, 0, 0]
+        self._spec_paused = False
+        self._prefix_paused = False
+
         self._lock = threading.Lock()   # serializes step()/run()
         self._counters = {"prefills": 0, "decode_steps": 0,
                           "tokens_generated": 0, "rejected": 0,
                           "verify_steps": 0, "draft_tokens_proposed": 0,
                           "draft_tokens_accepted": 0, "sampled_tokens": 0,
                           "prefill_chunks": 0, "chunked_prefills": 0,
-                          "shared_prefix_joins": 0, "prefill_pages_saved": 0}
+                          "shared_prefix_joins": 0, "prefill_pages_saved": 0,
+                          "shed": 0, "pressure_trims": 0, "spec_pauses": 0,
+                          "scratch_pages_returned": 0}
         # tokens-per-verify histogram: index i = verifies that emitted i
         # tokens for a slot (1..k+1)
         self._accept_hist = [0] * (self.spec_k + 2)
@@ -362,7 +411,12 @@ class ServingEngine:
                 f"engine's static layout holds max_seq_len="
                 f"{self.max_seq_len} — shorten the prompt/max_new_tokens "
                 f"or size the engine up")
-        if self.prefix_cache is not None and not req.is_sampling:
+        # bounded admission AFTER the permanent sizing/sampling rejections
+        # (those are bugs, not load) and BEFORE the prefix walk, so a shed
+        # request never takes refs on shared pages it must then give back
+        self._admit(req)
+        if self.prefix_cache is not None and not req.is_sampling \
+                and not self._prefix_paused:
             # walk the radix tree and take refs on the committed chain NOW
             # (the refs ride the request's lifetime; the scheduler reserves
             # only the pages it must own beyond the shared prefix). Sampled
@@ -377,6 +431,150 @@ class ServingEngine:
         return req
 
     # ------------------------------------------------------------------
+    # overload control: bounded admission + the degradation ladder
+    # ------------------------------------------------------------------
+    def _admit(self, req: Request) -> None:
+        """The overload front door, called from submit() for every request
+        that passed the permanent (sizing/sampling) checks. Sheds with the
+        typed EngineOverloaded when (a) the queue is at max_queue — the
+        hard cap that bounds both memory and worst-case queue wait — or
+        (b) the projected queue wait at the measured token rate already
+        exceeds the request's TTL (or PT_SERVE_SHED_TTL for TTL-less
+        requests): queueing it would only burn its whole deadline before
+        a RequestTimeout, so rejecting NOW costs the client nothing and
+        the engine a queue slot."""
+        depth = self.scheduler.queue_depth
+        if depth >= self.max_queue:
+            self._shed(req, depth,
+                       f"queue at max_queue={self.max_queue}")
+        if self.shed_ttl <= 0:
+            return  # deadline-aware shedding is opt-in (knob off)
+        budget = req.deadline.timeout
+        if budget is None:
+            budget = self.shed_ttl
+        if budget is not None and budget > 0:
+            wait = self._projected_wait(req.max_new_tokens)
+            if wait is not None and wait > budget:
+                self._shed(req, depth,
+                           f"projected queue wait {wait:.3g}s exceeds the "
+                           f"{budget:.3g}s deadline budget")
+
+    def _measured_rate(self) -> Optional[float]:
+        """Tokens/sec actually measured over this engine's lifetime (all
+        prefill + decode time), or None on a cold engine — a cold engine
+        never deadline-sheds, because an estimate from nothing would shed
+        the very first burst for no reason."""
+        gen_time = self._decode_time + self._prefill_time
+        toks = self._counters["tokens_generated"]
+        if gen_time <= 0 or toks <= 0:
+            return None
+        return toks / gen_time
+
+    def _projected_wait(self, new_tokens: int) -> Optional[float]:
+        """Seconds until a request submitted NOW would finish: the whole
+        outstanding backlog plus its own tokens, over the measured rate.
+        Deliberately conservative (FIFO drain, no occupancy modeling) —
+        the shed must be cheap, not clairvoyant."""
+        rate = self._measured_rate()
+        if rate is None:
+            return None
+        return (self.scheduler.backlog_tokens() + new_tokens) / rate
+
+    def _retry_after_ms(self) -> int:
+        """Advice for the 429: the time one queue slot should take to
+        drain at the measured rate — backlog over (queue depth + active),
+        clamped to [1ms, 60s]. Cold engines advise a flat 100ms."""
+        rate = self._measured_rate()
+        if rate is None:
+            return 100
+        inflight = self.scheduler.queue_depth + self.scheduler.active
+        per_slot = self.scheduler.backlog_tokens() / max(1, inflight)
+        return max(1, min(60_000, int(1000.0 * per_slot / rate)))
+
+    def _shed(self, req: Request, depth: int, why: str) -> None:
+        with self._lock:
+            self._counters["rejected"] += 1
+            self._counters["shed"] += 1
+        retry_ms = self._retry_after_ms()
+        # stamp the ring BEFORE constructing the error: EngineOverloaded's
+        # construction fires the flight-recorder incident hook, and the
+        # snapshot it takes must already contain this shed event
+        trace.event("engine.shed", rid=req.rid, level=self._pressure,
+                    queued=depth, retry_after_ms=retry_ms, reason=why)
+        raise EngineOverloaded(
+            f"serving request {req.rid}", req.deadline.timeout,
+            detail=f"{why}; retry after {retry_ms}ms",
+            retry_after_ms=retry_ms)
+
+    def _update_pressure(self) -> None:
+        """Walk the degradation ladder (called under self._lock at the top
+        of every step). Pressure = queue depth over max_queue; levels are
+        entered at _LADDER_ENTER and left below _LADDER_EXIT (hysteresis),
+        each transition stamped on the trace ring."""
+        faultpoint(FP_PRESSURE)
+        ratio = self.scheduler.queue_depth / float(self.max_queue)
+        level = self._pressure
+        new = level
+        while new < 3 and ratio >= _LADDER_ENTER[new + 1]:
+            new += 1
+        while new > 0 and ratio < _LADDER_EXIT[new]:
+            new -= 1
+        if new != level:
+            trace.event("engine.pressure", level=new, prev=level,
+                        queued=self.scheduler.queue_depth,
+                        ratio=round(ratio, 4))
+            if new > level:
+                self._enter_pressure(level, new)
+            else:
+                self._exit_pressure(level, new)
+            self._pressure = new
+        self._level_steps[self._pressure] += 1
+
+    def _enter_pressure(self, old: int, new: int) -> None:
+        if new >= 1 and not self._prefix_paused:
+            # level 1: trim the prefix-sharing radix tree — cached-prefix
+            # pages are a latency optimization, and under pressure their
+            # capacity serves admission instead
+            self._prefix_paused = True
+            if self.prefix_cache is not None:
+                self._counters["pressure_trims"] += 1
+                self.prefix_cache.evict(self.pool.total_pages)
+        if new >= 2 and not self._spec_paused and self.spec_k:
+            # level 2: disable speculative decoding and hand back every
+            # reservation's verify-scratch pages — spec is a throughput
+            # optimization whose scratch capacity now admits real requests
+            self._spec_paused = True
+            self._counters["spec_pauses"] += 1
+            freed = self.scheduler.shed_reserve_extra()
+            self._counters["scratch_pages_returned"] += freed
+        # level 3 carries no state: _advance_prefills reads the level and
+        # shrinks the chunked-prefill interleave to one window per step
+
+    def _exit_pressure(self, old: int, new: int) -> None:
+        if new < 2 and self._spec_paused:
+            self._spec_paused = False
+            self.scheduler.restore_reserve_extra(self.spec_k)
+        if new < 1 and self._prefix_paused:
+            self._prefix_paused = False
+
+    def _spec_ok(self) -> bool:
+        """Speculative decode runs only when every DECODING slot still
+        owns its verify scratch: a request admitted while level 2 shed
+        the reserve has no capacity for the k-token verify window, so the
+        whole batch decodes classically until those requests drain."""
+        if not self.spec_k or self._spec_paused:
+            return False
+        return all(r.scratch_reserved
+                   for r in self.scheduler.running().values()
+                   if r.state is RequestState.DECODING)
+
+    @property
+    def pressure_level(self) -> int:
+        """Current degradation-ladder level, 0 (healthy) .. 3 (shedding
+        everything optional). Read by the gateway's HEALTH verb."""
+        return self._pressure
+
+    # ------------------------------------------------------------------
     # the serving loop
     # ------------------------------------------------------------------
     def step(self) -> int:
@@ -384,6 +582,7 @@ class ServingEngine:
         prefill the joiners -> ONE batched decode step for every active
         slot. Returns the number of tokens produced."""
         with self._lock:
+            self._update_pressure()
             joined, evicted = self.scheduler.schedule()
             for req in evicted:
                 # a TTL eviction mid-chunked-prefill drops its scratch
@@ -403,7 +602,7 @@ class ServingEngine:
             # batch below runs every step regardless, so a mega-prompt's
             # prefill cost is amortized one bounded chunk at a time
             produced += self._advance_prefills()
-            produced += self._decode_speculative() if self.spec_k \
+            produced += self._decode_speculative() if self._spec_ok() \
                 else self._decode()
             return produced
 
@@ -466,7 +665,7 @@ class ServingEngine:
         classic single-shot bucketed prefill."""
         plen = int(req.prompt.size)
         if self.prefix_cache is not None and not req.is_sampling \
-                and req.shared_len == 0:
+                and req.shared_len == 0 and not self._prefix_paused:
             # second walk at JOIN time: a request submitted alongside its
             # donor missed the tree at submit (the donor had not committed
             # yet) — by the join pass it has. The refs replace an equal
@@ -506,9 +705,17 @@ class ServingEngine:
 
     def _advance_prefills(self) -> int:
         produced = 0
+        advanced = 0
         for _, req in sorted(self.scheduler.running().items()):
             if req.state is RequestState.PREFILL and req.scratch is not None:
                 produced += self._advance_one(req)
+                advanced += 1
+                if self._pressure >= 3 and advanced >= 1:
+                    # ladder level 3: shrink the chunked-prefill interleave
+                    # to ONE window per engine step — decode throughput for
+                    # the already-admitted batch outranks prefill progress
+                    # when the queue is near collapse
+                    break
         return produced
 
     def _advance_one(self, req: Request) -> int:
@@ -573,6 +780,8 @@ class ServingEngine:
         committed (share()-able from here on — the pool-level guard that
         an in-flight prefill's pages never enter the tree) and insert the
         chunks into the radix tree, which takes its own refs."""
+        if self._prefix_paused:
+            return  # ladder level 1+: don't regrow the tree we just shed
         ps = self.pool.page_size
         n_full = int(req.prompt.size) // ps
         base = req.shared_len // ps
@@ -814,6 +1023,18 @@ class ServingEngine:
             "prefill_pages_saved": c["prefill_pages_saved"],
             "pool": self.pool.info(),
             "step": step_info,
+            "pressure": {
+                "level": self._pressure,
+                "max_queue": self.max_queue,
+                "shed": c["shed"],
+                "pressure_trims": c["pressure_trims"],
+                "spec_pauses": c["spec_pauses"],
+                "scratch_pages_returned": c["scratch_pages_returned"],
+                "spec_paused": int(self._spec_paused),
+                "prefix_paused": int(self._prefix_paused),
+                **{f"level{i}_steps": n
+                   for i, n in enumerate(self._level_steps)},
+            },
         }
         if self.prefix_cache is not None:
             out["prefix"] = self.prefix_cache.info()

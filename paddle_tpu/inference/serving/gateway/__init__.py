@@ -5,11 +5,17 @@ line protocol (`protocol`), the threaded gateway server with graceful
 drain and per-connection read deadlines (`server`), and the typed client
 (`client`). Requests arrive with TTLs that map straight onto the engine's
 per-request `Deadline` — the typed `RequestTimeout` travels the wire as a
-408 frame and re-raises client-side. See README "Serving gateway".
+408 frame and re-raises client-side. Overload sheds (`EngineOverloaded`)
+travel as 429 frames with `retry-after-ms`; the client backs off, trips a
+circuit breaker (`CircuitOpen`) on consecutive typed failures, and load
+balancers poll the drain-aware HEALTH verb. See README "Serving gateway"
+and "Overload control & graceful degradation".
 """
-from .client import GatewayClient, GatewayConnectionError  # noqa: F401
+from .client import (CircuitOpen, GatewayClient,  # noqa: F401
+                     GatewayConnectionError)
 from .protocol import GatewayDraining, ProtocolError  # noqa: F401
 from .server import ServingGateway, gateway_info  # noqa: F401
 
-__all__ = ["GatewayClient", "GatewayConnectionError", "GatewayDraining",
-           "ProtocolError", "ServingGateway", "gateway_info"]
+__all__ = ["CircuitOpen", "GatewayClient", "GatewayConnectionError",
+           "GatewayDraining", "ProtocolError", "ServingGateway",
+           "gateway_info"]

@@ -17,17 +17,33 @@ PING handshake, and carries every exchange under ONE `Deadline`:
 - error frames re-raise the engine's typed exception class
   (`RequestTimeout`, `PoolExhausted`, `SamplingUnsupported`, ...): the
   socket is invisible in the caller's except clauses.
+
+Self-protection (the client half of the overload story):
+
+- a 429 `EngineOverloaded` frame carries the engine's ``retry-after-ms``
+  advice; `generate` honors it with jittered bounded backoff (at most
+  ``PT_GATEWAY_BREAKER_RETRIES`` re-submissions, never past the
+  request's own deadline) instead of hammering a saturated server;
+- after ``PT_GATEWAY_BREAKER_THRESHOLD`` CONSECUTIVE typed overloads /
+  timeouts the circuit breaker opens: calls fail locally with the typed
+  `CircuitOpen` (no wire traffic) for ``PT_GATEWAY_BREAKER_COOLDOWN``
+  seconds, then ONE half-open probe is let through — success closes the
+  breaker, another typed failure re-opens it for a fresh cooldown;
+- `health()` is breaker-exempt (a load balancer must be able to poll a
+  tripped backend) and never touches the generate path server-side.
 """
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
-from ....utils.deadline import Deadline, RequestTimeout, env_timeout
+from ....utils.deadline import (Deadline, EngineOverloaded, RequestTimeout,
+                                env_int, env_timeout)
 from . import protocol as proto
 
 
@@ -36,8 +52,37 @@ class GatewayConnectionError(ConnectionError):
     and reconnect-plus-retry did not recover it."""
 
 
+class CircuitOpen(RuntimeError):
+    """The client's circuit breaker is open: the last
+    ``PT_GATEWAY_BREAKER_THRESHOLD`` exchanges all failed with typed
+    overloads/timeouts, so calls fail fast LOCALLY (no wire traffic)
+    until the cooldown elapses and a half-open probe succeeds. Carries
+    ``retry_after_ms`` — the cooldown remainder — like the server-side
+    429 it shields."""
+
+    def __init__(self, host: str, port: int, fails: int,
+                 retry_after_ms: int):
+        self.retry_after_ms = int(retry_after_ms)
+        self.fails = int(fails)
+        super().__init__(
+            f"gateway {host}:{port} circuit open after {fails} consecutive "
+            f"typed overload/timeout failures — retry locally rejected for "
+            f"{retry_after_ms}ms (half-open probe follows)")
+
+
 def _typed_error(status: int, name: str, msg: str,
-                 budget: Optional[float]) -> BaseException:
+                 budget: Optional[float],
+                 headers: Optional[Dict[str, str]] = None) -> BaseException:
+    if status == proto.STATUS_EXHAUSTED and name == "EngineOverloaded":
+        # discriminated from PoolExhausted (same 429) by the class name on
+        # the status line; the retry-after-ms header rides into the attr
+        # the backoff below reads
+        try:
+            retry_ms = int((headers or {}).get("retry-after-ms", "") or 0)
+        except ValueError:
+            retry_ms = 0
+        return EngineOverloaded("gateway generate", budget, detail=msg,
+                                retry_after_ms=retry_ms)
     if status == proto.STATUS_TIMEOUT:
         return RequestTimeout(f"gateway request ({name})", budget,
                               detail=msg)
@@ -80,7 +125,46 @@ class GatewayClient:
         self._lock = threading.RLock()
         self._sock: Optional[socket.socket] = None
         self._buf = bytearray()
+        # circuit breaker (see module docstring): consecutive typed
+        # overload/timeout failures trip it; _breaker_open_until != 0
+        # means tripped — before it: fail fast; past it: half-open probe
+        self._breaker_threshold = env_int("PT_GATEWAY_BREAKER_THRESHOLD", 5)
+        self._breaker_cooldown = env_timeout("PT_GATEWAY_BREAKER_COOLDOWN",
+                                             1.0)
+        self._breaker_fails = 0
+        self._breaker_open_until = 0.0
         self._connect(self._connect_timeout)
+
+    # ------------------------------------------------------------------
+    # circuit breaker
+    # ------------------------------------------------------------------
+    def _breaker_gate(self) -> None:
+        """Raise the typed CircuitOpen while tripped and cooling; past the
+        cooldown the call proceeds as the ONE half-open probe (a failure
+        re-trips for a fresh cooldown, a success closes)."""
+        with self._lock:
+            remaining = self._breaker_open_until - time.monotonic()
+            if remaining > 0:
+                raise CircuitOpen(self.host, self.port, self._breaker_fails,
+                                  max(1, int(remaining * 1000)))
+
+    def _breaker_record(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self._breaker_fails = 0
+                self._breaker_open_until = 0.0
+                return
+            self._breaker_fails += 1
+            half_open_probe_failed = self._breaker_open_until != 0.0
+            if half_open_probe_failed \
+                    or self._breaker_fails >= self._breaker_threshold:
+                self._breaker_open_until = \
+                    time.monotonic() + self._breaker_cooldown
+
+    @property
+    def breaker_open(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._breaker_open_until
 
     # ------------------------------------------------------------------
     def _connect(self, timeout: float) -> None:
@@ -180,16 +264,49 @@ class GatewayClient:
                                headers.get("error", head), timeout)
         return body.decode("utf-8")
 
+    def health(self, timeout: float = 5.0) -> dict:
+        """Poll the gateway's drain-aware HEALTH verb: readiness +
+        current overload-ladder pressure, answered from bookkeeping alone
+        (never touches the generate path). Breaker-exempt by design — a
+        load balancer must be able to watch a tripped backend recover."""
+        dl = Deadline(timeout, what=f"gateway health "
+                                    f"{self.host}:{self.port}")
+        head, headers, _ = self._exchange(proto.health_frame(), dl, timeout)
+        parts = head.split(None, 1)
+        status = int(parts[0])
+        if status != proto.STATUS_OK:
+            raise _typed_error(status, parts[1] if len(parts) > 1 else "",
+                               headers.get("error", head), timeout, headers)
+
+        def _i(key):
+            try:
+                return int(headers.get(key, "") or 0)
+            except ValueError:
+                return 0
+
+        return {"ready": headers.get("ready") == "1",
+                "draining": headers.get("draining") == "1",
+                "pressure": _i("pressure"), "queued": _i("queued"),
+                "active": _i("active")}
+
     def generate(self, prompt_ids, max_new_tokens: int = 16,
                  ttl: Optional[float] = None,
                  timeout: Optional[float] = None,
                  temperature: Optional[float] = None,
                  top_p: Optional[float] = None,
                  seed: Optional[int] = None,
-                 eos_token_id: Optional[int] = None) -> np.ndarray:
+                 eos_token_id: Optional[int] = None,
+                 retries: Optional[int] = None) -> np.ndarray:
         """Round-trip one request; returns prompt+generated tokens exactly
         as the in-process `Request.result()` would (bitwise — the gateway
-        adds transport, never math). Raises the engine's typed errors."""
+        adds transport, never math). Raises the engine's typed errors.
+
+        A 429 `EngineOverloaded` answer is retried up to ``retries`` times
+        (default ``PT_GATEWAY_BREAKER_RETRIES``, 2), each wait the frame's
+        ``retry-after-ms`` advice plus up to 25% jitter, never past the
+        request's own deadline; consecutive typed overloads/timeouts feed
+        the circuit breaker, which fails fast with `CircuitOpen` once
+        tripped (``retries=0`` disables the backoff, not the breaker)."""
         if ttl is not None:
             budget = float(ttl) + env_timeout("PT_GATEWAY_TTL_GRACE", 10.0)
         else:
@@ -206,12 +323,39 @@ class GatewayClient:
         # differs per submission — and the orphaned original would keep
         # decoding, so an unseeded duplicate is a correctness bug twice)
         retryable = temperature is None or seed is not None
-        head, headers, body = self._exchange(frame, dl, budget,
-                                             retry=retryable)
-        parts = head.split(None, 1)
-        status = int(parts[0])
-        name = parts[1] if len(parts) > 1 else ""
-        if status != proto.STATUS_OK:
-            raise _typed_error(status, name,
-                               headers.get("error", head), budget)
-        return proto.unpack_tokens(body)
+        max_retries = env_int("PT_GATEWAY_BREAKER_RETRIES", 2) \
+            if retries is None else max(0, int(retries))
+        attempt = 0
+        while True:
+            self._breaker_gate()
+            try:
+                head, headers, body = self._exchange(frame, dl, budget,
+                                                     retry=retryable)
+            except RequestTimeout:
+                # socket-level expiry (wedged/partitioned server): a typed
+                # timeout, so it feeds the breaker like a frame-level 408
+                self._breaker_record(ok=False)
+                raise
+            parts = head.split(None, 1)
+            status = int(parts[0])
+            name = parts[1] if len(parts) > 1 else ""
+            if status == proto.STATUS_OK:
+                self._breaker_record(ok=True)
+                return proto.unpack_tokens(body)
+            err = _typed_error(status, name, headers.get("error", head),
+                               budget, headers)
+            if isinstance(err, (EngineOverloaded, RequestTimeout)):
+                self._breaker_record(ok=False)
+            if isinstance(err, EngineOverloaded) and attempt < max_retries:
+                # the server's advice, jittered so a shed burst of clients
+                # does not resubmit in lockstep; bounded by our own
+                # deadline — waiting past it just converts 429 into 408
+                wait = max(0.001, err.retry_after_ms / 1000.0) \
+                    * (1.0 + 0.25 * random.random())
+                remaining = dl.remaining()
+                if (remaining is None or wait < remaining) \
+                        and not self.breaker_open:
+                    attempt += 1
+                    time.sleep(wait)
+                    continue
+            raise err

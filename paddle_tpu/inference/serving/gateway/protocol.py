@@ -36,7 +36,14 @@ Errors carry the TYPED class name and message instead of a body::
 
 The client re-raises the matching typed error (`RequestTimeout`,
 `PoolExhausted`, `SamplingUnsupported`, ...) so a caller over the socket
-sees exactly the exceptions the in-process engine raises.
+sees exactly the exceptions the in-process engine raises. An overload
+shed (`EngineOverloaded`) answers 429 with a ``retry-after-ms`` header
+carrying the engine's computed backoff advice.
+
+``HEALTH`` answers readiness + overload pressure from bookkeeping alone
+(``ready`` / ``draining`` / ``pressure`` / ``queued`` / ``active``
+headers, no body) — the load-balancer poll never touches the generate
+path, so a saturated engine still answers it instantly.
 
 ``METRICS`` answers the process metrics registry as Prometheus text in a
 ``content-length``-sized UTF-8 body (drain-aware: a draining gateway
@@ -51,7 +58,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ....utils.deadline import Deadline, RequestTimeout, recv_exact
+from ....utils.deadline import (Deadline, EngineOverloaded, RequestTimeout,
+                                recv_exact)
 
 MAGIC = "PTSG/1"
 MAX_LINE = 4096          # a header line longer than this is a protocol error
@@ -211,17 +219,51 @@ def response_frame(tokens, finish_reason: Optional[str]) -> bytes:
     return ("\n".join(lines) + "\n\n").encode("ascii") + pack_tokens(arr)
 
 
-def error_frame(status: int, exc: BaseException) -> bytes:
+def error_frame(status: int, exc: BaseException,
+                extra_headers: Optional[Dict[str, str]] = None) -> bytes:
     name = type(exc).__name__
     msg = str(exc).replace("\n", " ")[:1024]
-    return (f"{MAGIC} {status} {name}\nerror: {msg}\n\n").encode(
-        "ascii", "replace")
+    lines = [f"{MAGIC} {status} {name}", f"error: {msg}"]
+    for key, val in (extra_headers or {}).items():
+        lines.append(f"{key}: {val}")
+    return ("\n".join(lines) + "\n\n").encode("ascii", "replace")
+
+
+def error_headers(exc: BaseException) -> Dict[str, str]:
+    """Typed-error headers that ride beside the status line: an overload
+    shed's 429 carries the engine's computed ``retry-after-ms`` so the
+    client's backoff is advised, not guessed."""
+    if isinstance(exc, EngineOverloaded):
+        return {"retry-after-ms": str(exc.retry_after_ms)}
+    return {}
+
+
+def health_frame() -> bytes:
+    """The HEALTH verb: drain-aware readiness + current overload-ladder
+    pressure, answered entirely from gateway/engine bookkeeping — a load
+    balancer polling it never touches the generate path."""
+    return f"{MAGIC} HEALTH\n\n".encode("ascii")
+
+
+def health_response_frame(ready: bool, draining: bool, pressure: int,
+                          queued: int, active: int) -> bytes:
+    return (f"{MAGIC} {STATUS_OK} OK\n"
+            f"ready: {int(bool(ready))}\n"
+            f"draining: {int(bool(draining))}\n"
+            f"pressure: {int(pressure)}\n"
+            f"queued: {int(queued)}\n"
+            f"active: {int(active)}\n\n").encode("ascii")
 
 
 def status_of(exc: BaseException) -> int:
     """Map an engine-side exception to its wire status."""
-    from ..kv_pool import PoolExhausted
+    from ..kv_pool import PageUncommitted, PoolExhausted
     from ..engine import SamplingUnsupported
+    if isinstance(exc, EngineOverloaded):
+        # checked BEFORE RequestTimeout: both are DeadlineExceeded, but an
+        # overload shed is retryable-later (429 + retry-after-ms) while a
+        # TTL expiry is this request's terminal 408
+        return STATUS_EXHAUSTED
     if isinstance(exc, RequestTimeout):
         return STATUS_TIMEOUT
     if isinstance(exc, GatewayDraining):
@@ -230,6 +272,10 @@ def status_of(exc: BaseException) -> int:
         return STATUS_EXHAUSTED
     if isinstance(exc, SamplingUnsupported):
         return STATUS_BAD_REQUEST
+    if isinstance(exc, PageUncommitted):
+        # refcount-law violation inside the engine — a server bug, not a
+        # client mistake: surfaces as the typed 500
+        return STATUS_INTERNAL
     if isinstance(exc, (ValueError, ProtocolError)):
         return STATUS_TOO_LARGE if "max_seq_len" in str(exc) \
             else STATUS_BAD_REQUEST

@@ -20,11 +20,12 @@ The no-hang law extends to the wire:
   ``PT_GATEWAY_DRAIN_TIMEOUT``, THEN the driver stops — a request the
   gateway accepted is never abandoned mid-decode by its own shutdown.
 
-Chaos: ``gateway.accept`` (every accepted connection passes it) and
-``gateway.read`` (every request read passes it) are registered fault
-sites; the no-hang matrix (tests/test_no_hang.py) arms each with
-crash/delay/error/drop and proves the typed-RequestTimeout / clean-retry
-bound end to end over a real socket.
+Chaos: ``gateway.accept`` (every accepted connection passes it),
+``gateway.read`` (every request read passes it) and ``gateway.admit``
+(every GENERATE passes it before engine.submit — the admission edge) are
+registered fault sites; the no-hang matrix (tests/test_no_hang.py) arms
+each with crash/delay/error/drop and proves the typed-RequestTimeout /
+clean-retry bound end to end over a real socket.
 """
 from __future__ import annotations
 
@@ -44,6 +45,9 @@ FP_ACCEPT = register_fault(
     "gateway.accept", "every accepted gateway connection passes here")
 FP_READ = register_fault(
     "gateway.read", "every gateway request read passes here")
+FP_ADMIT = register_fault(
+    "gateway.admit", "every GENERATE passes here before engine.submit — "
+    "the gateway-side admission edge (drain check + overload shed)")
 
 _GATEWAYS: "weakref.WeakSet[ServingGateway]" = weakref.WeakSet()
 
@@ -193,6 +197,20 @@ class ServingGateway:
                 if head.startswith("PING"):
                     fd.sendall(proto.response_frame([], None))
                     continue
+                if head.startswith("HEALTH"):
+                    # answered from bookkeeping alone — never touches the
+                    # generate path, so the LB poll works at any pressure.
+                    # 200 even while draining: "reachable but not ready"
+                    # is exactly what the ready/draining headers encode
+                    eng = self.engine
+                    self._count_status(proto.STATUS_OK)
+                    fd.sendall(proto.health_response_frame(
+                        ready=not (self._draining or self._stopping),
+                        draining=self._draining or self._stopping,
+                        pressure=getattr(eng, "pressure_level", 0),
+                        queued=eng.scheduler.queue_depth,
+                        active=eng.scheduler.active))
+                    continue
                 if head.startswith("METRICS"):
                     # drain-aware like GENERATE: a draining gateway answers
                     # the typed 503 (a scraper must never sample a half-
@@ -230,10 +248,16 @@ class ServingGateway:
                     # strand a finished request's bytes
                     try:
                         reply = self._serve_one(headers, body)
+                    except ConnectionError:
+                        # an injected drop at the admission edge simulates
+                        # the wire dying mid-exchange: close the conn, the
+                        # client's reconnect-and-retry absorbs it
+                        return
                     except BaseException as e:  # noqa: BLE001 — typed onto the wire
                         status = proto.status_of(e)
                         self._count_status(status)
-                        fd.sendall(proto.error_frame(status, e))
+                        fd.sendall(proto.error_frame(
+                            status, e, proto.error_headers(e)))
                         continue
                     self._count_status(proto.STATUS_OK)
                     with self._lock:
@@ -253,6 +277,10 @@ class ServingGateway:
                 pass
 
     def _serve_one(self, headers, body) -> bytes:
+        # chaos: the admission edge — a fault armed here hits the request
+        # AFTER its frame parsed but BEFORE any engine state exists, the
+        # exact window an overload shed occupies
+        faultpoint(FP_ADMIT)
         if self._draining or self._stopping:
             raise proto.GatewayDraining(
                 "gateway is draining for shutdown — resubmit elsewhere")

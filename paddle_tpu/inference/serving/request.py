@@ -72,6 +72,10 @@ class Request:
         # engine-owned placement (None until admitted)
         self.slot: Optional[int] = None
         self.pages: list = []           # KVPagePool pages reserved for us
+        # True while the reservation covers the speculative verify-scratch
+        # positions (scheduler.reserve_extra at alloc time); the ladder's
+        # shed_reserve_extra() clears it when the scratch pages go back
+        self.scratch_reserved = False
         # prefix sharing (engine-owned): refs taken on a committed page
         # chain at submit; shared_len prompt positions whose prefill we
         # skip. Chunked prefill state: prefill_pos = prompt positions

@@ -102,6 +102,7 @@ class ContinuousBatchingScheduler:
             if all(r.pages for r in self._queue):
                 try:
                     req.pages = self._alloc(need)
+                    req.scratch_reserved = self.reserve_extra > 0
                 except PoolExhausted:
                     pass  # stays queued unreserved; retried at join passes
             self._queue.append(req)
@@ -152,6 +153,7 @@ class ContinuousBatchingScheduler:
                     need = self._pages_needed(head)
                     try:
                         head.pages = self._alloc(need)
+                        head.scratch_reserved = self.reserve_extra > 0
                     except PoolExhausted:
                         break
                 self._queue.popleft()
@@ -163,6 +165,51 @@ class ContinuousBatchingScheduler:
                 trace.event("scheduler.join", rid=head.rid, slot=head.slot,
                             pages=len(head.pages))
         return joined, evicted
+
+    # ---- overload control (engine degradation ladder) ----
+    def backlog_tokens(self) -> int:
+        """Tokens still owed to everything queued or running — the
+        numerator of the engine's projected-queue-wait estimate (divided
+        by the measured token rate it yields seconds of backlog)."""
+        with self._lock:
+            queued = sum(r.max_new_tokens for r in self._queue)
+            running = sum(max(0, r.max_new_tokens - len(r.output_tokens))
+                          for r in self._running.values())
+            return queued + running
+
+    def shed_reserve_extra(self) -> int:
+        """Degradation-ladder lever: stop reserving the per-request verify
+        scratch for future allocations AND give back the whole pages it
+        added to every reservation already held (running or queued). A
+        request whose scratch went back is marked `scratch_reserved=False`
+        so the engine never runs a speculative verify that would write
+        past capacity it no longer owns. Returns pages freed."""
+        freed = 0
+        with self._lock:
+            extra, self.reserve_extra = self.reserve_extra, 0
+            if not extra:
+                return 0
+            for req in list(self._running.values()) + list(self._queue):
+                if not req.pages or not req.scratch_reserved:
+                    continue
+                total = int(req.prompt.size) + req.max_new_tokens
+                n = min(self.pool.pages_for(total + extra)
+                        - self.pool.pages_for(total), len(req.pages))
+                if n > 0:
+                    # the TAIL of the reservation: prompt-front pages may
+                    # be committed into the prefix tree, scratch never is
+                    tail, req.pages = req.pages[-n:], req.pages[:-n]
+                    self.pool.release(tail)
+                    freed += n
+                req.scratch_reserved = False
+        return freed
+
+    def restore_reserve_extra(self, extra: int) -> None:
+        """Exit the ladder level: future reservations cover verify scratch
+        again. Requests admitted while shed keep `scratch_reserved=False`
+        (their speculative window has no capacity) until they finish."""
+        with self._lock:
+            self.reserve_extra = int(extra)
 
     # ---- views ----
     def running(self) -> dict:

@@ -31,6 +31,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..utils.deadline import DataLoaderTimeout
+from ..utils.memo import LockedLRU
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
@@ -196,7 +197,9 @@ class WorkerInfo:
         self.dataset = dataset
 
 
-_worker_info = None
+# one-slot audited registry ("info" -> WorkerInfo), populated only inside a
+# forked worker process (memo idiom instead of a rebound module global)
+_worker_state = LockedLRU(maxsize=None)
 
 # registered in the PARENT at import so the fault matrix can enumerate it;
 # fork inherits the armed environment, so the fault fires in the worker
@@ -209,16 +212,15 @@ FP_WORKER_BATCH = _register_fault(
 def get_worker_info():
     """In a DataLoader worker process: that worker's WorkerInfo; in the main
     process: None (reference worker.py:79)."""
-    return _worker_info
+    return _worker_state.get("info")
 
 
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, seed,
                  num_workers=0):
-    global _worker_info
     from ..distributed.chaos import faultpoint
     np.random.seed((seed + worker_id) % (2 ** 31))
-    _worker_info = WorkerInfo(worker_id, num_workers, seed + worker_id,
-                              dataset)
+    _worker_state.put("info", WorkerInfo(worker_id, num_workers,
+                                         seed + worker_id, dataset))
     parent = os.getppid()
     while True:
         try:

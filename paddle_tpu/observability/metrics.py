@@ -252,6 +252,10 @@ _SERVING_GAUGES = frozenset({
     "avg_occupancy", "tokens_per_sec", "active", "queued", "max_batch",
     "max_seq_len", "prefill_chunk"})
 _GATEWAY_GAUGES = frozenset({"open_connections", "read_timeout", "port"})
+# the overload degradation ladder: level / pause flags / config move both
+# ways (gauges); shed + trim counts only grow (counters)
+_PRESSURE_GAUGES = frozenset({
+    "level", "max_queue", "spec_paused", "prefix_paused"})
 
 
 def _collect_serving() -> list:
@@ -262,13 +266,17 @@ def _collect_serving() -> list:
     for i, e in enumerate(serving.serving_info()):
         labels = {"engine": str(i)}
         skip = {"pool", "step", "prefix", "window", "spec",
-                "prefill_buckets"}
+                "prefill_buckets", "pressure"}
         out += _flat_counters(
             "pt_serving", "counter",
             {k: v for k, v in e.items() if k not in skip},
             labels, "serving engine funnel", gauges=_SERVING_GAUGES)
         out += _flat_counters("pt_serving_pool", "gauge", e["pool"], labels,
                               "KV page pool")
+        out += _flat_counters(
+            "pt_serving_pressure", "counter", e.get("pressure", {}),
+            labels, "overload degradation ladder",
+            gauges=_PRESSURE_GAUGES)
         if e.get("step"):
             out += _flat_counters("pt_serving_step", "counter", e["step"],
                                   labels, "decode step-capture cache")

@@ -75,6 +75,27 @@ class RequestTimeout(DeadlineExceeded):
     pages go back to the pool — see inference/serving/."""
 
 
+class EngineOverloaded(DeadlineExceeded):
+    """Admission rejected at the serving front door: the engine's queue is
+    capped out, or the projected queue wait (measured decode/prefill
+    rates x backlog) already exceeds the request's TTL — so queuing it
+    would only burn its whole deadline before a RequestTimeout.
+
+    TERMINAL for this submission: retrying immediately is exactly the
+    wrong move under overload. `retry_after_ms` carries the engine's
+    advice — the time one queue slot should take to free at the measured
+    rate — which the gateway surfaces as the 429 frame's
+    ``retry-after-ms`` header and `GatewayClient` honors with jittered
+    bounded backoff. Subclasses DeadlineExceeded so CONSTRUCTION fires
+    the flight-recorder incident hook: every shed lands in
+    `last_incident()` with the pressure timeline attached."""
+
+    def __init__(self, what: str, timeout: float | None = None,
+                 detail: str = "", retry_after_ms: int = 100):
+        self.retry_after_ms = int(retry_after_ms)
+        super().__init__(what, timeout, detail)
+
+
 class ReshardTimeout(DeadlineExceeded):
     """A live-resharding step (plan exchange, shard transfer, or commit
     barrier) ran out of budget — a peer died or partitioned mid-reshard.

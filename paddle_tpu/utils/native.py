@@ -12,15 +12,12 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
+
+from .memo import Lazy
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
 _SRC = os.path.join(_CSRC, "runtime.cc")
 _SO = os.path.join(_CSRC, "libpaddle_tpu_rt.so")
-
-_lib = None
-_lib_lock = threading.Lock()
-_load_error: str | None = None
 
 
 def _build() -> str | None:
@@ -148,32 +145,29 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def _load() -> tuple[ctypes.CDLL | None, str | None]:
+    """Build + bind once per process; returns (lib, error), one of them None."""
+    err = _build()
+    if err is not None:
+        return None, err
+    try:
+        return _bind(ctypes.CDLL(_SO)), None
+    except OSError as e:
+        # A corrupt artifact must not be cached on disk forever: remove it so
+        # a later process (or rebuild) regenerates from source.
+        try:
+            os.unlink(_SO)
+        except OSError:
+            pass
+        return None, str(e)
+
+
+_loaded = Lazy(_load)
+
+
 def get_lib():
     """Compile-on-demand and return the ctypes library, or None if unavailable."""
-    global _lib, _load_error
-    if _lib is not None:
-        return _lib
-    with _lib_lock:
-        if _lib is not None:
-            return _lib
-        if _load_error is not None:
-            return None
-        err = _build()
-        if err is not None:
-            _load_error = err
-            return None
-        try:
-            _lib = _bind(ctypes.CDLL(_SO))
-        except OSError as e:
-            # A corrupt artifact must not be cached forever: remove it so a
-            # later process (or retry) rebuilds from source.
-            _load_error = str(e)
-            try:
-                os.unlink(_SO)
-            except OSError:
-                pass
-            return None
-        return _lib
+    return _loaded()[0]
 
 
 def available() -> bool:
@@ -181,8 +175,7 @@ def available() -> bool:
 
 
 def load_error() -> str | None:
-    get_lib()
-    return _load_error
+    return _loaded()[1]
 
 
 def _take_bytes(lib, ptr: ctypes.c_void_p, n: int) -> bytes:
@@ -203,7 +196,7 @@ class BlockingQueue:
     def __init__(self, capacity: int):
         self._lib = get_lib()
         if self._lib is None:
-            raise RuntimeError(f"native runtime unavailable: {_load_error}")
+            raise RuntimeError(f"native runtime unavailable: {load_error()}")
         self._q = self._lib.pt_queue_new(int(capacity))
 
     def push(self, data: bytes, timeout: float = -1.0) -> bool:

@@ -108,6 +108,26 @@ def main(site: str) -> None:
             a.stop()
             b.stop()
             store.stop()
+    elif site == "engine.pressure":
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import paddle_tpu as P
+        from paddle_tpu.inference.serving import ServingEngine
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        # direct engine, no gateway: the fault sits at the top of every
+        # step(), so the first step hits it. The request's TTL is the
+        # bound — a delayed ladder evaluation expires it on the same
+        # step's scheduler pass (typed RequestTimeout, never a hang).
+        P.seed(0)
+        cfg = LlamaConfig.tiny(vocab=32, hidden=16, layers=1, heads=2,
+                               inter=32, seq=32)
+        eng = ServingEngine(LlamaForCausalLM(cfg), max_batch=2,
+                            max_seq_len=32)
+        prompt = np.random.RandomState(0).randint(0, 32, (6,))
+        out = eng.generate([prompt], max_new_tokens=4, ttl=BUDGET)
+        assert out[0].size == 10
     elif site.startswith("gateway."):
         import numpy as np
         import jax

@@ -1,0 +1,29 @@
+"""Fixture serving engine for typed-error-wire-coverage known answers:
+one typed raise with a status mapping (quiet), one without (fires), one
+subclass covered through its mapped ancestor (quiet), and a builtin
+raise that is out of scope."""
+from .gateway.protocol import FixtureDraining
+
+
+class FixtureOverloaded(TimeoutError):
+    """Typed shed with NO status_of mapping — the known answer."""
+
+
+class FixtureFrameTooLong(ValueError):
+    """Covered through the mapped ValueError ancestor."""
+
+
+def admit(queued, cap):
+    if queued >= cap:
+        raise FixtureOverloaded("queue at cap")
+
+
+def drain():
+    raise FixtureDraining("fixture gateway draining")
+
+
+def parse_frame(size, limit):
+    if size > limit:
+        raise FixtureFrameTooLong("frame over limit")
+    if size < 0:
+        raise ValueError("negative frame size")

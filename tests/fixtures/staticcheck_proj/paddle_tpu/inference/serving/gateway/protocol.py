@@ -1,0 +1,24 @@
+"""Fixture wire protocol for typed-error-wire-coverage known answers:
+``status_of`` maps FixtureDraining (and the ValueError/FixtureWireError
+pair) but NOT FixtureOverloaded — the uncovered raise in the fixture
+engine is the one expected finding."""
+
+STATUS_BAD_REQUEST = 400
+STATUS_DRAINING = 503
+STATUS_INTERNAL = 500
+
+
+class FixtureWireError(ConnectionError):
+    """Malformed fixture frame."""
+
+
+class FixtureDraining(RuntimeError):
+    """Fixture gateway is draining."""
+
+
+def status_of(exc):
+    if isinstance(exc, FixtureDraining):
+        return STATUS_DRAINING
+    if isinstance(exc, (ValueError, FixtureWireError)):
+        return STATUS_BAD_REQUEST
+    return STATUS_INTERNAL

@@ -144,6 +144,69 @@ def test_bench_serving_shared_prefix_smoke():
     os.unlink(art)  # tiny-workload artifacts are not trajectory evidence
 
 
+@pytest.mark.skipif(os.environ.get("PT_TIGHT_BUDGET") == "1",
+                    reason="wall-clock budget is tight; perf smoke skipped")
+def test_bench_serving_overload_smoke_json_contract():
+    """--overload smoke: JSON contract + the typed-shed and bitwise gates.
+    The goodput (>= 0.8x) and shed-latency (< 50 ms p99) floors are pinned
+    only in the slow battery — wire latency on a loaded single-core CI box
+    is noise at smoke scale."""
+    env = dict(os.environ, PT_SERVE_BENCH_REQUESTS="12",
+               PT_SERVE_BENCH_BATCH="2", PT_SERVE_BENCH_REPS="1")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_serving.py"),
+         "--overload"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    payload = json.loads(lines[0])
+    assert payload["metric"] == "serving_overload_goodput_ratio"
+    assert payload["backend"] == "cpu-proxy"
+    # 2x-over-capacity really overloaded: work was both served AND shed,
+    # and every shed came back as the typed 429 — zero untyped failures
+    assert payload["offered"] == 12
+    assert payload["accepted"] > 0 and payload["shed"] > 0
+    assert payload["accepted"] + payload["shed"] == payload["offered"]
+    assert payload["untyped_errors"] == 0, payload
+    # accepted tokens bitwise the closed-loop engine's
+    assert payload["token_mismatches"] == 0, payload
+    assert payload["value"] > 0
+    assert payload["shed_p99_ms"] >= payload["shed_p50_ms"] > 0
+    # the ladder engaged under the burst and the occupancy is a
+    # distribution over the four levels
+    occ = payload["ladder_occupancy"]
+    assert set(occ) == {"level0", "level1", "level2", "level3"}
+    assert abs(sum(occ.values()) - 1.0) < 0.01, occ
+    assert sum(occ[k] for k in ("level1", "level2", "level3")) > 0, occ
+    art = r.stderr.split("artifact ->", 1)[1].strip().splitlines()[0]
+    with open(art) as f:
+        detail = json.load(f)["detail"]
+    pressure = detail["engine_info"]["pressure"]
+    assert pressure["shed"] == payload["shed"]
+    assert len(detail["shed_latency_ms"]) == payload["shed"]
+    assert detail["untyped"] == []
+    os.unlink(art)  # tiny-workload artifacts are not trajectory evidence
+
+
+@pytest.mark.slow
+def test_bench_serving_overload_meets_floors():
+    """Full-scale --overload acceptance: typed 429 under 50 ms p99, tokens
+    bitwise, goodput >= 0.8x the closed-loop engine (measured 0.94x)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_serving.py"),
+         "--overload"],
+        capture_output=True, text=True, timeout=600, env=dict(os.environ),
+        cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads([ln for ln in r.stdout.splitlines()
+                          if ln.startswith("{")][0])
+    assert payload["value"] >= 0.8, payload
+    assert payload["shed"] > 0 and payload["untyped_errors"] == 0, payload
+    assert payload["shed_p99_ms"] < 50.0, payload
+    assert payload["token_mismatches"] == 0, payload
+
+
 @pytest.mark.slow
 def test_bench_serving_meets_acceptance_floor():
     payload, _ = _run_bench(requests=24, batch=8, reps=3)

@@ -1,0 +1,227 @@
+"""Distribution-method cases-table battery (registry-consistency orphan
+burn-down, ROADMAP standing debt).
+
+Every key in CASES was a baselined `registry-consistency` orphan: a
+``paddle_tpu.distribution`` method dispatching under a stable ``op_name``
+that no test battery referenced through the package. Per the burn-down
+rule these are retired with REAL known-answer assertions via the public
+surface — closed-form values where the distribution has them, exact
+numeric sums for the discrete entropies, and support/shape laws for the
+samplers — never by loosening the checker's resolution. The ratchet in
+tools/staticcheck/baseline.json is re-cut downward as this table grows.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.distribution as D
+
+_G = 0.5772156649015329          # Euler-Mascheroni
+
+
+def _np(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+def _t(a):
+    return P.to_tensor(np.asarray(a, np.float32))
+
+
+def _close(actual, expected, tol=1e-4):
+    np.testing.assert_allclose(_np(actual), np.asarray(expected, np.float32),
+                               rtol=tol, atol=tol)
+
+
+def _support(actual, pred, shape=None):
+    a = _np(actual)
+    assert np.isfinite(a).all(), a
+    assert pred(a).all(), a
+    if shape is not None:
+        assert a.shape == shape, a.shape
+
+
+def _binomial_entropy(n, p):
+    pk = np.asarray([math.comb(n, k) * p**k * (1 - p)**(n - k)
+                     for k in range(n + 1)])
+    return float(-(pk * np.log(pk)).sum())
+
+
+def _poisson_entropy(rate):
+    pk = np.asarray([rate**k * math.exp(-rate) / math.factorial(k)
+                     for k in range(60)])
+    pk = pk[pk > 0]
+    return float(-(pk * np.log(pk)).sum())
+
+
+# Each value is a zero-arg case body: building the distribution and
+# asserting the known answer IS the case. The string keys are the
+# governed op names; the D. references in the values tie the table to
+# the package (the battery-governance route the checker resolves).
+CASES = {
+    # ---- bernoulli ----
+    "bernoulli_cdf": lambda: _close(
+        D.Bernoulli(0.3).cdf(_t([-1.0, 0.5, 2.0])), [0.0, 0.7, 1.0]),
+    "bernoulli_entropy": lambda: _close(
+        D.Bernoulli(0.3).entropy(),
+        -(0.3 * math.log(0.3) + 0.7 * math.log(0.7))),
+    "bernoulli_log_prob": lambda: _close(
+        D.Bernoulli(0.3).log_prob(_t([1.0, 0.0])),
+        [math.log(0.3), math.log(0.7)]),
+    "bernoulli_rsample": lambda: _support(
+        D.Bernoulli(0.3).rsample((64,)),
+        lambda a: (a > 0.0) & (a < 1.0), shape=(64,)),
+    "bernoulli_sample": lambda: _support(
+        D.Bernoulli(0.3).sample((64,)),
+        lambda a: (a == 0.0) | (a == 1.0), shape=(64,)),
+    # ---- beta ----
+    "beta_entropy": lambda: _close(D.Beta(2.0, 3.0).entropy(), -0.2349066),
+    "beta_log_prob": lambda: _close(
+        D.Beta(2.0, 3.0).log_prob(_t([0.5])), [math.log(1.5)]),
+    "beta_mean": lambda: _close(D.Beta(2.0, 3.0).mean, 0.4),
+    "beta_rsample": lambda: _support(
+        D.Beta(2.0, 3.0).rsample((32,)), lambda a: (a > 0.0) & (a < 1.0)),
+    # ---- binomial ----
+    "binomial_entropy": lambda: _close(
+        D.Binomial(10, 0.5).entropy(), _binomial_entropy(10, 0.5),
+        tol=1e-3),
+    "binomial_log_prob": lambda: _close(
+        D.Binomial(10, 0.5).log_prob(_t([5.0])),
+        [math.log(252.0 / 1024.0)]),
+    "binomial_mean": lambda: _close(D.Binomial(10, 0.5).mean, 5.0),
+    "binomial_sample": lambda: _support(
+        D.Binomial(10, 0.5).sample((32,)),
+        lambda a: (a >= 0) & (a <= 10) & (a == np.floor(a))),
+    # ---- categorical (logits are unnormalized probabilities) ----
+    "categorical_entropy": lambda: _close(
+        D.Categorical(_t([1.0, 2.0, 1.0])).entropy(),
+        -(0.5 * math.log(0.25) + 0.5 * math.log(0.5))),
+    "categorical_log_prob": lambda: _close(
+        D.Categorical(_t([1.0, 2.0, 1.0])).log_prob(_t(1.0)),
+        math.log(0.5)),
+    "categorical_probs": lambda: _close(
+        D.Categorical(_t([1.0, 2.0, 1.0])).probs, [0.25, 0.5, 0.25]),
+    "categorical_sample": lambda: _support(
+        D.Categorical(_t([1.0, 2.0, 1.0])).sample((64,)),
+        lambda a: (a >= 0) & (a <= 2) & (a == np.floor(a))),
+    # ---- cauchy ----
+    "cauchy_cdf": lambda: _close(
+        D.Cauchy(0.0, 1.0).cdf(_t([0.0, 1.0])), [0.5, 0.75]),
+    "cauchy_entropy": lambda: _close(
+        D.Cauchy(0.0, 1.0).entropy(), math.log(4 * math.pi)),
+    "cauchy_icdf": lambda: _close(
+        D.Cauchy(0.0, 1.0).icdf(_t([0.5, 0.75])), [0.0, 1.0]),
+    "cauchy_log_prob": lambda: _close(
+        D.Cauchy(0.0, 1.0).log_prob(_t([0.0])), [-math.log(math.pi)]),
+    "cauchy_rsample": lambda: _support(
+        D.Cauchy(0.0, 1.0).rsample((32,)), np.isfinite),
+    # ---- dirichlet (2 components == Beta, so the answers cross-check) --
+    "dirichlet_entropy": lambda: _close(
+        D.Dirichlet(_t([2.0, 3.0])).entropy(), -0.2349066),
+    "dirichlet_log_prob": lambda: _close(
+        D.Dirichlet(_t([2.0, 3.0])).log_prob(_t([0.4, 0.6])), 0.5469646),
+    "dirichlet_mean": lambda: _close(
+        D.Dirichlet(_t([2.0, 3.0])).mean, [0.4, 0.6]),
+    "dirichlet_rsample": lambda: _support(
+        D.Dirichlet(_t([2.0, 3.0])).rsample((8,)),
+        lambda a: (a > 0.0) & (a < 1.0), shape=(8, 2)),
+    # ---- gamma ----
+    "gamma_entropy": lambda: _close(
+        D.Gamma(2.0, 3.0).entropy(), 2.0 - math.log(3.0) - 0.4227843),
+    "gamma_log_prob": lambda: _close(
+        D.Gamma(2.0, 3.0).log_prob(_t([1.0])), [math.log(9.0) - 3.0]),
+    "gamma_mean": lambda: _close(D.Gamma(2.0, 3.0).mean, 2.0 / 3.0),
+    "gamma_rsample": lambda: _support(
+        D.Gamma(2.0, 3.0).rsample((32,)), lambda a: a > 0.0),
+    # ---- geometric (failures before first success, support {0,1,..}) --
+    "geometric_cdf": lambda: _close(
+        D.Geometric(0.3).cdf(_t([2.0])), [1.0 - 0.7**3]),
+    "geometric_entropy": lambda: _close(
+        D.Geometric(0.3).entropy(),
+        -(0.7 * math.log(0.7) + 0.3 * math.log(0.3)) / 0.3),
+    "geometric_log_prob": lambda: _close(
+        D.Geometric(0.3).log_prob(_t([2.0])),
+        [2 * math.log(0.7) + math.log(0.3)]),
+    "geometric_mean": lambda: _close(D.Geometric(0.3).mean, 0.7 / 0.3),
+    "geometric_sample": lambda: _support(
+        D.Geometric(0.3).sample((64,)),
+        lambda a: (a >= 0) & (a == np.floor(a))),
+    # ---- gumbel ----
+    "gumbel_cdf": lambda: _close(
+        D.Gumbel(1.0, 2.0).cdf(_t([1.0])), [math.exp(-1.0)]),
+    "gumbel_entropy": lambda: _close(
+        D.Gumbel(1.0, 2.0).entropy(), math.log(2.0) + _G + 1.0),
+    "gumbel_log_prob": lambda: _close(
+        D.Gumbel(1.0, 2.0).log_prob(_t([1.0])), [-math.log(2.0) - 1.0]),
+    "gumbel_mean": lambda: _close(D.Gumbel(1.0, 2.0).mean, 1.0 + 2.0 * _G),
+    "gumbel_rsample": lambda: _support(
+        D.Gumbel(1.0, 2.0).rsample((32,)), np.isfinite),
+    # ---- independent (rank-1 reinterpretation sums the base laws) ----
+    "independent_entropy": lambda: _close(
+        D.Independent(D.Normal(_t([0.0, 0.0]), _t([1.0, 1.0])), 1)
+        .entropy(), math.log(2 * math.pi * math.e)),
+    "independent_log_prob": lambda: _close(
+        D.Independent(D.Normal(_t([0.0, 0.0]), _t([1.0, 1.0])), 1)
+        .log_prob(_t([0.0, 0.0])), -math.log(2 * math.pi)),
+    # ---- laplace ----
+    "laplace_cdf": lambda: _close(
+        D.Laplace(0.0, 1.0).cdf(_t([0.0, 1.0])),
+        [0.5, 1.0 - 0.5 * math.exp(-1.0)]),
+    "laplace_entropy": lambda: _close(
+        D.Laplace(0.0, 1.0).entropy(), 1.0 + math.log(2.0)),
+    "laplace_icdf": lambda: _close(
+        D.Laplace(0.0, 1.0).icdf(_t([0.5, 1.0 - 0.5 * math.exp(-1.0)])),
+        [0.0, 1.0]),
+    "laplace_log_prob": lambda: _close(
+        D.Laplace(0.0, 1.0).log_prob(_t([0.0])), [-math.log(2.0)]),
+    "laplace_rsample": lambda: _support(
+        D.Laplace(0.0, 1.0).rsample((32,)), np.isfinite),
+    # ---- normal ----
+    "normal_cdf": lambda: _close(
+        D.Normal(0.0, 1.0).cdf(_t([0.0, 1.0])), [0.5, 0.8413447]),
+    "normal_entropy": lambda: _close(
+        D.Normal(0.0, 1.0).entropy(),
+        0.5 * math.log(2 * math.pi * math.e)),
+    "normal_icdf": lambda: _close(
+        D.Normal(0.0, 1.0).icdf(_t([0.5, 0.8413447])), [0.0, 1.0],
+        tol=1e-3),
+    "normal_log_prob": lambda: _close(
+        D.Normal(0.0, 1.0).log_prob(_t([0.0])),
+        [-0.5 * math.log(2 * math.pi)]),
+    "normal_rsample": lambda: _support(
+        D.Normal(0.0, 1.0).rsample((32,)), np.isfinite, shape=(32,)),
+    # ---- poisson ----
+    "poisson_entropy": lambda: _close(
+        D.Poisson(3.0).entropy(), _poisson_entropy(3.0), tol=1e-3),
+    "poisson_log_prob": lambda: _close(
+        D.Poisson(3.0).log_prob(_t([2.0])),
+        [2 * math.log(3.0) - 3.0 - math.log(2.0)]),
+    "poisson_sample": lambda: _support(
+        D.Poisson(3.0).sample((64,)),
+        lambda a: (a >= 0) & (a == np.floor(a))),
+    # ---- uniform ----
+    "uniform_cdf": lambda: _close(
+        D.Uniform(2.0, 6.0).cdf(_t([3.0, 6.0])), [0.25, 1.0]),
+    "uniform_entropy": lambda: _close(
+        D.Uniform(2.0, 6.0).entropy(), math.log(4.0)),
+    "uniform_icdf": lambda: _close(
+        D.Uniform(2.0, 6.0).icdf(_t([0.25, 1.0])), [3.0, 6.0]),
+    "uniform_log_prob": lambda: _close(
+        D.Uniform(2.0, 6.0).log_prob(_t([3.0])), [-math.log(4.0)]),
+    "uniform_mean": lambda: _close(D.Uniform(2.0, 6.0).mean, 4.0),
+    "uniform_rsample": lambda: _support(
+        D.Uniform(2.0, 6.0).rsample((32,)),
+        lambda a: (a >= 2.0) & (a < 6.0), shape=(32,)),
+}
+
+
+def test_battery_covers_the_burn_down_floor():
+    # the PR-18 satellite burned >= 34 orphans; this table carries 61
+    assert len(CASES) == 61, len(CASES)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_distribution_method_known_answer(name):
+    P.seed(11)
+    CASES[name]()

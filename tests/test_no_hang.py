@@ -145,6 +145,25 @@ MATRIX = {
     ("gateway.read", "delay:2.0"):   ("typed", "RequestTimeout"),
     ("gateway.read", "error"):       ("typed", "FaultInjected"),
     ("gateway.read", "drop"):        ("clean", None),
+    # gateway admission edge (gateway.admit — every GENERATE passes it
+    # before engine.submit, the window an overload shed occupies): a
+    # stalled admission burns the CLIENT's budget into the typed
+    # RequestTimeout; an injected error answers a typed 500 frame the
+    # client re-raises; a dropped admission closes the connection like a
+    # wire death and the client's reconnect-retry-once absorbs it.
+    ("gateway.admit", "crash"):      ("sigkill", None),
+    ("gateway.admit", "delay:2.0"):  ("typed", "RequestTimeout"),
+    ("gateway.admit", "error"):      ("typed", "FaultInjected"),
+    ("gateway.admit", "drop"):       ("clean", None),
+    # serving overload ladder (engine.pressure — every engine step's
+    # ladder evaluation, direct-engine child with a per-request TTL): a
+    # stalled evaluation expires the request on the same step's scheduler
+    # pass into the typed RequestTimeout; error/drop propagate typed out
+    # of run(); crash is the preempted-server case.
+    ("engine.pressure", "crash"):     ("sigkill", None),
+    ("engine.pressure", "delay:2.0"): ("typed", "RequestTimeout"),
+    ("engine.pressure", "error"):     ("typed", "FaultInjected"),
+    ("engine.pressure", "drop"):      ("typed", "FaultDrop"),
 }
 
 
@@ -636,6 +655,16 @@ def test_gateway_read_delay_becomes_typed_timeout_in_child(tmp_path):
     budget — the no-hang law holds end to end over a real socket."""
     proc = _spawn_case("gateway.read", "delay:2.0", tmp_path)
     _assert_case("gateway.read", "delay:2.0", proc)
+
+
+def test_engine_pressure_delay_becomes_typed_timeout_in_child(tmp_path):
+    """Quick tier-1 representative of the overload-control rows: a
+    stalled ladder evaluation at the top of step() burns the request's
+    TTL, and the SAME step's scheduler pass expires it into the typed
+    RequestTimeout — the overload control point can never wedge a
+    request past its deadline."""
+    proc = _spawn_case("engine.pressure", "delay:2.0", tmp_path)
+    _assert_case("engine.pressure", "delay:2.0", proc)
 
 
 @pytest.mark.slow

@@ -121,7 +121,7 @@ def test_native_transport_in_use():
     rpc.init_rpc("carol", rank=0, world_size=1)
     try:
         from paddle_tpu.distributed import rpc as rmod
-        assert isinstance(rmod._state.server, _NativeRpcServer)
+        assert isinstance(rmod._require_state().server, _NativeRpcServer)
         assert rpc.rpc_sync("carol", _add, args=(20, 3)) == 23
         fut = rpc.rpc_async("carol", _add, args=(1, 1))
         assert fut.result() == 2

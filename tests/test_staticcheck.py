@@ -46,7 +46,8 @@ def test_all_rules_fire_on_fixtures(fixture_findings):
                      "registry-consistency", "mutable-global",
                      "dead-export", "key-reuse", "closure-capture",
                      "unbounded-blocking", "dtype-rule-coverage",
-                     "naked-collective", "chaos-site-coverage"}, rules
+                     "naked-collective", "chaos-site-coverage",
+                     "typed-error-wire-coverage"}, rules
     assert len(rules) >= 5  # the acceptance floor, trivially exceeded
 
 
@@ -240,6 +241,34 @@ def test_chaos_site_coverage_known_answers(fixture_findings):
               if f.path.endswith("fault_sites.py")
               and f.rule != "chaos-site-coverage"]
     assert others == [], others
+
+
+def test_typed_error_wire_coverage_known_answers(fixture_findings):
+    """inference/serving fixture: only the typed raise with NO status_of
+    mapping fires (FixtureOverloaded), anchored at the raise with the
+    class name as the stable baseline key. The mapped class
+    (FixtureDraining), the subclass covered through its mapped ValueError
+    ancestor (FixtureFrameTooLong), and the builtin raise stay quiet."""
+    tw = [f for f in fixture_findings
+          if f.rule == "typed-error-wire-coverage"]
+    assert {f.context for f in tw} == {"FixtureOverloaded"}, tw
+    assert all(f.path == "paddle_tpu/inference/serving/engine.py"
+               for f in tw), tw
+    assert all(f.severity == "warning" for f in tw)
+    # and no OTHER rule trips over the serving fixture modules
+    others = [f for f in fixture_findings
+              if "inference/serving/" in f.path
+              and f.rule != "typed-error-wire-coverage"]
+    assert others == [], others
+
+
+def test_typed_error_wire_coverage_clean_on_repo(repo_findings):
+    """Every typed exception the real serving path raises or ships has an
+    explicit PTSG/1 mapping (EngineOverloaded -> 429, GatewayDraining ->
+    503, RequestTimeout -> 408, ...) — the rule holds at ZERO baselined
+    entries: a new typed serving error must land with its wire status."""
+    assert [f for f in repo_findings
+            if f.rule == "typed-error-wire-coverage"] == []
 
 
 def test_chaos_site_coverage_clean_on_repo(repo_findings):

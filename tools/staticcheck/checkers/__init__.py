@@ -11,5 +11,6 @@ from . import (  # noqa: F401
     numpy_on_tracer,
     registry_consistency,
     tracer_branch,
+    typed_error_wire_coverage,
     unbounded_blocking,
 )

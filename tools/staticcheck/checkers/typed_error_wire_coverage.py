@@ -1,0 +1,145 @@
+"""typed-error-wire-coverage: serving-side typed errors must map to a
+PTSG/1 status.
+
+The gateway handler serializes whatever the engine raises through
+``status_of`` in ``gateway/protocol.py``; an exception class with no
+``isinstance`` branch there falls through to the generic 500, so the
+client loses the TYPE — retry policy, breaker accounting, and the typed
+re-raise all degrade to "internal error". The contract this rule closes:
+a typed exception raised (or constructed as a request's terminal error)
+anywhere on the serving path must be covered by ``status_of`` — by its
+own class or an ancestor — the moment it lands, not when a client first
+trips over an unmapped 500 in production.
+
+Scope: modules under ``inference/serving/`` except ``gateway/client.py``
+(client-side errors never traverse the server handler). Exception
+classes are collected from the serving tree plus ``utils/deadline.py``
+(the shared deadline hierarchy serving raises from); a class counts as
+an exception when its base chain reaches a builtin exception. Trees
+without a ``gateway/protocol.py`` defining ``status_of`` (fixture
+projects that don't exercise this rule) are skipped. Zero entries are
+baselined; a new typed serving error must land together with its wire
+mapping (and its client-side reconstruction if it should stay typed end
+to end).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name
+from ..core import Checker, Module, Project, register
+
+PROTOCOL_TAIL = "inference/serving/gateway/protocol.py"
+SERVING_DIR = "inference/serving/"
+CLIENT_TAIL = "gateway/client.py"
+DEADLINE_TAIL = "utils/deadline.py"
+
+_BUILTIN_EXC = {
+    "BaseException", "Exception", "ArithmeticError", "AssertionError",
+    "AttributeError", "BufferError", "ConnectionError", "EOFError",
+    "ImportError", "IndexError", "InterruptedError", "KeyError",
+    "LookupError", "MemoryError", "NotImplementedError", "OSError",
+    "OverflowError", "PermissionError", "RuntimeError", "StopIteration",
+    "TimeoutError", "TypeError", "ValueError",
+}
+
+
+def _tail_name(node: ast.AST) -> str:
+    """`Name` / dotted-`Attribute` -> the last component, else ''."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+@register
+class TypedErrorWireCoverageChecker(Checker):
+    rule = "typed-error-wire-coverage"
+    severity = "warning"
+
+    def __init__(self):
+        # class name -> base-class names (last components)
+        self._bases: dict[str, set[str]] = {}
+        # (module, node, class name) per raise/construction site
+        self._sites: list[tuple[Module, ast.AST, str]] = []
+
+    def _collect_classes(self, mod: Module):
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                names = {_tail_name(b) for b in node.bases} - {""}
+                self._bases.setdefault(node.name, set()).update(names)
+
+    def check_module(self, mod: Module):
+        if mod.path.endswith(DEADLINE_TAIL):
+            self._collect_classes(mod)
+            return ()
+        if SERVING_DIR not in mod.path:
+            return ()
+        self._collect_classes(mod)
+        if mod.path.endswith(CLIENT_TAIL):
+            return ()
+        for node in ast.walk(mod.tree):
+            # every construction is a site, not just `raise X(...)`: the
+            # server also ships errors it never raises (error_frame(...,
+            # GatewayDraining(...))) and requests carry terminal errors
+            # by assignment (req.error = RequestTimeout(...))
+            if isinstance(node, ast.Call):
+                name = _tail_name(node.func)
+            elif isinstance(node, ast.Raise) and node.exc is not None \
+                    and not isinstance(node.exc, ast.Call):
+                name = _tail_name(node.exc)   # `raise Name` re-raise form
+            else:
+                continue
+            if name:
+                self._sites.append((mod, node, name))
+        return ()
+
+    def _reaches(self, name: str, targets: set[str]) -> bool:
+        seen, frontier = set(), {name}
+        while frontier:
+            n = frontier.pop()
+            if n in targets:
+                return True
+            seen.add(n)
+            frontier.update(self._bases.get(n, set()) - seen)
+        return False
+
+    def finalize(self, project: Project):
+        protocol = next((m for m in project.modules
+                         if m.path.endswith(PROTOCOL_TAIL)), None)
+        if protocol is None:
+            return
+        covered: set[str] = set()
+        for fn in ast.walk(protocol.tree):
+            if not (isinstance(fn, ast.FunctionDef)
+                    and fn.name == "status_of"):
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and call_name(node) == "isinstance"
+                        and len(node.args) == 2):
+                    continue
+                spec = node.args[1]
+                elts = spec.elts if isinstance(spec, ast.Tuple) else [spec]
+                covered.update(_tail_name(e) for e in elts)
+        covered.discard("")
+        if not covered:
+            return
+        reported: set[tuple[str, str]] = set()   # one per (path, class)
+        for mod, node, name in self._sites:
+            if name not in self._bases \
+                    or not self._reaches(name, _BUILTIN_EXC) \
+                    or self._reaches(name, covered) \
+                    or (mod.path, name) in reported:
+                continue
+            reported.add((mod.path, name))
+            yield mod.finding(
+                self.rule, self.severity, node,
+                f"typed exception {name!r} travels the serving path but "
+                f"has no PTSG/1 status mapping in {PROTOCOL_TAIL} "
+                f"status_of — the gateway would ship it as the generic "
+                f"500 and clients lose the type; add an isinstance "
+                f"branch (and a client-side reconstruction if it must "
+                f"stay typed end to end)",
+                context=name)
