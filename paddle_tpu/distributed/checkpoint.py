@@ -132,19 +132,59 @@ def _verify_file(path: str):
             f"size={want[1]}) — torn or corrupted shard")
 
 
-_pending: Optional[threading.Thread] = None
-_pending_error: Optional[BaseException] = None
-_pending_lock = threading.Lock()
-_barrier_seq = 0
+class _AsyncWriter:
+    """Audited holder for the module's async-writer slot (utils/memo idiom:
+    module state lives on a locked instance, never in rebindable globals —
+    the mutable-global ratchet). Tracks the in-flight writer thread, the
+    error it hit, and the per-save barrier sequence."""
+
+    __slots__ = ("_lock", "_thread", "_error", "_seq")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._seq = 0
+
+    def next_tag(self, path: str) -> str:
+        with self._lock:
+            self._seq += 1
+            return f"pt_ckpt:{os.path.basename(path)}:{self._seq}"
+
+    def thread(self) -> Optional[threading.Thread]:
+        with self._lock:
+            return self._thread
+
+    def launch(self, target) -> None:
+        t = threading.Thread(target=target, daemon=False)
+        with self._lock:
+            self._thread = t
+        t.start()
+
+    def record_error(self, e: BaseException) -> None:
+        with self._lock:
+            self._error = e
+
+    def finish(self, t: Optional[threading.Thread]) -> Optional[BaseException]:
+        """Clear the slot (if still holding `t`) and consume the error."""
+        with self._lock:
+            if self._thread is t:
+                self._thread = None
+            err, self._error = self._error, None
+            return err
+
+    def idle(self) -> bool:
+        with self._lock:
+            return self._thread is None and self._error is None
+
+
+_writer = _AsyncWriter()
 
 
 def _next_barrier_tag(path: str) -> str:
     """Unique per-save barrier id; every process calls save() in the same
     order (SPMD discipline), so sequence numbers agree across hosts."""
-    global _barrier_seq
-    with _pending_lock:
-        _barrier_seq += 1
-        return f"pt_ckpt:{os.path.basename(path)}:{_barrier_seq}"
+    return _writer.next_tag(path)
 
 
 def _host_barrier(tag: str, timeout_ms: int = 600_000):
@@ -223,15 +263,10 @@ def wait():
     (PT_CKPT_WAIT_TIMEOUT, default 600s): a writer wedged on dead storage
     becomes a typed DeadlineExceeded, not a forever-blocked trainer."""
     from ..utils.deadline import join_bounded
-    global _pending, _pending_error
-    with _pending_lock:
-        t = _pending
+    t = _writer.thread()
     if t is not None:
         join_bounded(t, "async checkpoint writer")
-    with _pending_lock:
-        if _pending is t:
-            _pending = None
-        err, _pending_error = _pending_error, None
+    err = _writer.finish(t)
     if err is not None:
         raise RuntimeError("async checkpoint save failed") from err
 
@@ -319,20 +354,13 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             crashpoint(CP_META_FINAL)
 
     if async_save:
-        global _pending
-
         def _write_guarded():
-            global _pending_error
             try:
                 _write()
             except BaseException as e:
-                with _pending_lock:
-                    _pending_error = e
+                _writer.record_error(e)
 
-        t = threading.Thread(target=_write_guarded, daemon=False)
-        with _pending_lock:
-            _pending = t
-        t.start()
+        _writer.launch(_write_guarded)
     else:
         _write()
 

@@ -27,21 +27,48 @@ Crash sites in the commit path are registered with the chaos harness; the
 fault-injection matrix (tests/test_ckpt_chaos.py) SIGKILLs a writer at
 every one of them and proves `latest()` + `restore()` still land on the
 last committed generation.
+
+Sharded generations (the elastic-supervisor commit path) use the same
+directory protocol with per-OWNER staging instead of a gather onto one
+writer:
+
+    root/step-40/
+      shard-a.npz + .crc32     owner "a" staged its local bricks
+      receipt-a.json           ...then its receipt (stage complete)
+      shard-b.npz + .crc32     owner "b" likewise, concurrently
+      receipt-b.json
+      metadata.json            committer: global param metadata
+      manifest.json            committer: unified manifest over ALL files
+      COMMIT                   committer: single atomic durability instant
+
+Two-phase: every owner stages bricks + a receipt (`ckpt.shard_staged`),
+the committer collects every receipt (`ckpt.receipts`), cross-checks them
+against the CRC sidecars, and only then writes manifest + COMMIT. A death
+at ANY point leaves either the previous committed generation or a
+complete new one — never a torn state; GC reaps dead staged attempts.
+The read side is unchanged: shard files are slice-keyed exactly like the
+gather layout, so `latest()`/`restore()`/`read_params` assemble across
+owners with the existing manifest cross-check.
 """
 from __future__ import annotations
 
+import io
 import json
 import os
 import re
 import shutil
 import threading
-from typing import Optional
+import time
+from typing import Callable, Dict, List, Optional
 
 import jax
+import numpy as np
 
-from ..utils.deadline import join_bounded
+from ..utils.deadline import (CheckpointTimeout, Deadline, env_timeout,
+                              join_bounded)
 from . import checkpoint as _ckpt
-from .chaos import crashpoint, register as _register_crashpoint
+from .chaos import (FaultDrop, crashpoint, faultpoint,
+                    register as _register_crashpoint, register_fault)
 
 CP_GEN_STAGED = _register_crashpoint(
     "ckpt.generation_staged", "all files durable, manifest not written")
@@ -52,9 +79,33 @@ CP_COMMIT = _register_crashpoint(
 CP_GC = _register_crashpoint(
     "ckpt.gc_done", "commit + GC complete")
 
+# sharded two-phase commit sites — faultpoints (crash/delay/error/drop),
+# rows in the no-hang matrix AND the supervisor writer-kill matrix
+FP_SHARD_STAGED = register_fault(
+    "ckpt.shard_staged", "owner bricks durable, receipt not yet written")
+FP_RECEIPTS = register_fault(
+    "ckpt.receipts", "receipt collection / commit-marker wait")
+
 _GEN_RE = re.compile(r"^step-(\d+)$")
 MANIFEST = "manifest.json"
 COMMIT = "COMMIT"
+_OWNER_RE = re.compile(r"^[A-Za-z0-9_.\-]+$")
+RECEIPT_FORMAT = "paddle_tpu.ckpt_receipt.v1"
+SHARDED_LAYOUT = "owner-sharded"
+
+
+def _fault_site(site: str, dl: Deadline, what: str):
+    """One chaos-visible blocking edge of the sharded commit: a dropped
+    wire is absorbed by retry-once (receipt files are idempotent), a stall
+    becomes the typed CheckpointTimeout via the commit Deadline."""
+    for attempt in (0, 1):
+        try:
+            faultpoint(site)
+            break
+        except FaultDrop:
+            if attempt:
+                raise
+    dl.check(what, exc=CheckpointTimeout)
 
 
 class CheckpointManager:
@@ -275,7 +326,8 @@ class CheckpointManager:
             # their own save() returned
             _ckpt._host_barrier(_ckpt._next_barrier_tag(d + "/commit"))
 
-    def _write_manifest(self, d: str, step: int, user_data: Optional[dict]):
+    def _write_manifest(self, d: str, step: int, user_data: Optional[dict],
+                        layout: Optional[str] = None):
         files = {}
         for name in sorted(os.listdir(d)):
             if name in (MANIFEST, COMMIT) or name.endswith(".crc32") \
@@ -294,8 +346,233 @@ class CheckpointManager:
             files[name] = {"crc32": f"{crc:08x}", "size": size}
         man = {"format": "paddle_tpu.ckpt_gen.v1", "step": int(step),
                "files": files, "user_data": user_data or {}}
+        if layout is not None:
+            man["layout"] = layout
         _ckpt._atomic_write(os.path.join(d, MANIFEST),
                             json.dumps(man, indent=1, sort_keys=True).encode())
+
+    # ---- sharded write side (two-phase: stage -> receipts -> marker) ----
+
+    def _receipt_path(self, d: str, owner: str) -> str:
+        return os.path.join(d, f"receipt-{owner}.json")
+
+    def _shard_path(self, d: str, owner: str) -> str:
+        return os.path.join(d, f"shard-{owner}.npz")
+
+    @staticmethod
+    def _check_owner(owner: str):
+        if not _OWNER_RE.match(owner):
+            raise ValueError(f"owner id {owner!r} is not filesystem-safe")
+
+    def stage_shards(self, step: int, owner: str,
+                     shards: Dict[str, np.ndarray],
+                     budget: Optional[float] = None) -> dict:
+        """Phase 1, run by EVERY owner: write this owner's bricks as one
+        slice-keyed shard file (key `name|lo:hi,...` or `name|full`, the
+        same convention the gather layout's reader assembles) plus its CRC
+        sidecar, then the owner's receipt. The receipt's atomic rename is
+        the owner's stage-complete instant: a death before it leaves an
+        attempt the committer never counts. Returns per-owner commit
+        accounting ({"bytes", "wall_s"}) for the supervisor event."""
+        self._check_owner(owner)
+        t0 = time.monotonic()
+        dl = Deadline(budget if budget is not None
+                      else env_timeout("PT_CKPT_COMMIT_TIMEOUT", 600.0),
+                      f"sharded stage of step-{step} by {owner}")
+        d = self.gen_dir(step)
+        os.makedirs(d, exist_ok=True)
+        # a stale receipt from a dead earlier attempt of this same step
+        # must never vouch for the NEW bytes — drop it before staging
+        for stale in (self._receipt_path(d, owner),):
+            try:
+                os.unlink(stale)
+            except FileNotFoundError:
+                pass
+        buf = io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in shards.items()})
+        payload = buf.getvalue()
+        path = self._shard_path(d, owner)
+        crc = _ckpt._atomic_write(path, payload)
+        _ckpt._write_sidecar(path, crc, len(payload))
+        _fault_site(FP_SHARD_STAGED, dl,
+                    f"sharded stage of step-{step} by {owner}")
+        receipt = {"format": RECEIPT_FORMAT, "owner": owner,
+                   "step": int(step),
+                   "files": {os.path.basename(path):
+                             {"crc32": f"{crc:08x}", "size": len(payload)}},
+                   "keys": sorted(shards)}
+        _ckpt._atomic_write(self._receipt_path(d, owner),
+                            json.dumps(receipt, indent=1,
+                                       sort_keys=True).encode())
+        return {"bytes": len(payload), "wall_s": time.monotonic() - t0}
+
+    def _read_receipt(self, d: str, owner: str) -> dict:
+        path = self._receipt_path(d, owner)
+        try:
+            with open(path, encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            raise _ckpt.CheckpointCorruptionError(
+                f"{path}: unreadable receipt — refusing to commit") from e
+        if rec.get("format") != RECEIPT_FORMAT or rec.get("owner") != owner:
+            raise _ckpt.CheckpointCorruptionError(
+                f"{path}: receipt does not identify owner {owner!r} "
+                f"(format={rec.get('format')!r}, owner={rec.get('owner')!r})")
+        for fname, want in rec.get("files", {}).items():
+            fpath = os.path.join(d, fname)
+            side = _ckpt._read_sidecar(fpath)
+            got = side if side is not None else (
+                _ckpt._crc32_file(fpath) if os.path.exists(fpath) else None)
+            if got != (int(want["crc32"], 16), int(want["size"])):
+                raise _ckpt.CheckpointCorruptionError(
+                    f"{fpath}: staged bytes disagree with {owner}'s receipt "
+                    f"(receipt says crc32={want['crc32']} "
+                    f"size={want['size']}, file has {got}) — a torn or "
+                    f"replayed stage must not commit")
+        return rec
+
+    def commit_sharded(self, step: int, owners: List[str],
+                       param_meta: Dict[str, dict],
+                       user_data: Optional[dict] = None,
+                       budget: Optional[float] = None,
+                       abort: Optional[Callable[[], bool]] = None):
+        """Phase 2, run by the single committer: wait (bounded) for every
+        owner's receipt over the shared checkpoint filesystem, cross-check
+        each against the staged sidecars, then write metadata + the
+        unified manifest + the atomic COMMIT marker. `abort` lets the
+        caller stop waiting early (an owner died, the roster changed)
+        without burning the whole budget — the attempt stays uncommitted
+        and GC reaps it after the next successful commit."""
+        for o in owners:
+            self._check_owner(o)
+        dl = Deadline(budget if budget is not None
+                      else env_timeout("PT_CKPT_COMMIT_TIMEOUT", 600.0),
+                      f"receipt collection for step-{step}")
+        d = self.gen_dir(step)
+        while True:
+            missing = [o for o in owners
+                       if not os.path.exists(self._receipt_path(d, o))]
+            if not missing:
+                break
+            _fault_site(FP_RECEIPTS, dl,
+                        f"receipt collection for step-{step} "
+                        f"(missing {missing})")
+            if abort is not None and abort():
+                raise CheckpointTimeout(
+                    f"receipt collection for step-{step}",
+                    timeout=dl.timeout,
+                    detail=f"aborted: still missing receipts from {missing}")
+            dl.sleep(0.01)
+        receipts = {o: self._read_receipt(d, o) for o in owners}
+        keys = set()
+        for rec in receipts.values():
+            keys.update(rec.get("keys", ()))
+        self._check_key_coverage(step, keys, param_meta)
+        # files from owners outside this commit (a dead earlier attempt)
+        # must not ride into the manifest: the generation is exactly what
+        # the collected receipts vouch for
+        expected = {MANIFEST, COMMIT, "metadata.json"}
+        for o, rec in receipts.items():
+            expected.add(os.path.basename(self._receipt_path(d, o)))
+            expected.update(rec.get("files", ()))
+        for name in os.listdir(d):
+            if name.endswith(".crc32") or ".tmp." in name:
+                continue
+            if name not in expected:
+                for p in (os.path.join(d, name),
+                          os.path.join(d, name) + ".crc32"):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+        params = {n: {"shape": list(rec.get("shape", ())),
+                      "dtype": str(rec.get("dtype", "float32")),
+                      "spec": list(rec.get("spec") or [])}
+                  for n, rec in param_meta.items()}
+        meta = {"format": "paddle_tpu.dist_ckpt.v1", "params": params}
+        _ckpt._atomic_write(os.path.join(d, "metadata.json"),
+                            json.dumps(meta, indent=1,
+                                       sort_keys=True).encode())
+        crashpoint(CP_GEN_STAGED)
+        self._write_manifest(d, step, user_data, layout=SHARDED_LAYOUT)
+        crashpoint(CP_MANIFEST)
+        _ckpt._atomic_write(os.path.join(d, COMMIT),
+                            f"{int(step)}\n".encode())
+        crashpoint(CP_COMMIT)
+        self._gc()
+        crashpoint(CP_GC)
+
+    @staticmethod
+    def _check_key_coverage(step: int, keys, param_meta: Dict[str, dict]):
+        """Every parameter must be fully covered by the staged bricks
+        (volume check over the distinct slice keys — owners stage disjoint
+        bricks). An under-covered commit would only fail at restore time,
+        long after the writers are gone."""
+        vol: Dict[str, int] = {n: 0 for n in param_meta}
+        full = set()
+        for key in keys:
+            name, _, idx = key.rpartition("|")
+            if name not in vol:
+                continue
+            if idx == "full":
+                full.add(name)
+                continue
+            v = 1
+            for part in [p for p in idx.split(",") if p]:
+                lo, hi = part.split(":")
+                v *= max(0, int(hi) - int(lo))
+            vol[name] += v
+        for n, rec in param_meta.items():
+            total = 1
+            for dim in rec.get("shape", ()):  # scalars: empty shape -> 1
+                total *= int(dim)
+            if n in full or vol[n] >= total:
+                continue
+            raise _ckpt.CheckpointCorruptionError(
+                f"step-{step}: parameter {n!r} is under-covered by the "
+                f"staged bricks ({vol[n]}/{total} elements) — refusing to "
+                f"commit a generation that cannot restore")
+
+    def wait_commit(self, step: int, budget: Optional[float] = None,
+                    abort: Optional[Callable[[], bool]] = None):
+        """Non-committer's bounded wait for the COMMIT marker: save()
+        returning implies the generation is visible, same as the gather
+        layout's commit barrier."""
+        dl = Deadline(budget if budget is not None
+                      else env_timeout("PT_CKPT_COMMIT_TIMEOUT", 600.0),
+                      f"COMMIT wait for step-{step}")
+        path = os.path.join(self.gen_dir(step), COMMIT)
+        while not os.path.exists(path):
+            _fault_site(FP_RECEIPTS, dl, f"COMMIT wait for step-{step}")
+            if abort is not None and abort():
+                raise CheckpointTimeout(
+                    f"COMMIT wait for step-{step}", timeout=dl.timeout,
+                    detail="aborted: committer is gone")
+            dl.sleep(0.01)
+
+    def save_sharded(self, step: int, owner: str, owners: List[str],
+                     shards: Dict[str, np.ndarray],
+                     param_meta: Dict[str, dict],
+                     user_data: Optional[dict] = None,
+                     budget: Optional[float] = None,
+                     abort: Optional[Callable[[], bool]] = None,
+                     committer: Optional[str] = None) -> dict:
+        """One owner's whole sharded commit: stage this owner's bricks,
+        then either collect receipts + commit (the committer — by default
+        the lowest owner id) or wait for the marker. Every participant
+        calls this with the SAME owners list; the per-owner staging stats
+        come back for commit accounting."""
+        self.wait()  # staticcheck: ok[unbounded-blocking] — joins OUR async gather writer thread (bounded inside wait() by PT_CKPT_WAIT_TIMEOUT), never a peer
+        stats = self.stage_shards(step, owner, shards, budget=budget)
+        if committer is None:
+            committer = sorted(owners)[0]
+        if owner == committer:
+            self.commit_sharded(step, owners, param_meta,
+                                user_data=user_data, budget=budget,
+                                abort=abort)
+        else:
+            self.wait_commit(step, budget=budget, abort=abort)
+        return stats
 
     # ---- gc ----
     def _gc(self):

@@ -14,6 +14,7 @@ TPU-native semantics (single-controller SPMD):
 """
 from __future__ import annotations
 
+import threading
 from typing import List, Optional
 
 import jax
@@ -609,22 +610,44 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]):
     return tasks
 
 
-_barrier_state = {"store": None, "gen": 0}
+class _BarrierState:
+    """Audited holder for the cross-process barrier's TCPStore client and
+    generation counter (utils/memo idiom: module state lives on a locked
+    instance, never in a module-level dict)."""
+
+    __slots__ = ("_lock", "_store", "_gen")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store = None
+        self._gen = 0
+
+    def store(self):
+        with self._lock:
+            if self._store is None:
+                import os
+
+                from .store import TCPStore
+                ep = os.environ.get("PADDLE_MASTER")
+                if not ep:
+                    return None
+                host, port = ep.rsplit(":", 1)
+                self._store = TCPStore(host, int(port), is_master=False,
+                                       world_size=jax.process_count())
+            return self._store
+
+    def next_gen(self) -> int:
+        with self._lock:
+            self._gen += 1
+            return self._gen
+
+
+_barrier_state = _BarrierState()
 
 
 def _world_store():
     """Lazy TCPStore client to the launcher's rendezvous store."""
-    if _barrier_state["store"] is None:
-        import os
-
-        from .store import TCPStore
-        ep = os.environ.get("PADDLE_MASTER")
-        if not ep:
-            return None
-        host, port = ep.rsplit(":", 1)
-        _barrier_state["store"] = TCPStore(host, int(port), is_master=False,
-                                           world_size=jax.process_count())
-    return _barrier_state["store"]
+    return _barrier_state.store()
 
 
 def barrier(group=None):
@@ -640,8 +663,7 @@ def barrier(group=None):
         st = _world_store()
         if st is not None:
             import time
-            _barrier_state["gen"] += 1
-            key = f"barrier/{_barrier_state['gen']}"
+            key = f"barrier/{_barrier_state.next_gen()}"
             n = st.add(key, 1)
             deadline = time.monotonic() + 300.0
             while n < world:

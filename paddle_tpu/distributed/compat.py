@@ -5,12 +5,14 @@ classes, DistAttr."""
 from __future__ import annotations
 
 import pickle
+import threading
 from typing import List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..utils.memo import LockedLRU
 from . import collective as C
 from .env import get_rank, get_world_size
 
@@ -95,7 +97,9 @@ def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
 
 # ---- distributed split (python/paddle/distributed/collective.py split) ----
 
-_split_layers = {}
+# audited registry (utils/memo idiom), not a bare module dict: split() may be
+# called from fleet worker threads, and the keyspace is bounded by layer names
+_split_layers = LockedLRU(maxsize=None)
 
 
 def split(x, size, operation="linear", axis=0, num_partitions=1,
@@ -129,50 +133,72 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
                                               weight_attr=weight_attr)
         else:
             raise ValueError(f"unknown split operation {operation!r}")
-        _split_layers[key] = layer
+        _split_layers.put(key, layer)
     return layer(x)
 
 
 # ---- gloo rendezvous (reference parallel.py gloo_init_parallel_env):
 # CPU-side barrier service — here the TCPStore plays gloo's role ----
 
-_gloo_store = None
-_gloo_world = 1
+class _GlooState:
+    """Audited holder for the gloo rendezvous store and world size (utils/
+    memo idiom: module state lives on a locked instance, installed/released
+    through named methods instead of `global` rebinds)."""
+
+    __slots__ = ("_lock", "_store", "_world")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store = None
+        self._world = 1
+
+    def install(self, store, world: int):
+        with self._lock:
+            self._store = store
+            self._world = int(world)
+
+    def snapshot(self):
+        with self._lock:
+            return self._store, self._world
+
+    def release(self):
+        with self._lock:
+            store, self._store = self._store, None
+        if store is not None:
+            try:
+                store.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+_gloo = _GlooState()
 
 
 def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
-    global _gloo_store, _gloo_world
     from .store import TCPStore
     host, port = server_endpoint.rsplit(":", 1)
-    _gloo_world = int(rank_num)
-    _gloo_store = TCPStore(host, int(port), is_master=(rank_id == 0),
-                           world_size=rank_num)
+    _gloo.install(TCPStore(host, int(port), is_master=(rank_id == 0),
+                           world_size=rank_num), rank_num)
 
 
 def gloo_barrier():
-    if _gloo_store is None:
+    store, world = _gloo.snapshot()
+    if store is None:
         raise RuntimeError("call gloo_init_parallel_env first")
-    _gloo_store.add("gloo/barrier", 1)
+    store.add("gloo/barrier", 1)
     import time
 
     # size the barrier by the rank_num given to gloo_init_parallel_env — the
     # collective env is typically NOT initialized when the gloo API is used,
     # so get_world_size() would default to 1 and the barrier would no-op
-    world = _gloo_world
     deadline = time.time() + 300
-    while _gloo_store.add("gloo/barrier", 0) % max(world, 1) != 0 \
+    while store.add("gloo/barrier", 0) % max(world, 1) != 0 \
             and time.time() < deadline:
         time.sleep(0.005)
 
 
 def gloo_release():
-    global _gloo_store
-    if _gloo_store is not None:
-        try:
-            _gloo_store.stop()
-        except Exception:  # noqa: BLE001
-            pass
-        _gloo_store = None
+    _gloo.release()
 
 
 # ---- PS-style datasets (reference distributed/fleet/dataset/):

@@ -29,9 +29,11 @@ The supervised loop (one `Supervisor` per worker, stable elastic node id):
    gets the typed `StaleEpoch` — it may not rejoin mid-swap; it re-enters
    through a fresh rendezvous as a joiner, exactly like a grow event.
 3. **swap** — the scale event commits cursor + params as ONE checkpoint
-   generation first (`save_stream_checkpoint` via a gather-plan to the
-   lowest-id survivor: the commit IS a reshard onto a one-owner mesh),
-   then drives the existing ladder to the new mesh: an attached
+   generation first, SHARDED: every valid survivor stages its OWN bricks
+   plus a per-owner receipt and the lowest-id valid member writes the
+   unified manifest + atomic COMMIT marker once every receipt landed
+   (two-phase; O(state/n) bytes per owner instead of a gather onto one
+   node), then drives the existing ladder to the new mesh: an attached
    `TrainStep.reshard(new_mesh)` moves single-controller device state
    (placement-only, bitwise), and `reshard_or_restore_churn` moves the
    cross-process shards — re-planning against survivors when a lease
@@ -64,9 +66,26 @@ resumes the global prefix exactly where the committed generation said,
 with the surviving loss curve changed only by the batch shape it now
 computes.
 
+**Coordinated drain** (``request_stop(leave=True)`` on a watched fleet):
+the departing member announces intent on the store (one counter add at
+the ``supervisor.drain`` site), then participates in the scale event as a
+LIVE member — it stages bricks into the commit, serves as a reshard
+source and passes rung agreement — and revokes its lease only after the
+survivors converged. A graceful leave therefore costs ZERO replayed
+steps and lands in the event log as its own cause (``"drain"`` /
+``"drained"``), typed-distinct from every crash cause.
+
+**Incident forensics**: every scale event (crash OR drain) best-effort
+exports the event record + ``trace.last_incident()`` and the trace ring
+(Chrome JSON) beside the generation directory it rolled to
+(``incident-step<N>-epoch<E>-<node>.json`` under the checkpoint root),
+so elastic events are debuggable after the fact. ``PT_INCIDENT_EXPORT=0``
+disables.
+
 Knobs: ``PT_SUPERVISOR_TIMEOUT`` (cumulative per-event budget, default
 60s), ``PT_SUPERVISE`` (``0`` disables the watch — steps run unsupervised
-and failure signals propagate raw).
+and failure signals propagate raw), ``PT_INCIDENT_EXPORT`` (forensics
+export switch, default on).
 """
 from __future__ import annotations
 
@@ -80,8 +99,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils.deadline import (CommTimeout, Deadline, DeadlineExceeded,
-                              MembershipTimeout, ReshardTimeout, StoreTimeout,
+from ..utils.deadline import (CheckpointTimeout, CommTimeout, Deadline,
+                              DeadlineExceeded, MembershipTimeout,
+                              ReshardTimeout, StoreTimeout,
                               SupervisorTimeout, env_timeout)
 from . import reshard as rs
 from .chaos import faultpoint, register_fault
@@ -105,12 +125,17 @@ FP_SWAP = register_fault(
 FP_RESUME = register_fault(
     "supervisor.resume",
     "loop resume on the new mesh (cursor + bindings)")
+FP_DRAIN = register_fault(
+    "supervisor.drain",
+    "departing member announcing drain intent on the store")
 
 # the typed failure signals a step (or its barrier/commit) can escape
 # with that MAY mean "a peer died" — the detect transition re-checks the
-# lease roster to decide
+# lease roster (and the drain counter) to decide. CheckpointTimeout is
+# the sharded commit's receipt/marker wait giving up on a dead (or
+# draining) stager.
 STEP_SIGNALS = (CommTimeout, ReshardTimeout, StoreTimeout,
-                MembershipTimeout)
+                MembershipTimeout, CheckpointTimeout)
 
 
 class SupervisorError(RuntimeError):
@@ -290,6 +315,20 @@ class Supervisor:
         self._stop_requested = False
         self._leave_on_stop = False
         self.events: List[dict] = []
+        # coordinated-drain bookkeeping: the store-side announcement
+        # counter this worker has already folded into a scale event (a
+        # joiner adopts the current value — drains before its time are
+        # not its events), the set of members known to have DRAINED away
+        # (their lease may linger briefly after the event; it must not
+        # read as fresh churn), and whether THIS worker is the leaver.
+        self._drains_seen = 0
+        self._drains_seen = self._drain_counter()
+        self._drained: set = set()
+        self._leaving = False
+        # per-owner sharded-commit accounting (profiler.supervisor_summary
+        # renders the bytes/wall columns from the event fields)
+        self.commit_stats: List[dict] = []
+        self._last_commit: Optional[dict] = None
 
     # ------------------------------------------------------------------
     # binding
@@ -355,22 +394,47 @@ class Supervisor:
             # commit the STARTING state as a generation before the first
             # step: a member dying before the first per-step commit would
             # otherwise take its exclusive shards somewhere no rollback
-            # rung can reach. Every bound member runs this gather
-            # unconditionally (a latest()-is-None check would race the
-            # committer's in-flight save across members); the committer
-            # skips the save when the boundary is already durable.
-            self._gather_commit(
-                self.mesh, list(self.roster), self.steps_done,
-                Deadline(self.watch_budget, what="initial commit"),
-                tag=f"init{self.epoch}-{self.steps_done}")
+            # rung can reach. Every bound member stages unconditionally
+            # (a latest()-is-None check would race the committer's
+            # in-flight marker across members); _sharded_commit skips
+            # when the boundary is already durable. Wrapped in the same
+            # classifier as the loop body: a peer dying mid-initial-commit
+            # is a scale event, not a raw typed error.
+            try:
+                self._sharded_commit(
+                    self.mesh, list(self.roster), self.steps_done,
+                    Deadline(self.watch_budget, what="initial commit"),
+                    tag=f"init{self.epoch}-{self.steps_done}")
+            except STEP_SIGNALS + (rs.ReshardError,) as e:
+                if not watched:
+                    raise
+                self._classify_step_failure(e)
         while self.steps_done < int(n_steps):
             if self._stop_requested:
+                if (watched and self._leave_on_stop and not self._leaving
+                        and len(self.roster) > 1):
+                    # coordinated drain: announce on the store, then
+                    # participate in the survivors' scale event as the
+                    # LEAVER — the fleet commits a generation with this
+                    # member still present and reshards its bricks away,
+                    # so the graceful leave costs zero replay. A drain
+                    # that cannot CONVERGE falls back to the blunt leave
+                    # below (survivors recover through the crash path);
+                    # a typed deadline error propagates — a wedged
+                    # graceful leave must name its stuck dependency, not
+                    # exit looking clean.
+                    try:
+                        self._drain_and_leave()
+                    except (SupervisorError, rs.ReshardError,
+                            ConnectionError):
+                        self._leaving = False  # blunt leave below
                 break
             try:
                 dl = Deadline(self.watch_budget,
                               what=f"supervised watch @ {self.node_id}")
-                if watched and self._detect(dl):
-                    self._handle_event("lease-lapse")
+                cause = self._detect(dl) if watched else None
+                if cause:
+                    self._handle_event(cause)
                     continue
                 if watched and self.barrier and len(self.roster) > 1:
                     self._step_barrier(dl)
@@ -381,33 +445,46 @@ class Supervisor:
                 self.steps_done += 1
                 if self.ckpt_every > 0 \
                         and self.steps_done % self.ckpt_every == 0:
-                    self._gather_commit(
+                    self._sharded_commit(
                         self.mesh, list(self.roster), self.steps_done,
                         Deadline(self.watch_budget, what="step commit"),
                         tag=f"s{self.epoch}-{self.steps_done}")
             except STEP_SIGNALS + (rs.ReshardError,) as e:
-                # rs.ReshardError covers the per-step gather-commit: a
-                # peer dying right before the commit surfaces there as
-                # ShardLost / a torn exchange
+                # rs.ReshardError / CheckpointTimeout cover the per-step
+                # sharded commit: a peer dying mid-stage surfaces there
+                # as ShardLost or an aborted receipt wait
                 if not watched:
                     raise
-                if self._roster_changed():
-                    self._handle_event(f"typed:{type(e).__name__}")
-                else:
-                    # full roster, genuine infrastructure failure: the
-                    # typed error must reach the operator, not be eaten
-                    # as churn
-                    raise
-        if self._stop_requested and self._leave_on_stop:
-            # leave AFTER the final step's commit: revoking the lease
+                self._classify_step_failure(e)
+        if self._stop_requested and self._leave_on_stop \
+                and not self._leaving:
+            # blunt leave (unwatched fleets, solo member, failed drain):
+            # AFTER the final step's commit — revoking the lease
             # mid-commit would make this member's own bricks unavailable
-            # to the gather it is still participating in
+            # to the commit it is still participating in
             self.elastic.leave()
         return self.state
 
+    def _classify_step_failure(self, e: BaseException) -> None:
+        """A typed failure escaped a step (or its barrier/commit): a
+        pending drain announcement or a changed lease roster makes it a
+        scale event; an intact fleet means a genuine infrastructure
+        failure that must reach the operator, not be eaten as churn."""
+        if self._drains_pending():
+            self._handle_event("drain")
+        elif self._roster_changed():
+            self._handle_event(f"typed:{type(e).__name__}")
+        else:
+            raise e
+
     def request_stop(self, leave: bool = True) -> None:
         """Graceful scale-down: finish the current step, then exit the
-        loop (and revoke the lease, so peers shrink without a timeout)."""
+        loop. With ``leave`` on a watched multi-member fleet this drives
+        the COORDINATED DRAIN — announce on the store, commit a
+        generation with this member still present, reshard its bricks
+        to the survivors, and only then revoke the lease — so peers
+        shrink with zero replayed steps and the event is typed "drain",
+        not a crash."""
         self._stop_requested = True
         self._leave_on_stop = bool(leave)
 
@@ -422,19 +499,40 @@ class Supervisor:
             self._own_store = False
 
     # ---- detection ----
-    def _detect(self, dl: Deadline) -> bool:
+    def _detect(self, dl: Deadline) -> Optional[str]:
+        """Between-steps poll; returns the scale-event cause (``"drain"``
+        / ``"lease-lapse"``) or None. The drain counter is checked FIRST
+        and is one cheap store add — a graceful leave is classified
+        without waiting out any failure-detection deadline."""
         self._ticks += 1
         self._site(FP_DETECT, dl, "supervisor detect poll")
         if self._ticks % self.detect_every:
-            return False
-        return self._roster_changed()
+            return None
+        if self._drains_pending():
+            return "drain"
+        return "lease-lapse" if self._roster_changed() else None
+
+    def _drain_counter(self) -> int:
+        try:
+            return int(self._sup_store.add(f"{self.ns}/drainn", 0))
+        except STEP_SIGNALS + (ConnectionError,):
+            return self._drains_seen
+
+    def _drains_pending(self) -> bool:
+        return self._drain_counter() > self._drains_seen
 
     def _roster_changed(self) -> bool:
         try:
             alive = set(self.elastic.alive_members())
         except STEP_SIGNALS:
             return False  # can't read the roster: not evidence of churn
-        return alive != set(self.roster)
+        # a member that DRAINED away may hold a live lease for a little
+        # while after the event (it revokes only once the survivors'
+        # rendezvous converged) — that lingering lease is not churn. The
+        # mask self-prunes on lease expiry, so the same id re-joining
+        # later is detected as a fresh grow event.
+        self._drained &= alive
+        return (alive - self._drained) != set(self.roster)
 
     def _step_barrier(self, dl: Deadline) -> None:
         """All roster members must reach step boundary `steps_done` before
@@ -457,6 +555,14 @@ class Supervisor:
                     self._sup_store.wait(f"{key}/{peer}", timeout=slice_t)
                     break
                 except (StoreTimeout, DeadlineExceeded) as e:
+                    if self._drains_pending():
+                        # the missing peer is (or follows) a DRAINING
+                        # member already in the scale event's rendezvous:
+                        # classify now instead of waiting out the budget
+                        raise StoreTimeout(
+                            f"step barrier {self.steps_done}", slice_t,
+                            detail=f"peer {peer!r} missed the barrier "
+                                   f"with a drain announced") from e
                     if self._roster_changed():
                         raise StoreTimeout(
                             f"step barrier {self.steps_done}", slice_t,
@@ -525,10 +631,23 @@ class Supervisor:
                       what=f"supervisor event @ {self.node_id}")
         self._site(FP_DETECT, dl, "scale-event classification")
         detect_latency = time.perf_counter() - t0
+        self._last_commit = None
+        # announcements up to here are folded into THIS event (a draining
+        # member announces immediately before entering the same epoch's
+        # rendezvous, where its payload carries the leaving flag); later
+        # announcements stay pending for the next detect poll
+        drains_at_entry = self._drain_counter()
         while True:
             survivors, infos = self._rendezvous(dl)
+            leaving = sorted(m for m in survivors
+                             if infos[m].get("leaving"))
+            staying = [m for m in survivors if m not in leaving]
+            if not staying:
+                raise SupervisorError(
+                    "every rendezvous participant is draining — no "
+                    "surviving mesh to hand the state to")
             new_mesh = MeshSpec.from_members(
-                survivors, self._mesh_shape(len(survivors)))
+                staying, self._mesh_shape(len(staying)))
             try:
                 out, how, gen, steps, cursor, moved = \
                     self._swap(new_mesh, infos, dl)
@@ -552,9 +671,100 @@ class Supervisor:
             # post-swap roster re-check here would let one survivor
             # resume while another re-converges against a stale roster
             # (fleet split), so resume unconditionally.
+            if self._leaving:
+                # the LEAVER: the survivors converged, the commit barrier
+                # passed (its bricks are durable in the committed
+                # generation) and the ladder moved its live shards to the
+                # stayers — record the typed drain event, export the
+                # forensics bundle, revoke the lease, exit the loop.
+                self._drain_exit(new_mesh, gen, steps, moved,
+                                 detect_latency, t0)
+                return
+            self._drains_seen = max(self._drains_seen, drains_at_entry)
+            self._drained |= set(leaving)
             self._resume(new_mesh, out, how, gen, steps, cursor, cause,
                          detect_latency, t0, moved, dl)
             return
+
+    # ---- coordinated drain ----
+    def _drain_and_leave(self) -> None:
+        """The leaver's half of the coordinated drain: announce intent on
+        the store (the ``supervisor.drain`` chaos site — one counter add,
+        so survivors classify the event from a cheap poll instead of
+        waiting out a barrier/lease deadline), then participate in the
+        scale event as a LIVE member — stage bricks into the commit,
+        serve as a reshard source, pass rung agreement — and only then
+        revoke the lease and exit (inside `_handle_event`)."""
+        dl = Deadline(self.budget,
+                      what=f"coordinated drain @ {self.node_id}")
+        self._site(FP_DRAIN, dl, "drain announcement")
+        for attempt in (0, 1):
+            try:
+                self._sup_store.add(f"{self.ns}/drainn", 1)
+                break
+            except ConnectionError:
+                if attempt:
+                    raise
+        self._leaving = True
+        self._handle_event("drain")
+
+    def _drain_exit(self, new_mesh: MeshSpec, gen, steps: int,
+                    moved: int, detect_latency: float,
+                    t0: float) -> None:
+        """Leaver's bookkeeping after the survivors converged: the typed
+        "drained" event (distinct from every crash cause), the forensics
+        bundle, key GC, lease revocation. Zero replayed steps: the event
+        rode a live rung, so the survivors' step count never moved."""
+        event = {
+            "node": self.node_id, "epoch": self.epoch, "cause": "drain",
+            "how": "drained", "generation": gen, "steps": int(steps),
+            "roster": list(new_mesh.owners),
+            "old_size": len(self.roster), "new_size": len(new_mesh.owners),
+            "bytes_moved": int(moved),
+            "detect_latency_s": float(detect_latency),
+            "downtime_s": time.perf_counter() - t0,
+            "state_sha": None,  # the leaver hands its state away
+            "cursor_pos": (int(self.stream.pos)
+                           if self.stream is not None else None),
+            "commit_bytes": (self._last_commit or {}).get("bytes"),
+            "commit_wall_s": (self._last_commit or {}).get("wall_s"),
+        }
+        self.events.append(event)
+        _register_event(event)
+        self._export_forensics(event)
+        self._gc_rendezvous_keys()
+        self.elastic.leave()
+        self._leave_on_stop = False  # the lease is already revoked
+        self._stop_requested = True
+
+    # ---- incident forensics ----
+    def _export_forensics(self, event: dict) -> None:
+        """Best-effort post-event export beside the generation directory
+        the event rolled to: the event record + `trace.last_incident()`
+        (the typed-deadline postmortem, when one fired) as one JSON, plus
+        the trace ring as Chrome trace-event JSON. File names do not
+        match the ``step-<N>`` generation pattern, so the checkpoint
+        scanner never confuses forensics with state. PT_INCIDENT_EXPORT=0
+        disables. Export failures are swallowed — forensics must never
+        fail the resume that is trying to keep the fleet alive."""
+        if os.environ.get("PT_INCIDENT_EXPORT", "1").strip().lower() in (
+                "0", "false", "off"):
+            return
+        try:
+            from ..observability import trace
+            tag = (f"incident-step{event.get('generation')}"
+                   f"-epoch{event['epoch']}-{self.node_id}")
+            path = os.path.join(self.ckpt.root, f"{tag}.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"event": event,
+                           "incident": trace.last_incident()},
+                          f, indent=1, sort_keys=True, default=str)
+            os.replace(tmp, path)
+            trace.export_trace(os.path.join(self.ckpt.root,
+                                            f"{tag}.trace.json"))
+        except Exception:  # noqa: BLE001 — forensics are advisory
+            pass
 
     # ---- rendezvous ----
     def _rendezvous(self, dl: Deadline):
@@ -599,6 +809,7 @@ class Supervisor:
             payload = json.dumps({
                 "view": alive,
                 "valid": bool(self._has_state),
+                "leaving": bool(self._leaving),
                 "roster": list(self.roster),
                 "steps": int(self.steps_done),
                 "cursor": (self.stream.state_dict()
@@ -753,6 +964,122 @@ class Supervisor:
                     self.ckpt.save(full, steps)
         return int(steps)
 
+    def _local_bricks(self, src_mesh: MeshSpec,
+                      valid: List[str]) -> Dict[str, np.ndarray]:
+        """This owner's slice-keyed bricks of the live state, dedup'd
+        across replicas: of the valid owners holding an IDENTICAL brick
+        (replicated layouts, size-1 axes), only the lowest id stages it —
+        every brick lands exactly once and every parameter stays fully
+        covered (the recoverability pre-check guarantees a live holder
+        for every brick before anyone stages)."""
+        bricks: Dict[str, np.ndarray] = {}
+        for name, p in self.params.items():
+            idx = rs.shard_index(p.shape, p.spec, src_mesh, self.node_id)
+            holders = [m for m in valid if m in src_mesh.owners and
+                       rs.shard_index(p.shape, p.spec, src_mesh, m) == idx]
+            if holders and min(holders) != self.node_id:
+                continue
+            if all(lo == 0 and hi == d
+                   for (lo, hi), d in zip(idx, p.shape)):
+                key = f"{name}|full"
+            else:
+                key = name + "|" + ",".join(f"{lo}:{hi}"
+                                            for lo, hi in idx)
+            bricks[key] = np.asarray(self.state[name])
+        return bricks
+
+    def _brick_stagers(self, src_mesh: MeshSpec,
+                       valid: List[str]) -> List[str]:
+        """The owners that stage at least one brick under the dedup rule
+        — every member derives the SAME list from (params, mesh, valid),
+        so the committer never waits for a receipt from an owner whose
+        bricks are all duplicates of a lower id (e.g. fully replicated
+        state: only the lowest valid owner stages)."""
+        stagers = set()
+        for name, p in self.params.items():
+            seen: Dict[tuple, str] = {}
+            for m in sorted(valid):
+                if m not in src_mesh.owners:
+                    continue
+                idx = rs.shard_index(p.shape, p.spec, src_mesh, m)
+                if idx not in seen:
+                    seen[idx] = m
+            stagers.update(seen.values())
+        return sorted(stagers) if stagers else sorted(valid)[:1]
+
+    def _stagers_lost(self, valid: List[str]) -> bool:
+        """Abort hook for the sharded commit's receipt/marker waits: a
+        commit participant losing its lease mid-stage means its receipt
+        will never land — stop waiting NOW (typed CheckpointTimeout) and
+        let the classifier turn it into a scale event, instead of burning
+        the whole commit budget on a dead peer."""
+        try:
+            alive = set(self.elastic.alive_members())
+        except STEP_SIGNALS:
+            return False
+        return not set(valid) <= alive
+
+    def _sharded_commit(self, src_mesh: MeshSpec, valid: List[str],
+                        steps: int, dl: Deadline, tag: str) -> int:
+        """Commit the fleet's live state + cursor as ONE sharded
+        generation: every valid owner stages its OWN bricks + per-owner
+        receipt concurrently — O(state/n) bytes written per owner instead
+        of the gather's O(state) onto one node — and the lowest-id valid
+        member turns the collected receipts into the unified manifest +
+        atomic COMMIT marker (the ckpt_manager two-phase protocol; a
+        death at any point leaves the previous committed generation or a
+        complete new one). Same recoverability pre-check and `ShardLost`
+        contract as `_gather_commit`, which is kept as the bench
+        baseline. Returns the committed generation step."""
+        committer = sorted(valid)[0]
+        specs = self._param_specs()
+        gplan = plan_reshard(src_mesh, MeshSpec.from_members([committer]),
+                             specs, available=set(valid))
+        if not gplan.recoverable_from_peers:
+            raise rs.ShardLost(
+                f"sharded-commit {tag}: live bytes lost with a dead "
+                f"owner — rolling back to the last committed generation")
+        latest = self.ckpt.latest()
+        if latest is not None and latest >= steps:
+            # the boundary is already durable (a restarted fleet or a
+            # re-entered event at the same step): never stage into a
+            # committed generation. Commits are fleet-synchronized —
+            # save_sharded returns only after COMMIT is visible — so
+            # every member sees the same answer here.
+            return int(steps)
+        param_meta = {n: {"shape": list(p.shape),
+                          "dtype": np.dtype(p.dtype).name,
+                          "spec": list(p.spec)}
+                      for n, p in self.params.items()}
+        stagers = self._brick_stagers(src_mesh, valid)
+        abort = lambda: self._stagers_lost(stagers)  # noqa: E731
+        from ..observability import trace
+        with trace.span("ckpt.sharded_commit", epoch=self.epoch,
+                        node=self.node_id, step=int(steps)):
+            if self.node_id not in stagers:
+                # every brick this owner holds is a duplicate of a lower
+                # id's: participate in the commit barrier only
+                self.ckpt.wait_commit(int(steps),
+                                      budget=dl.remaining(floor=0.1),
+                                      abort=abort)
+                return int(steps)
+            bricks = self._local_bricks(src_mesh, valid)
+            if self.stream is not None:
+                from ..io.streaming import save_stream_sharded
+                stats = save_stream_sharded(
+                    self.ckpt, int(steps), self.node_id, stagers,
+                    bricks, param_meta, self.stream,
+                    budget=dl.remaining(floor=0.1), abort=abort)
+            else:
+                stats = self.ckpt.save_sharded(
+                    int(steps), self.node_id, stagers, bricks,
+                    param_meta, budget=dl.remaining(floor=0.1),
+                    abort=abort)
+        stats = dict(stats, owner=self.node_id, step=int(steps), tag=tag)
+        self.commit_stats.append(stats)
+        self._last_commit = stats
+        return int(steps)
+
     def _swap(self, new_mesh: MeshSpec, infos: Dict[str, dict],
               dl: Deadline):
         """One mesh swap at the (already converged) epoch: commit, ladder,
@@ -789,18 +1116,18 @@ class Supervisor:
         live_cursor = next((infos[m]["cursor"] for m in valid
                             if infos[m]["cursor"] is not None), None)
 
-        # ---- 1. commit cursor+params as ONE generation (satellite) ----
-        # Every VALID member runs the gather unconditionally — the
-        # decision "is this boundary already durable?" belongs to the
-        # committer alone (inside _gather_commit), because a per-node
-        # latest() check could race the committer's in-flight save and
-        # split the fleet between gathering and skipping.
+        # ---- 1. commit cursor+params as ONE generation ----
+        # Every VALID member stages its own bricks (the sharded
+        # two-phase commit); the lowest-id valid member collects the
+        # receipts and writes the atomic COMMIT marker. save_sharded
+        # doubles as the commit barrier: nobody proceeds to the ladder
+        # until the generation is durably visible.
         rollback = False
         gen: Optional[int] = None
         if self.node_id in valid:
             try:
-                gen = self._gather_commit(old_mesh, valid, steps, dl,
-                                          tag=f"g{self.epoch}")
+                gen = self._sharded_commit(old_mesh, valid, steps, dl,
+                                           tag=f"g{self.epoch}")
             except rs.ShardLost:
                 rollback = True
                 gen = self.ckpt.latest()
@@ -924,9 +1251,14 @@ class Supervisor:
             "state_sha": _state_sha(self.state),
             "cursor_pos": (int(self.stream.pos)
                            if self.stream is not None else None),
+            # per-owner sharded-commit accounting (None when the event
+            # rolled back without this owner staging, e.g. ShardLost)
+            "commit_bytes": (self._last_commit or {}).get("bytes"),
+            "commit_wall_s": (self._last_commit or {}).get("wall_s"),
         }
         self.events.append(event)
         _register_event(event)
+        self._export_forensics(event)
 
 
 # ---------------------------------------------------------------------------

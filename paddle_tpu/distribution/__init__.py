@@ -6,7 +6,8 @@ built from jax.numpy/jax.scipy so they differentiate and trace under jit like
 every other op.
 """
 from .distribution import Distribution, ExponentialFamily  # noqa: F401
-from .normal import Normal, LogNormal  # noqa: F401
+from .normal import Normal  # noqa: F401
+from .lognormal import LogNormal  # noqa: F401
 from .uniform import Uniform  # noqa: F401
 from .bernoulli import Bernoulli, ContinuousBernoulli  # noqa: F401
 from .categorical import Categorical, Multinomial  # noqa: F401

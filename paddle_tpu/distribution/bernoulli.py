@@ -21,7 +21,7 @@ class Bernoulli(ExponentialFamily):
 
     @property
     def variance(self):
-        return _wrap(lambda p: p * (1 - p), self.probs, op_name="bernoulli_var")
+        return _wrap(lambda p: p * (1 - p), self.probs, op_name="bernoulli_variance")
 
     def sample(self, shape=()):
         key = self._key()
@@ -84,7 +84,7 @@ class ContinuousBernoulli(Distribution):
             p_safe = jnp.where(near, 0.25, p)
             m = p_safe / (2 * p_safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * p_safe))
             return jnp.where(near, 0.5, m)
-        return _wrap(f, self.probs, op_name="cb_mean")
+        return _wrap(f, self.probs, op_name="bernoulli_mean")
 
     def rsample(self, shape=()):
         key = self._key()
@@ -97,7 +97,7 @@ class ContinuousBernoulli(Distribution):
             x = (jnp.log1p(u * (2 * p_safe - 1) / (1 - p_safe))
                  / (jnp.log(p_safe) - jnp.log1p(-p_safe)))
             return jnp.where(near, u, x)
-        return _wrap(f, self.probs, op_name="cb_rsample")
+        return _wrap(f, self.probs, op_name="bernoulli_rsample")
 
     def sample(self, shape=()):
         return self.rsample(shape).detach()
@@ -107,4 +107,4 @@ class ContinuousBernoulli(Distribution):
         return _wrap(
             lambda v, p: v * jnp.log(jnp.clip(p, _EPS, 1))
             + (1 - v) * jnp.log(jnp.clip(1 - p, _EPS, 1)) + self._log_norm(p),
-            value, self.probs, op_name="cb_log_prob")
+            value, self.probs, op_name="bernoulli_log_prob")

@@ -23,7 +23,7 @@ class Beta(ExponentialFamily):
     @property
     def variance(self):
         return _wrap(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
-                     self.alpha, self.beta, op_name="beta_var")
+                     self.alpha, self.beta, op_name="beta_variance")
 
     def rsample(self, shape=()):
         key = self._key()

@@ -23,7 +23,7 @@ class Binomial(Distribution):
     @property
     def variance(self):
         return _wrap(lambda p: self.total_count * p * (1 - p), self.probs,
-                     op_name="binomial_var")
+                     op_name="binomial_variance")
 
     def sample(self, shape=()):
         key = self._key()

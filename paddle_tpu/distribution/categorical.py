@@ -55,7 +55,7 @@ class Categorical(Distribution):
         return _wrap(
             lambda a, b: jnp.sum(self._probs_fn(a) * (
                 jnp.log(self._probs_fn(a)) - jnp.log(other._probs_fn(b))), -1),
-            self.logits, other.logits, op_name="categorical_kl")
+            self.logits, other.logits, op_name="categorical_kl_divergence")
 
 
 class Multinomial(Distribution):

@@ -24,7 +24,7 @@ class Dirichlet(ExponentialFamily):
             a0 = jnp.sum(c, -1, keepdims=True)
             m = c / a0
             return m * (1 - m) / (a0 + 1)
-        return _wrap(f, self.concentration, op_name="dirichlet_var")
+        return _wrap(f, self.concentration, op_name="dirichlet_variance")
 
     def rsample(self, shape=()):
         key = self._key()

@@ -60,7 +60,7 @@ class Distribution:
 
     def prob(self, value):
         lp = self.log_prob(value)
-        return _wrap(jnp.exp, lp, op_name="dist_prob")
+        return _wrap(jnp.exp, lp, op_name="distribution_prob")
 
     def entropy(self):
         raise NotImplementedError

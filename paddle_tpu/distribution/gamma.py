@@ -24,7 +24,7 @@ class Gamma(ExponentialFamily):
     @property
     def variance(self):
         return _wrap(lambda a, b: a / (b * b), self.concentration, self.rate,
-                     op_name="gamma_var")
+                     op_name="gamma_variance")
 
     def rsample(self, shape=()):
         key = self._key()

@@ -22,12 +22,12 @@ class Geometric(Distribution):
     @property
     def variance(self):
         return _wrap(lambda p: (1 - p) / (p * p), self.probs,
-                     op_name="geometric_var")
+                     op_name="geometric_variance")
 
     @property
     def stddev(self):
         return _wrap(lambda p: jnp.sqrt(1 - p) / p, self.probs,
-                     op_name="geometric_std")
+                     op_name="geometric_stddev")
 
     def sample(self, shape=()):
         key = self._key()

@@ -26,12 +26,12 @@ class Gumbel(Distribution):
     @property
     def variance(self):
         return _wrap(lambda s: (math.pi ** 2 / 6) * s * s, self.scale,
-                     op_name="gumbel_var")
+                     op_name="gumbel_variance")
 
     @property
     def stddev(self):
         return _wrap(lambda s: (math.pi / math.sqrt(6)) * s, self.scale,
-                     op_name="gumbel_std")
+                     op_name="gumbel_stddev")
 
     def rsample(self, shape=()):
         key = self._key()

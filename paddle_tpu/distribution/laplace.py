@@ -22,11 +22,11 @@ class Laplace(Distribution):
 
     @property
     def variance(self):
-        return _wrap(lambda s: 2 * s * s, self.scale, op_name="laplace_var")
+        return _wrap(lambda s: 2 * s * s, self.scale, op_name="laplace_variance")
 
     @property
     def stddev(self):
-        return _wrap(lambda s: math.sqrt(2) * s, self.scale, op_name="laplace_std")
+        return _wrap(lambda s: math.sqrt(2) * s, self.scale, op_name="laplace_stddev")
 
     def rsample(self, shape=()):
         key = self._key()

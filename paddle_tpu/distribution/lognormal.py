@@ -1,0 +1,50 @@
+"""LogNormal — analog of python/paddle/distribution/lognormal.py.
+
+Split out of normal.py so the dispatched op names carry the module-
+qualified public spelling (`lognormal_variance` is LogNormal.variance
+reached through this module) — the registry-consistency battery route.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _wrap
+from .normal import Normal
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(batch_shape=self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return _wrap(lambda l, s: jnp.exp(l + s * s / 2), self.loc, self.scale,
+                     op_name="lognormal_mean")
+
+    @property
+    def variance(self):
+        return _wrap(lambda l, s: (jnp.exp(s * s) - 1) * jnp.exp(2 * l + s * s),
+                     self.loc, self.scale, op_name="lognormal_variance")
+
+    def rsample(self, shape=()):
+        base = self._base.rsample(shape)
+        return _wrap(jnp.exp, base, op_name="lognormal_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _wrap(
+            lambda v, l, s: -((jnp.log(v) - l) ** 2) / (2 * s ** 2)
+            - jnp.log(v * s) - 0.5 * math.log(2 * math.pi),
+            value, self.loc, self.scale, op_name="lognormal_log_prob")
+
+    def entropy(self):
+        return _wrap(
+            lambda l, s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) + l,
+                self._batch_shape),
+            self.loc, self.scale, op_name="lognormal_entropy")

@@ -45,7 +45,7 @@ class MultivariateNormal(Distribution):
     @property
     def variance(self):
         return _wrap(lambda c: jnp.diagonal(c, axis1=-2, axis2=-1),
-                     self.covariance_matrix, op_name="mvn_var")
+                     self.covariance_matrix, op_name="multivariate_normal_variance")
 
     def rsample(self, shape=()):
         key = self._key()
@@ -54,7 +54,7 @@ class MultivariateNormal(Distribution):
             lambda l, L: l + jnp.einsum(
                 "...ij,...j->...i", L,
                 jax.random.normal(key, out_shape, jnp.float32)),
-            self.loc, self.scale_tril, op_name="mvn_rsample")
+            self.loc, self.scale_tril, op_name="multivariate_normal_rsample")
 
     def log_prob(self, value):
         value = _t(value)
@@ -68,11 +68,11 @@ class MultivariateNormal(Distribution):
             maha = jnp.sum(sol * sol, -1)
             logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
             return -0.5 * (d * math.log(2 * math.pi) + logdet + maha)
-        return _wrap(f, value, self.loc, self.scale_tril, op_name="mvn_log_prob")
+        return _wrap(f, value, self.loc, self.scale_tril, op_name="multivariate_normal_log_prob")
 
     def entropy(self):
         def f(L):
             d = L.shape[-1]
             logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
             return 0.5 * (d * (1 + math.log(2 * math.pi)) + logdet)
-        return _wrap(f, self.scale_tril, op_name="mvn_entropy")
+        return _wrap(f, self.scale_tril, op_name="multivariate_normal_entropy")

@@ -1,5 +1,6 @@
-"""Normal / LogNormal — analog of python/paddle/distribution/normal.py,
-lognormal.py."""
+"""Normal — analog of python/paddle/distribution/normal.py.
+
+LogNormal lives in lognormal.py (import kept here for compatibility)."""
 from __future__ import annotations
 
 import math
@@ -24,7 +25,7 @@ class Normal(ExponentialFamily):
     @property
     def variance(self):
         return _wrap(lambda s: jnp.broadcast_to(s * s, self._batch_shape),
-                     self.scale, op_name="normal_var")
+                     self.scale, op_name="normal_variance")
 
     @property
     def stddev(self):
@@ -65,38 +66,4 @@ class Normal(ExponentialFamily):
     def probs(self, value):
         return self.prob(value)
 
-
-class LogNormal(Distribution):
-    def __init__(self, loc, scale, name=None):
-        self.loc = _t(loc)
-        self.scale = _t(scale)
-        self._base = Normal(loc, scale)
-        super().__init__(batch_shape=self._base.batch_shape)
-
-    @property
-    def mean(self):
-        return _wrap(lambda l, s: jnp.exp(l + s * s / 2), self.loc, self.scale,
-                     op_name="lognormal_mean")
-
-    @property
-    def variance(self):
-        return _wrap(lambda l, s: (jnp.exp(s * s) - 1) * jnp.exp(2 * l + s * s),
-                     self.loc, self.scale, op_name="lognormal_var")
-
-    def rsample(self, shape=()):
-        base = self._base.rsample(shape)
-        return _wrap(jnp.exp, base, op_name="lognormal_rsample")
-
-    def log_prob(self, value):
-        value = _t(value)
-        return _wrap(
-            lambda v, l, s: -((jnp.log(v) - l) ** 2) / (2 * s ** 2)
-            - jnp.log(v * s) - 0.5 * math.log(2 * math.pi),
-            value, self.loc, self.scale, op_name="lognormal_log_prob")
-
-    def entropy(self):
-        return _wrap(
-            lambda l, s: jnp.broadcast_to(
-                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) + l,
-                self._batch_shape),
-            self.loc, self.scale, op_name="lognormal_entropy")
+from .lognormal import LogNormal  # noqa: E402,F401  (compat re-export)

@@ -22,14 +22,14 @@ class StudentT(Distribution):
     @property
     def mean(self):
         return _wrap(lambda d, l: jnp.where(d > 1, l, jnp.nan), self.df,
-                     self.loc, op_name="studentt_mean")
+                     self.loc, op_name="student_t_mean")
 
     @property
     def variance(self):
         return _wrap(
             lambda d, s: jnp.where(d > 2, s * s * d / (d - 2),
                                    jnp.where(d > 1, jnp.inf, jnp.nan)),
-            self.df, self.scale, op_name="studentt_var")
+            self.df, self.scale, op_name="student_t_variance")
 
     def rsample(self, shape=()):
         key = self._key()
@@ -39,7 +39,7 @@ class StudentT(Distribution):
         # result-must-equal-shape check. Pass shape= and let df broadcast.
         return _wrap(
             lambda d, l, s: l + s * jax.random.t(key, d, shape=out_shape),
-            self.df, self.loc, self.scale, op_name="studentt_rsample")
+            self.df, self.loc, self.scale, op_name="student_t_rsample")
 
     def log_prob(self, value):
         value = _t(value)
@@ -51,7 +51,7 @@ class StudentT(Distribution):
                     - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
                     - (d + 1) / 2 * jnp.log1p(z * z / d))
         return _wrap(f, value, self.df, self.loc, self.scale,
-                     op_name="studentt_log_prob")
+                     op_name="student_t_log_prob")
 
     def entropy(self):
         def f(d, s):
@@ -59,4 +59,4 @@ class StudentT(Distribution):
             return ((d + 1) / 2 * (dg((d + 1) / 2) - dg(d / 2))
                     + 0.5 * jnp.log(d)
                     + jax.scipy.special.betaln(d / 2, 0.5) + jnp.log(s))
-        return _wrap(f, self.df, self.scale, op_name="studentt_entropy")
+        return _wrap(f, self.df, self.scale, op_name="student_t_entropy")

@@ -43,5 +43,5 @@ class TransformedDistribution(Distribution):
                                                op_name="tdist_acc")
             y = x
         blp = self.base.log_prob(y)
-        return _wrap(jnp.add, blp, lp, op_name="tdist_log_prob") \
+        return _wrap(jnp.add, blp, lp, op_name="transformed_distribution_log_prob") \
             if lp is not None else blp

@@ -22,7 +22,7 @@ class Uniform(Distribution):
     @property
     def variance(self):
         return _wrap(lambda a, b: (b - a) ** 2 / 12, self.low, self.high,
-                     op_name="uniform_var")
+                     op_name="uniform_variance")
 
     def rsample(self, shape=()):
         key = self._key()

@@ -55,7 +55,7 @@ from .dataloader import (DataLoaderWorkerError, _np_collate, _to_tensor_tree)
 
 __all__ = [
     "ShardedSampleStream", "StreamLoader", "save_stream_checkpoint",
-    "restore_stream_checkpoint", "STREAM_CURSOR_KEY",
+    "save_stream_sharded", "restore_stream_checkpoint", "STREAM_CURSOR_KEY",
 ]
 
 STREAM_CURSOR_KEY = "stream_cursor"
@@ -393,6 +393,28 @@ def save_stream_checkpoint(manager, state_dict, step: int,
     crashpoint(CP_CURSOR_STAGED)
     manager.save(state_dict, step, user_data=ud)
     crashpoint(CP_CURSOR_COMMITTED)
+
+
+def save_stream_sharded(manager, step: int, owner: str, owners,
+                        shards, param_meta,
+                        stream: ShardedSampleStream,
+                        user_data: Optional[dict] = None,
+                        budget: Optional[float] = None,
+                        abort=None) -> dict:
+    """Sharded-layout sibling of `save_stream_checkpoint`: this owner's
+    bricks ride `CheckpointManager.save_sharded` and the cursor rides the
+    committer's unified manifest — still ONE generation, ONE atomic
+    COMMIT marker, so state and data position come from the same commit
+    point on every restore. Every owner passes the cursor (the supervisor
+    keeps it mesh-invariant, so all copies agree); only the committer's
+    lands in the manifest. Returns the per-owner staging stats."""
+    ud = dict(user_data or {})
+    ud[STREAM_CURSOR_KEY] = stream.state_dict()
+    crashpoint(CP_CURSOR_STAGED)
+    stats = manager.save_sharded(step, owner, owners, shards, param_meta,
+                                 user_data=ud, budget=budget, abort=abort)
+    crashpoint(CP_CURSOR_COMMITTED)
+    return stats
 
 
 def restore_stream_checkpoint(manager, state_dict,

@@ -306,14 +306,17 @@ def reshard_summary() -> str:
 
 def supervisor_summary() -> str:
     """Elastic-supervisor scale events (distributed/supervisor.py) as
-    text: per event the supervision epoch, the failure cause (lease
-    lapse, a typed timeout escaping a step, a missed barrier, a join),
-    the mesh transition, the ladder rung the swap landed on, the
-    generation it committed/rolled to, detect latency, total downtime and
-    wire bytes moved. A healthy elastic fleet shows `reshard` rungs whose
-    downtime sits near the detect latency plus the transfer time;
-    recurring `full-restore` rungs mean live bytes keep dying with their
-    exclusive owner — shard the state wider or commit more often."""
+    text: per event the supervision epoch, the cause — a coordinated
+    ``drain`` typed-distinct from every crash cause (lease lapse, a
+    typed timeout escaping a step, a missed barrier, a join) — the mesh
+    transition, the ladder rung the swap landed on, the generation it
+    committed/rolled to, detect latency, total downtime, wire bytes
+    moved, and this owner's sharded-commit bytes/wall (the per-owner
+    O(state/n) stage the two-phase commit buys over a one-node gather).
+    A healthy elastic fleet shows `reshard` rungs whose downtime sits
+    near the detect latency plus the transfer time; recurring
+    `full-restore` rungs mean live bytes keep dying with their exclusive
+    owner — shard the state wider or commit more often."""
     supervisor = _subsystem("paddle_tpu.distributed.supervisor")
     if supervisor is None:
         return _no_data("supervisor")
@@ -321,17 +324,24 @@ def supervisor_summary() -> str:
     events = supervisor.supervisor_events()
     if not events:
         return "supervisor: no scale events"
+    drains = sum(1 for e in events if str(e.get("cause")) == "drain")
     head = (f"{'Epoch':>5} {'Cause':<18} {'Mesh':<10} {'Rung':<16} "
-            f"{'Gen':>5} {'Detect':>8} {'Downtime':>9} {'Moved':>12}")
-    lines = [f"supervisor: {len(events)} scale event(s)", head,
-             "-" * len(head)]
+            f"{'Gen':>5} {'Detect':>8} {'Downtime':>9} {'Moved':>12} "
+            f"{'CommitB':>10} {'Commit':>9}")
+    lines = [f"supervisor: {len(events)} scale event(s) "
+             f"({drains} drain, {len(events) - drains} crash/other)",
+             head, "-" * len(head)]
     for e in events:
         mesh = f"{e['old_size']}->{e['new_size']}"
+        cb = e.get("commit_bytes")
+        cw = e.get("commit_wall_s")
         lines.append(
             f"{e['epoch']:>5} {str(e['cause'])[:18]:<18} {mesh:<10} "
             f"{e['how']:<16} {str(e['generation']):>5} "
             f"{e['detect_latency_s']:>7.3f}s {e['downtime_s']:>8.3f}s "
-            f"{e['bytes_moved']:>12}")
+            f"{e['bytes_moved']:>12} "
+            f"{(str(cb) if cb is not None else '-'):>10} "
+            f"{(f'{cw:.3f}s' if cw is not None else '-'):>9}")
     return "\n".join(lines)
 
 

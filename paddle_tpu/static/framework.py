@@ -21,6 +21,8 @@ static op world (paddle/fluid/operators/) for this.
 from __future__ import annotations
 
 import contextlib
+import itertools
+import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -39,7 +41,9 @@ __all__ = [
 ]
 
 _static_mode = False
-_name_counter = [0]
+# monotone name sequence: itertools.count.__next__ is atomic under the GIL,
+# so unique names stay unique without a module-level mutable container
+_name_counter = itertools.count(1)
 # placeholder extents for dynamic dims during shape inference; inferring with
 # TWO distinct extents and diffing the results propagates dynamic-ness through
 # ops (the role InferMeta's -1 propagation plays in the reference,
@@ -49,8 +53,7 @@ _DYN_PLACEHOLDER_B = 3
 
 
 def _unique_name(prefix: str) -> str:
-    _name_counter[0] += 1
-    return f"{prefix}_{_name_counter[0]}"
+    return f"{prefix}_{next(_name_counter)}"
 
 
 class Variable(Tensor):
@@ -253,30 +256,49 @@ class CompiledProgram:
         self.build_strategy = build_strategy
 
 
-_default_main = Program()
-_default_startup = Program()
-_guard_stack: List[tuple] = []
+class _ProgramDefaults:
+    """Audited holder for the ambient default programs (utils/memo idiom:
+    module state lives on a locked instance; program_guard swaps through
+    push/pop instead of `global` rebinds)."""
+
+    __slots__ = ("_lock", "main", "startup")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.main = Program()
+        self.startup = Program()
+
+    def push(self, main: Program, startup: Optional[Program]):
+        with self._lock:
+            prev = (self.main, self.startup)
+            self.main = main
+            if startup is not None:
+                self.startup = startup
+            return prev
+
+    def pop(self, prev):
+        with self._lock:
+            self.main, self.startup = prev
+
+
+_defaults = _ProgramDefaults()
 
 
 def default_main_program() -> Program:
-    return _default_main
+    return _defaults.main
 
 
 def default_startup_program() -> Program:
-    return _default_startup
+    return _defaults.startup
 
 
 @contextlib.contextmanager
 def program_guard(main_program: Program, startup_program: Optional[Program] = None):
-    global _default_main, _default_startup
-    prev = (_default_main, _default_startup)
-    _default_main = main_program
-    if startup_program is not None:
-        _default_startup = startup_program
+    prev = _defaults.push(main_program, startup_program)
     try:
         yield
     finally:
-        _default_main, _default_startup = prev
+        _defaults.pop(prev)
 
 
 # ---------------------------------------------------------------------------
@@ -300,7 +322,7 @@ def _recorder(jax_fn, args, static_kwargs, name):
     input is a symbolic Variable; otherwise fall through to eager."""
     if not _static_mode or not any(_is_var(a) for a in args):
         return NotImplemented
-    prog = _default_main
+    prog = _defaults.main
     block = prog.global_block()
 
     tmpl = []
@@ -389,7 +411,7 @@ def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
     per-shape caching to_static uses). Reading `.shape` on a dynamic dim
     returns -1 (the reference's static-graph convention)."""
     v = Variable(shape, dtype, name=name,
-                 block=_default_main.global_block(), is_data=True,
+                 block=_defaults.main.global_block(), is_data=True,
                  stop_gradient=True)
     v.block.vars[v.name] = v
     return v
@@ -397,7 +419,7 @@ def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
 
 def append_backward_and_update(loss: Variable, optimizer) -> None:
     """Record minimize(): called by Optimizer.minimize under static mode."""
-    prog = _default_main
+    prog = _defaults.main
     names = []
     for p in optimizer._params:
         if p.stop_gradient:

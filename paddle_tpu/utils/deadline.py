@@ -121,6 +121,14 @@ class SupervisorTimeout(DeadlineExceeded):
     surviving fleet."""
 
 
+class CheckpointTimeout(DeadlineExceeded):
+    """A sharded generation commit ran out of budget: a staging owner died
+    (or wedged) before its receipt landed, or the COMMIT marker never
+    appeared within the committer's bound (distributed/ckpt_manager.py).
+    The generation stays uncommitted — readers keep resolving the previous
+    committed one, and GC reaps the partial stage."""
+
+
 class MembershipTimeout(DeadlineExceeded):
     """The elastic membership never reached the required size within the
     budget (ElasticManager.require_np) — the typed form of wait_for_np's

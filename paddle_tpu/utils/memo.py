@@ -9,8 +9,14 @@ audited container: state lives on class instances (never on module-level
 dicts), every write happens under the instance lock, and the call sites
 stay declarative. Users today: the compiled-op dispatch cache
 (paddle_tpu/ops/_op_cache.py), the logger registry (utils/log.py), the
-KL-divergence dispatch table (distribution/kl.py), and dispatch's lazy AMP
-hook import (ops/dispatch.py).
+KL-divergence dispatch table (distribution/kl.py), dispatch's lazy AMP
+hook import (ops/dispatch.py), and the distributed split-layer registry
+(distributed/compat.py). The same idiom — state on a locked instance with
+named methods, never `global` rebinds — also carries the checkpoint async
+writer (distributed/checkpoint.py), the collective barrier store
+(distributed/collective.py), the gloo rendezvous store
+(distributed/compat.py), and the static-mode program defaults
+(static/framework.py).
 """
 from __future__ import annotations
 

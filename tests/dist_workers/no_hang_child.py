@@ -62,6 +62,69 @@ def main(site: str) -> None:
                 jnp.ones((2048,), jnp.float32), owner="no-hang-child",
                 budget=BUDGET)
         assert out.shape == (2048,)
+    elif site == "supervisor.drain":
+        import threading
+        import numpy as np
+        from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+        from paddle_tpu.distributed.launch.elastic import ElasticManager
+        from paddle_tpu.distributed.store import create_master_store
+        from paddle_tpu.distributed.supervisor import (Supervisor,
+                                                       SupervisedParam)
+        from paddle_tpu.io import ShardedSampleStream
+
+        # a COORDINATED drain: two real supervisors in lockstep (step
+        # barrier ON, short slices so a's barrier wait re-checks the
+        # drain counter fast), member b announces its departure (the
+        # armed site) and leaves through its own farewell rendezvous
+        # while a absorbs the shrink. A stalled announcement must burn
+        # b's drain Deadline into the typed SupervisorTimeout — never
+        # wedge either member.
+        store = create_master_store()
+        shards = [[np.full((2,), 10 * s + i, np.float32) for i in range(4)]
+                  for s in range(3)]
+        mgrs, sups, errors, threads = {}, {}, {}, {}
+        for n in ("a", "b"):
+            mgrs[n] = ElasticManager(store, node_id=n, np_range=(1, 2),
+                                     heartbeat_interval=0.1, timeout=0.5)
+            sups[n] = Supervisor(
+                store=store, elastic=mgrs[n],
+                ckpt=CheckpointManager(os.path.join(os.getcwd(), "ckpt")),
+                params={"w": SupervisedParam((4,), np.float32, (None,))},
+                state={"w": np.ones((4,), np.float32)},
+                stream=ShardedSampleStream(shards, seed=0),
+                batch_size=2, budget=BUDGET, watch_budget=BUDGET,
+                barrier=True, barrier_timeout=0.2, ckpt_every=1,
+                churn_probe=0.2)
+
+        def member(n):
+            def fn(state, batch, s):
+                if n == "b" and s.steps_done == 1:
+                    s.request_stop(leave=True)
+                return {"w": state["w"] + 1.0}
+            try:
+                sups[n].bind(2, timeout=10.0)
+                sups[n].run(fn, 4)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors[n] = e
+
+        try:
+            for n in ("a", "b"):
+                threads[n] = threading.Thread(target=member, args=(n,))
+                threads[n].start()
+            for t in threads.values():
+                t.join(timeout=30.0)
+            if "b" in errors:
+                raise errors["b"]
+            if "a" in errors:
+                raise errors["a"]
+            assert sups["a"].roster == ["a"], sups["a"].roster
+            assert any(e.get("cause") == "drain"
+                       for e in sups["a"].events), sups["a"].events
+        finally:
+            for n in ("a", "b"):
+                sups[n].close()
+                mgrs[n].stop()
+            store.stop()
     elif site.startswith("supervisor."):
         import numpy as np
         from paddle_tpu.distributed.ckpt_manager import CheckpointManager
@@ -108,6 +171,51 @@ def main(site: str) -> None:
             a.stop()
             b.stop()
             store.stop()
+    elif site == "ckpt.shard_staged":
+        import numpy as np
+        from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+
+        # one owner's whole sharded commit: stage (the armed site sits
+        # between the shard file and its receipt) then self-commit. A
+        # stall burns the commit Deadline into the typed
+        # CheckpointTimeout and the generation never exists; a dropped
+        # wire is absorbed by the stage's retry-once.
+        mgr = CheckpointManager(os.path.join(os.getcwd(), "ckpt"))
+        w = np.arange(8, dtype=np.float32)
+        mgr.save_sharded(1, "a", ["a"], {"w|full": w},
+                         {"w": {"shape": [8], "dtype": "float32",
+                                "spec": [None]}},
+                         budget=BUDGET)
+        assert mgr.latest() == 1
+    elif site == "ckpt.receipts":
+        import threading
+        import time
+        import numpy as np
+        from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+
+        # the committer's receipt-collection poll: owner b stages LATE
+        # (from a thread) so the committer's first poll finds b's receipt
+        # missing and traverses the armed site. A stalled poll burns the
+        # commit Deadline into the typed CheckpointTimeout; a dropped
+        # wire is absorbed and the late receipt then completes the
+        # commit.
+        root = os.path.join(os.getcwd(), "ckpt")
+        a, b = CheckpointManager(root), CheckpointManager(root)
+        w = np.arange(8, dtype=np.float32)
+        meta = {"w": {"shape": [8], "dtype": "float32", "spec": ["dp"]}}
+
+        def late_stage():
+            time.sleep(0.3)
+            b.stage_shards(1, "b", {"w|4:8": w[4:]}, budget=BUDGET)
+
+        t = threading.Thread(target=late_stage)
+        t.start()
+        try:
+            a.save_sharded(1, "a", ["a", "b"], {"w|0:4": w[:4]}, meta,
+                           budget=BUDGET)
+        finally:
+            t.join(timeout=5.0)
+        assert a.latest() == 1
     elif site == "engine.pressure":
         import numpy as np
         import jax

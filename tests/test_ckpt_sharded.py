@@ -1,0 +1,298 @@
+"""Crash-consistent SHARDED generation commits (distributed/ckpt_manager).
+
+The owner-sharded layout is two-phase: every owner stages its bricks as
+`shard-<owner>.npz` + CRC sidecar + a per-owner receipt, then ONE
+committer collects every receipt, cross-checks them against the staged
+sidecars, and writes metadata + the unified manifest + the atomic COMMIT
+marker. The laws under test:
+
+  * a partial stage (shards and receipts but no marker) NEVER becomes
+    latest() — readers keep resolving the previous committed generation;
+  * a receipt that disagrees with the staged bytes is a typed
+    CheckpointCorruptionError at commit time, not a torn restore later;
+  * GC reaps dead partial stages of BOTH layouts once a newer commit
+    lands;
+  * a generation written shard-by-shard restores bit-identically to the
+    same state written through the gather layout — one read side;
+  * N owners staging concurrently beat one gatherer writing the same
+    bytes (the whole point of sharding the write path).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.checkpoint import CheckpointCorruptionError
+from paddle_tpu.distributed.ckpt_manager import (COMMIT, CheckpointManager,
+                                                 MANIFEST, SHARDED_LAYOUT)
+from paddle_tpu.utils.deadline import CheckpointTimeout
+
+
+def _state_for(step, rows=8):
+    return {"w": np.full((rows, 4), float(step), np.float32),
+            "b": (np.arange(rows, dtype=np.float32) + 1) * step}
+
+
+def _meta_for(state):
+    return {n: {"shape": list(v.shape), "dtype": str(v.dtype),
+                "spec": ["dp"] + [None] * (v.ndim - 1)}
+            for n, v in state.items()}
+
+
+def _stripe(state, i, n):
+    """Owner i's dp-stripe of every param, slice-keyed the way the
+    reader assembles (`name|lo:hi,...` over every dim)."""
+    out = {}
+    for name, v in state.items():
+        rows = v.shape[0] // n
+        lo, hi = i * rows, (i + 1) * rows
+        idx = ",".join([f"{lo}:{hi}"] + [f"0:{d}" for d in v.shape[1:]])
+        out[f"{name}|{idx}"] = v[lo:hi].copy()
+    return out
+
+
+def _sharded_save(root, step, state, owners, budget=30.0):
+    """Every owner stages its stripe from its own thread (its own manager,
+    like separate processes over a shared filesystem); the lowest id
+    collects receipts and commits. Returns per-owner wall seconds."""
+    meta = _meta_for(state)
+    walls, errs = {}, {}
+
+    def run(i, owner):
+        try:
+            mgr = CheckpointManager(root)
+            t0 = time.monotonic()
+            mgr.save_sharded(step, owner, owners,
+                             _stripe(state, i, len(owners)), meta,
+                             budget=budget)
+            walls[owner] = time.monotonic() - t0
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs[owner] = e
+
+    threads = [threading.Thread(target=run, args=(i, o))
+               for i, o in enumerate(owners)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    if errs:
+        raise next(iter(errs.values()))
+    return walls
+
+
+def test_partial_stage_is_never_latest(tmp_path):
+    """Shards + receipts but no COMMIT marker: the generation does not
+    exist for readers — latest() and restore() keep the previous one."""
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root)
+    mgr.save(_state_for(1), 1)
+
+    # both owners stage step-2 fully (receipts included); nobody commits
+    st2 = _state_for(2)
+    for i, owner in enumerate(("a", "b")):
+        mgr.stage_shards(2, owner, _stripe(st2, i, 2))
+    assert os.path.exists(os.path.join(mgr.gen_dir(2), "receipt-a.json"))
+    assert not os.path.exists(os.path.join(mgr.gen_dir(2), COMMIT))
+
+    fresh = CheckpointManager(root)
+    assert fresh.latest() == 1
+    state = {"w": np.zeros((8, 4), np.float32),
+             "b": np.zeros(8, np.float32)}
+    assert fresh.restore(state) == 1
+    np.testing.assert_array_equal(state["w"], _state_for(1)["w"])
+
+
+def test_receipt_shard_mismatch_rejected_typed(tmp_path):
+    """A receipt whose CRC disagrees with the staged sidecar (a torn or
+    replayed stage) must fail the COMMIT with the typed
+    CheckpointCorruptionError — and leave the generation uncommitted."""
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root)
+    st = _state_for(3)
+    mgr.stage_shards(3, "a", _stripe(st, 0, 2))
+    mgr.stage_shards(3, "b", _stripe(st, 1, 2))
+
+    # doctor b's receipt so it vouches for different bytes
+    rpath = os.path.join(mgr.gen_dir(3), "receipt-b.json")
+    rec = json.load(open(rpath))
+    rec["files"]["shard-b.npz"]["crc32"] = "deadbeef"
+    with open(rpath, "w") as f:
+        json.dump(rec, f)
+
+    with pytest.raises(CheckpointCorruptionError):
+        mgr.commit_sharded(3, ["a", "b"], _meta_for(st), budget=5.0)
+    assert not os.path.exists(os.path.join(mgr.gen_dir(3), COMMIT))
+    assert CheckpointManager(root).latest() is None
+
+
+def test_receipt_owner_mismatch_rejected_typed(tmp_path):
+    """A receipt filed under one owner's name but claiming another (a
+    mis-routed or replayed receipt) is typed corruption, not a commit."""
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root)
+    st = _state_for(4)
+    mgr.stage_shards(4, "a", _stripe(st, 0, 2))
+    mgr.stage_shards(4, "b", _stripe(st, 1, 2))
+    rpath = os.path.join(mgr.gen_dir(4), "receipt-b.json")
+    rec = json.load(open(rpath))
+    rec["owner"] = "z"
+    with open(rpath, "w") as f:
+        json.dump(rec, f)
+    with pytest.raises(CheckpointCorruptionError):
+        mgr.commit_sharded(4, ["a", "b"], _meta_for(st), budget=5.0)
+
+
+def test_under_covered_commit_rejected_typed(tmp_path):
+    """Receipts that together cover only part of a parameter's volume
+    must refuse to commit: an under-covered generation would only fail
+    at restore time, long after the writers are gone."""
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root)
+    st = _state_for(5)
+    # owner a stages only ITS stripe but claims to be the whole commit
+    mgr.stage_shards(5, "a", _stripe(st, 0, 2))
+    with pytest.raises(CheckpointCorruptionError, match="under-covered"):
+        mgr.commit_sharded(5, ["a"], _meta_for(st), budget=5.0)
+
+
+def test_commit_abort_raises_typed_timeout(tmp_path):
+    """The committer's receipt wait honors its abort callback (an owner
+    died, the roster changed): typed CheckpointTimeout naming the missing
+    receipts, without burning the whole budget."""
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root)
+    st = _state_for(6)
+    mgr.stage_shards(6, "a", _stripe(st, 0, 2))
+    with pytest.raises(CheckpointTimeout, match="missing"):
+        mgr.commit_sharded(6, ["a", "b"], _meta_for(st), budget=30.0,
+                           abort=lambda: True)
+
+
+def test_gc_reaps_partial_stages_of_both_layouts(tmp_path):
+    """Dead partial attempts — a gather-layout stage without a marker AND
+    a sharded stage without a marker — are reaped by the next successful
+    commit's GC; committed generations obey keep_last_k."""
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root, keep_last_k=2)
+    _sharded_save(root, 1, _state_for(1), ["a", "b"])
+
+    # dead gather-layout attempt at step 2
+    os.makedirs(mgr.gen_dir(2), exist_ok=True)
+    with open(os.path.join(mgr.gen_dir(2), "shard-0.npz"), "wb") as f:
+        f.write(b"half a shard from a dead gatherer")
+    # dead sharded attempt at step 3: staged + receipt, no marker
+    mgr.stage_shards(3, "a", _stripe(_state_for(3), 0, 2))
+
+    _sharded_save(root, 4, _state_for(4), ["a", "b"])
+    assert mgr.all_steps() == [1, 4]
+    assert not os.path.exists(mgr.gen_dir(2))
+    assert not os.path.exists(mgr.gen_dir(3))
+
+
+def test_sharded_restores_bitwise_like_gather(tmp_path):
+    """One read side for both layouts: the same state committed through
+    the gather path and through per-owner shard files restores
+    bit-identically, and the sharded manifest is typed with its layout."""
+    st = _state_for(7)
+    groot, sroot = str(tmp_path / "g"), str(tmp_path / "s")
+    CheckpointManager(groot).save(st, 7)
+    _sharded_save(sroot, 7, st, ["a", "b", "c", "d"])
+
+    man = CheckpointManager(sroot).manifest(7)
+    assert man["layout"] == SHARDED_LAYOUT
+    out = {}
+    for root in (groot, sroot):
+        state = {"w": np.zeros((8, 4), np.float32),
+                 "b": np.zeros(8, np.float32)}
+        assert CheckpointManager(root).restore(state) == 7
+        out[root] = state
+    for name in st:
+        np.testing.assert_array_equal(out[groot][name], out[sroot][name])
+        np.testing.assert_array_equal(out[sroot][name], st[name])
+
+
+def test_sharded_commit_beats_gather_commit(tmp_path):
+    """The acceptance bench. A gather commit is a reshard onto ONE owner
+    — every non-committer ships its stripe over the store transport
+    before a single writer serializes the whole state. The sharded
+    commit's point is that those bytes never cross the wire: each owner
+    writes its bricks to the shared checkpoint filesystem directly.
+    Both sides run the real primitives (StoreTransport over a TCPStore
+    for the gather's byte movement, save_sharded for the bricks)."""
+    from paddle_tpu.distributed import reshard as rs
+    from paddle_tpu.distributed import store as store_mod
+
+    rows, owners = 4096, ["a", "b", "c", "d"]   # ~16 MB of float32
+    st = {"w": np.random.RandomState(0)
+          .standard_normal((rows, 1024)).astype(np.float32)}
+    stripes = {o: _stripe(st, i, len(owners))
+               for i, o in enumerate(owners)}
+
+    # -- gather commit: 3 stripes over the wire, then one writer --------
+    groot = str(tmp_path / "g")
+    ts = store_mod.TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    try:
+        tr = rs.StoreTransport(ts, prefix="bench")
+        from paddle_tpu.utils.deadline import Deadline
+
+        def ship(owner):
+            for key, arr in stripes[owner].items():
+                tr.put(f"{owner}/{key}", arr.tobytes())
+
+        t0 = time.monotonic()
+        senders = [threading.Thread(target=ship, args=(o,))
+                   for o in owners[1:]]
+        for t in senders:
+            t.start()
+        full = {k: v.copy() for k, v in stripes[owners[0]].items()}
+        dl = Deadline(60.0, what="bench gather")
+        for o in owners[1:]:
+            for key, arr in stripes[o].items():
+                got = np.frombuffer(tr.get(f"{o}/{key}", dl),
+                                    dtype=arr.dtype).reshape(arr.shape)
+                full[key] = got.copy()
+        assembled = {"w": np.concatenate(
+            [full[k] for k in sorted(full, key=lambda k: int(
+                k.split("|")[1].split(":")[0]))])}
+        CheckpointManager(groot).save(assembled, 1)
+        gather_wall = time.monotonic() - t0
+        for t in senders:
+            t.join(timeout=30.0)
+    finally:
+        ts.stop()
+
+    # -- sharded commit: every owner writes its own bricks --------------
+    sroot = str(tmp_path / "s")
+    t0 = time.monotonic()
+    _sharded_save(sroot, 1, st, owners)
+    sharded_wall = time.monotonic() - t0
+
+    state = {"w": np.zeros((rows, 1024), np.float32)}
+    assert CheckpointManager(sroot).restore(state) == 1
+    np.testing.assert_array_equal(state["w"], st["w"])
+    np.testing.assert_array_equal(assembled["w"], st["w"])
+    assert sharded_wall < gather_wall, (
+        f"sharded commit ({sharded_wall:.3f}s) did not beat the gather "
+        f"commit ({gather_wall:.3f}s) on {rows * 1024 * 4} bytes")
+
+
+def test_commit_drops_files_no_receipt_vouches_for(tmp_path):
+    """Leftover shard files from a dead EARLIER attempt of the same step
+    (an owner that is not part of this commit) must not ride into the
+    manifest: the generation is exactly what the receipts vouch for."""
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root)
+    st = _state_for(8)
+    # a dead previous attempt by owner z, receipt and all
+    mgr.stage_shards(8, "z", _stripe(st, 0, 2))
+    _sharded_save(root, 8, st, ["a", "b"])
+    man = CheckpointManager(root).manifest(8)
+    assert "shard-z.npz" not in man["files"]
+    assert not os.path.exists(os.path.join(mgr.gen_dir(8), "shard-z.npz"))
+    state = {"w": np.zeros((8, 4), np.float32),
+             "b": np.zeros(8, np.float32)}
+    assert CheckpointManager(root).restore(state) == 8
+    np.testing.assert_array_equal(state["w"], st["w"])

@@ -107,7 +107,7 @@ def test_checkpoint_async_save_failure_reraised_exactly_once(tmp_path,
     with pytest.raises(RuntimeError, match="async checkpoint save") as ei:
         ckpt.wait()
     assert isinstance(ei.value.__cause__, OSError)
-    assert ckpt._pending is None and ckpt._pending_error is None
+    assert ckpt._writer.idle()
     ckpt.wait()      # second wait: no re-raise, error consumed
     assert not (tmp_path / "c" / "metadata.json").exists()
 

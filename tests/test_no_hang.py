@@ -128,6 +128,37 @@ MATRIX = {
     ("supervisor.resume", "delay:2.0"): ("typed", "SupervisorTimeout"),
     ("supervisor.resume", "error"):     ("typed", "FaultInjected"),
     ("supervisor.resume", "drop"):      ("clean", None),
+    # coordinated drain (supervisor.drain — the leaver's announcement on
+    # the store before it participates in its own farewell rendezvous): a
+    # stalled announcement burns the drain Deadline into the typed
+    # SupervisorTimeout (a wedged graceful leave must name its stuck
+    # dependency, never hang the fleet); a dropped wire is absorbed by
+    # the announce retry-once (the counter add is idempotent per armed
+    # hit); an injected error propagates typed; a crash at the
+    # announcement is the leaver dying mid-goodbye — the kill matrix
+    # (tests/test_supervisor.py) proves survivors take it as an ordinary
+    # crash with zero replayed steps lost.
+    ("supervisor.drain", "crash"):     ("sigkill", None),
+    ("supervisor.drain", "delay:2.0"): ("typed", "SupervisorTimeout"),
+    ("supervisor.drain", "error"):     ("typed", "FaultInjected"),
+    ("supervisor.drain", "drop"):      ("clean", None),
+    # sharded generation commit (distributed/ckpt_manager): the window
+    # between an owner's staged shard file and its receipt
+    # (ckpt.shard_staged), and the committer's receipt-collection /
+    # marker-wait poll (ckpt.receipts). A stall at either burns the
+    # commit Deadline into the typed CheckpointTimeout — the generation
+    # stays uncommitted, readers keep resolving the previous one, GC
+    # reaps the partial stage; a dropped wire is absorbed by retry-once
+    # (shard, sidecar, and receipt writes are idempotent); a crash is
+    # the killed-writer case the chaos suite proves crash-consistent.
+    ("ckpt.shard_staged", "crash"):     ("sigkill", None),
+    ("ckpt.shard_staged", "delay:2.0"): ("typed", "CheckpointTimeout"),
+    ("ckpt.shard_staged", "error"):     ("typed", "FaultInjected"),
+    ("ckpt.shard_staged", "drop"):      ("clean", None),
+    ("ckpt.receipts", "crash"):     ("sigkill", None),
+    ("ckpt.receipts", "delay:2.0"): ("typed", "CheckpointTimeout"),
+    ("ckpt.receipts", "error"):     ("typed", "FaultInjected"),
+    ("ckpt.receipts", "drop"):      ("clean", None),
     # serving gateway (inference/serving/gateway): the accept loop and the
     # per-connection request read. An accept-side fault costs one
     # connection — the client's reconnect-and-retry absorbs error/drop
@@ -647,6 +678,32 @@ def test_supervisor_delay_becomes_typed_timeout_in_child(tmp_path):
     into the typed SupervisorTimeout, never a hang."""
     proc = _spawn_case("supervisor.rendezvous", "delay:2.0", tmp_path)
     _assert_case("supervisor.rendezvous", "delay:2.0", proc)
+
+
+def test_drain_delay_becomes_typed_timeout_in_child(tmp_path):
+    """Quick tier-1 representative of the drain rows: a leaver whose
+    drain announcement stalls burns its drain Deadline into the typed
+    SupervisorTimeout — a wedged graceful leave names its stuck
+    dependency instead of hanging the fleet."""
+    proc = _spawn_case("supervisor.drain", "delay:2.0", tmp_path)
+    _assert_case("supervisor.drain", "delay:2.0", proc)
+
+
+def test_sharded_stage_delay_becomes_typed_timeout_in_child(tmp_path):
+    """Quick tier-1 representative of the sharded-commit stage rows: a
+    stall between an owner's shard file and its receipt burns the commit
+    Deadline into the typed CheckpointTimeout — the generation never
+    commits and readers keep resolving the previous one."""
+    proc = _spawn_case("ckpt.shard_staged", "delay:2.0", tmp_path)
+    _assert_case("ckpt.shard_staged", "delay:2.0", proc)
+
+
+def test_receipt_collection_drop_absorbed_in_child(tmp_path):
+    """Quick tier-1 representative of the receipt-collection rows: a
+    dropped wire during the committer's receipt poll is absorbed by
+    retry-once, and the late owner's receipt then completes the commit."""
+    proc = _spawn_case("ckpt.receipts", "drop", tmp_path)
+    _assert_case("ckpt.receipts", "drop", proc)
 
 
 def test_gateway_read_delay_becomes_typed_timeout_in_child(tmp_path):

@@ -211,6 +211,77 @@ def test_graceful_leave_shrinks_and_matches_oracle(tmp_path):
         _stop_fleet(sups, store, els)
 
 
+def test_coordinated_drain_typed_event_and_forensics(tmp_path):
+    """The coordinated drain is its own TYPED cause end to end: the
+    survivor classifies the announced departure as "drain" (no
+    failure-detection deadline burned, zero replayed steps — the event
+    rides a live rung), the leaver records its own "drained" farewell
+    event, and BOTH sides auto-export an incident bundle + trace beside
+    the generation directories."""
+    sv.reset_events()
+
+    def fn_a(state, batch, sup):
+        return step_fn(state, batch, sup)
+
+    def fn_b(state, batch, sup):
+        if sup.steps_done == 2:
+            sup.request_stop(leave=True)
+        return step_fn(state, batch, sup)
+
+    sups, results, errors, mgr, store, els = _run_fleet(
+        tmp_path, ["a", "b"], 6, {"a": fn_a, "b": fn_b})
+    try:
+        assert not errors, errors
+        a, b = sups["a"], sups["b"]
+
+        # survivor: exactly one event, typed-distinct from every crash
+        assert [e["cause"] for e in a.events] == ["drain"]
+        ea = a.events[0]
+        # zero replayed steps: the generation the event committed IS the
+        # step count at the leave — nothing rolled back, nothing re-run
+        assert ea["generation"] == 3 and ea["steps"] == 3
+        assert a.steps_done == 6 and a.roster == ["a"]
+
+        # leaver: its own farewell event — it participated in the swap
+        # (bricks staged, reshard served) and only then revoked its lease
+        eb = b.events[-1]
+        assert eb["cause"] == "drain" and eb["how"] == "drained"
+        assert eb["state_sha"] is None and eb["roster"] == ["a"]
+        assert eb["steps"] == 3
+
+        # per-step sharded commits recorded their accounting
+        assert a.commit_stats and all(
+            s["owner"] == "a" and s["bytes"] > 0 for s in a.commit_stats)
+
+        # forensics: incident bundle + Chrome-trace export on BOTH sides,
+        # beside (never inside) the generation directories
+        root = str(tmp_path / "ckpt")
+        names = os.listdir(root)
+        for nid, ev in (("a", ea), ("b", eb)):
+            tag = (f"incident-step{ev['generation']}"
+                   f"-epoch{ev['epoch']}-{nid}")
+            assert f"{tag}.json" in names, names
+            assert f"{tag}.trace.json" in names, names
+            with open(os.path.join(root, f"{tag}.json")) as f:
+                bundle = json.load(f)
+            assert bundle["event"]["cause"] == "drain"
+        # the forensics files are invisible to the generation scanner
+        assert mgr.latest() == 6
+
+        # zero-dup/zero-lost: the oracle replay equals the survivor
+        full, members = _replay(a.events, 6, ["a", "b"], mgr=mgr)
+        assert members == ["a"]
+        for k in full:
+            assert np.array_equal(results["a"][k], full[k]), k
+
+        # the drain-vs-crash split reaches the profiler summary
+        import paddle_tpu.profiler as profiler
+        text = profiler.supervisor_summary()
+        assert "drain" in text and "drained" in text
+    finally:
+        _stop_fleet(sups, store, els)
+
+
 def test_rendezvous_key_gc_across_epochs(tmp_path, monkeypatch):
     """Satellite (ISSUE 14): the store must NOT accumulate per-epoch
     rendezvous keys and per-step barrier keys for the life of a run.
@@ -592,11 +663,12 @@ def _run_parent_member(store, out_dir, n_steps, n_members, budget=20.0):
 
 
 def _chaos_case(tmp_path, site, n_members, n_steps=6, leave=None,
-                armed=("c",)):
+                armed=("c",), arm_skip="0"):
     """Parent = survivor 'a' in-process; children = the other members.
-    `armed` children SIGKILL at `site`; `leave` maps a child id to its
-    scripted graceful-leave step (the event that puts the armed child
-    INSIDE a scale event when the site is not supervisor.detect)."""
+    `armed` children SIGKILL at `site` (after `arm_skip` unarmed
+    traversals); `leave` maps a child id to its scripted graceful-leave
+    step (the event that puts the armed child INSIDE a scale event when
+    the site is not supervisor.detect)."""
     sv.reset_events()
     chaos.reset_hits()
     ids = ["a", "b", "c", "d"][:n_members]
@@ -608,7 +680,8 @@ def _chaos_case(tmp_path, site, n_members, n_steps=6, leave=None,
             extra = {}
             if nid in armed:
                 extra = {"PT_FAULTPOINT": site, "PT_FAULTPOINT_MODE": "crash",
-                         "PT_FAULTPOINT_HITS": "1", "PT_FAULTPOINT_SKIP": "0"}
+                         "PT_FAULTPOINT_HITS": "1",
+                         "PT_FAULTPOINT_SKIP": arm_skip}
             if leave and nid in leave:
                 extra["PT_SUP_LEAVE_STEP"] = str(leave[nid])
             procs[nid] = _spawn_member(store.port, nid, tmp_path, n_steps,
@@ -681,8 +754,10 @@ def test_sites_registered_for_fault_matrix():
     """The supervisor.* sites are enumerable via fault_sites(): the site
     x mode matrix (test_no_hang.MATRIX) widens automatically."""
     assert {"supervisor.detect", "supervisor.rendezvous",
-            "supervisor.swap", "supervisor.resume"} <= \
-        set(chaos.fault_sites("supervisor."))
+            "supervisor.swap", "supervisor.resume",
+            "supervisor.drain"} <= set(chaos.fault_sites("supervisor."))
+    assert {"ckpt.shard_staged", "ckpt.receipts"} <= \
+        set(chaos.fault_sites("ckpt."))
 
 
 def test_member_sigkilled_at_detect_survivor_resumes_dp1(tmp_path):
@@ -698,14 +773,31 @@ def test_member_sigkilled_at_detect_survivor_resumes_dp1(tmp_path):
 @pytest.mark.slow
 @pytest.mark.parametrize("site", ["supervisor.detect",
                                   "supervisor.rendezvous",
-                                  "supervisor.swap", "supervisor.resume"])
+                                  "supervisor.swap", "supervisor.resume",
+                                  "supervisor.drain",
+                                  "ckpt.shard_staged", "ckpt.receipts"])
 def test_kill_matrix_dp4_to_dp2_every_supervisor_site(tmp_path, site):
     """The acceptance matrix: a real dp4 run; b leaves gracefully at step
-    2 (the scale event), c SIGKILLs at the armed supervisor site (for
-    detect: at its first poll, before any event). Survivors a+d converge
-    on dp2 within the supervisor deadline; resumed params bitwise a fresh
+    2 (the scale event), c SIGKILLs at the armed site (for detect: at its
+    first poll, before any event; for the ckpt.* sites: inside its very
+    first sharded commit — shard staged but receipt never filed, or
+    wedged in the marker wait; for drain: announcing its OWN coordinated
+    departure at step 3, dying mid-goodbye). Survivors a+d converge on
+    dp2 within the supervisor deadline; resumed params bitwise a fresh
     restore of the same committed generation; the stream's global prefix
     replays exactly-once (oracle equality)."""
+    leave = {"b": 2}
+    skip = "0"
+    if site == "supervisor.drain":
+        # the armed child only reaches the drain site by draining itself
+        leave = {"b": 2, "c": 3}
+    if site == "ckpt.shard_staged":
+        # skip the INITIAL commit's traversal: dying there loses c's
+        # dp-shard with no committed generation to roll back to —
+        # genuinely unrecoverable by design. Killed at its step-1 commit
+        # instead, the survivors roll back to the initial generation and
+        # full-restore (the ladder's bottom rung).
+        skip = "1"
     sup = _chaos_case(tmp_path, site, n_members=4, n_steps=6,
-                      leave={"b": 2}, armed=("c",))
+                      leave=leave, armed=("c",), arm_skip=skip)
     assert sorted(sup.roster) == ["a", "d"], sup.roster
